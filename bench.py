@@ -1,0 +1,77 @@
+"""Benchmark: accepted-particles/sec on the Gaussian-mixture ABC-SMC config.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Problem: BASELINE.json config #2 (two-Gaussian model selection) at
+population 16384 with a FIXED epsilon = 0.2 — the same threshold the
+baseline generation was measured at, so both sides do identical per-
+candidate work (KDE transition draw, simulate, distance, threshold accept,
+O(N)-support KDE pdf for the importance weight) in the same acceptance
+regime.
+
+Baseline: BASELINE_MEASURED.json — a faithful reproduction of pyABC's
+default ``MulticoreEvalParallelSampler`` hot loop measured on this host's
+CPUs with the KDE support matched to the same population size
+(tools/baseline_reference.py; the reference package itself cannot run in
+this image).  Metric for both sides: accepted particles per second of
+steady-state generation sampling (excluding XLA compile, which is one-off).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+POP = 16384
+WARMUP_GENERATIONS = 3
+TIMED_GENERATIONS = 3
+FALLBACK_BASELINE = 675.19  # accepted/s, see BASELINE_MEASURED.json
+
+
+def main():
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    sampler = pt.VectorizedSampler(max_batch_size=1 << 20)
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=POP,
+        eps=pt.ConstantEpsilon(0.2),
+        sampler=sampler,
+        seed=0)
+    abc.new("sqlite://", observed)
+
+    # warm-up: calibration + first generations trigger all XLA compiles
+    abc.run(max_nr_populations=WARMUP_GENERATIONS)
+
+    t0 = time.perf_counter()
+    h = abc.run(max_nr_populations=TIMED_GENERATIONS)
+    elapsed = time.perf_counter() - t0
+    pops = h.get_all_populations()
+    timed = pops[pops.t >= WARMUP_GENERATIONS]
+    accepted = POP * len(timed)
+
+    rate = accepted / elapsed
+
+    baseline = FALLBACK_BASELINE
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_MEASURED.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            baseline = json.load(f)["accepted_particles_per_sec"]
+
+    print(json.dumps({
+        "metric": "accepted_particles_per_sec_gaussian_mixture_pop16384",
+        "value": round(rate, 1),
+        "unit": "particles/s",
+        "vs_baseline": round(rate / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

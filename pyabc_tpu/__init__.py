@@ -1,0 +1,140 @@
+"""pyabc_tpu: TPU-native likelihood-free Bayesian inference (ABC-SMC).
+
+A ground-up JAX/XLA re-design of the capabilities of pyABC (reference:
+kurhula/pyABC v0.10.5): instead of farming millions of per-particle Python
+closure calls to processes/Redis/Dask, every SMC generation runs as fused,
+fixed-shape, mesh-shardable XLA programs on TPU.
+
+Public API parity with ``pyabc/__init__.py:21-107``.
+"""
+
+from .acceptor import (
+    Acceptor,
+    AcceptorResult,
+    ScaledPDFNorm,
+    StochasticAcceptor,
+    UniformAcceptor,
+    pdf_norm_from_kernel,
+    pdf_norm_max_found,
+)
+from .distance import (
+    SCALE_LIN,
+    SCALE_LOG,
+    AcceptAllDistance,
+    AdaptiveAggregatedDistance,
+    AdaptivePNormDistance,
+    AggregatedDistance,
+    BinomialKernel,
+    Distance,
+    IdentityFakeDistance,
+    IndependentLaplaceKernel,
+    IndependentNormalKernel,
+    MinMaxDistance,
+    NegativeBinomialKernel,
+    NoDistance,
+    NormalKernel,
+    PCADistance,
+    PercentileDistance,
+    PNormDistance,
+    PoissonKernel,
+    RangeEstimatorDistance,
+    SimpleFunctionDistance,
+    SimpleFunctionKernel,
+    StochasticKernel,
+    ZScoreDistance,
+)
+from .epsilon import (
+    AcceptanceRateScheme,
+    ConstantEpsilon,
+    DalyScheme,
+    Epsilon,
+    EssScheme,
+    ExpDecayFixedIterScheme,
+    ExpDecayFixedRatioScheme,
+    FrielPettittScheme,
+    ListEpsilon,
+    ListTemperature,
+    MedianEpsilon,
+    NoEpsilon,
+    PolynomialDecayFixedIterScheme,
+    QuantileEpsilon,
+    Temperature,
+    TemperatureBase,
+)
+from .model import IntegratedModel, Model, ModelResult, SimpleModel
+from .parameters import Parameter, ParameterSpace
+from .population import Population
+from .populationstrategy import (
+    AdaptivePopulationSize,
+    ConstantPopulationSize,
+    ListPopulationSize,
+)
+from .random_variables import (
+    RV,
+    Distribution,
+    LowerBoundDecorator,
+    ModelPerturbationKernel,
+    RVBase,
+    TruncatedRV,
+)
+from .sampler import (
+    MulticoreEvalParallelSampler,
+    MulticoreParticleParallelSampler,
+    RoundKernel,
+    Sample,
+    Sampler,
+    ShardedSampler,
+    SingleCoreSampler,
+    VectorizedSampler,
+)
+from .smc import ABCSMC
+from .storage import History
+from .sumstat import SumStatSpec
+from .transition import (
+    AggregatedTransition,
+    DiscreteRandomWalkTransition,
+    GridSearchCV,
+    LocalTransition,
+    MultivariateNormalTransition,
+)
+from .version import __version__  # noqa: F401
+
+import logging as _logging
+import os as _os
+
+# per-subsystem loggers, level from ABC_LOG_LEVEL (reference
+# pyabc/__init__.py:109-117)
+_log_level = _os.environ.get("ABC_LOG_LEVEL", "INFO").upper()
+for _name in ("ABC", "ABC.Sampler", "ABC.Distance", "ABC.Epsilon",
+              "ABC.Acceptor", "ABC.History"):
+    _logging.getLogger(_name).setLevel(_log_level)
+
+__all__ = [
+    "ABCSMC", "History", "Population", "Parameter", "ParameterSpace",
+    "SumStatSpec",
+    "Model", "SimpleModel", "IntegratedModel", "ModelResult",
+    "RV", "RVBase", "Distribution", "ModelPerturbationKernel",
+    "LowerBoundDecorator", "TruncatedRV",
+    "Distance", "NoDistance", "AcceptAllDistance", "IdentityFakeDistance",
+    "SimpleFunctionDistance", "PNormDistance", "AdaptivePNormDistance",
+    "AggregatedDistance", "AdaptiveAggregatedDistance", "ZScoreDistance",
+    "PCADistance", "RangeEstimatorDistance", "MinMaxDistance",
+    "PercentileDistance", "StochasticKernel", "SimpleFunctionKernel",
+    "NormalKernel", "IndependentNormalKernel", "IndependentLaplaceKernel",
+    "BinomialKernel", "PoissonKernel", "NegativeBinomialKernel",
+    "SCALE_LIN", "SCALE_LOG",
+    "Epsilon", "NoEpsilon", "ConstantEpsilon", "ListEpsilon",
+    "QuantileEpsilon", "MedianEpsilon", "TemperatureBase", "ListTemperature",
+    "Temperature", "AcceptanceRateScheme", "ExpDecayFixedIterScheme",
+    "ExpDecayFixedRatioScheme", "PolynomialDecayFixedIterScheme",
+    "DalyScheme", "FrielPettittScheme", "EssScheme",
+    "Acceptor", "AcceptorResult", "UniformAcceptor", "StochasticAcceptor",
+    "pdf_norm_from_kernel", "pdf_norm_max_found", "ScaledPDFNorm",
+    "MultivariateNormalTransition", "LocalTransition",
+    "DiscreteRandomWalkTransition", "GridSearchCV", "AggregatedTransition",
+    "ConstantPopulationSize", "AdaptivePopulationSize", "ListPopulationSize",
+    "Sampler", "Sample", "VectorizedSampler", "ShardedSampler",
+    "SingleCoreSampler", "MulticoreEvalParallelSampler",
+    "MulticoreParticleParallelSampler", "RoundKernel",
+    "__version__",
+]

@@ -1,0 +1,187 @@
+"""Acceptors: the accept/reject decision as a pure batched kernel.
+
+Parity: pyabc/acceptor/acceptor.py (607 LoC).
+
+- ``AcceptorResult`` (acceptor.py:32-65) -> here a tuple of arrays
+  ``(distance[N], accept[N], weight[N])`` over the whole candidate batch.
+- ``UniformAcceptor`` (acceptor.py:279-306): accept iff d ≤ ε_t; the
+  ``use_complete_history`` variant checks all previous thresholds, which for
+  a fixed distance collapses to d ≤ min_{s≤t} ε_s.
+- ``StochasticAcceptor`` (acceptor.py:309-476): exact-likelihood ABC
+  (Wilkinson): accept with probability (pdf/c)^(1/T); when the density
+  exceeds the normalization c the particle is always accepted and carries
+  importance weight acc_prob (= max(1, acc_prob) overall — acceptance math
+  at acceptor.py:449-467).  Everything is computed in log space (f32-safe on
+  TPU; the reference works in linear space).
+
+TPU split: lifecycle/update on host; ``accept(key, distance, params)`` is a
+pure jit-safe kernel whose dynamic params (ε or (c, T)) arrive as traced
+arguments so generations never recompile.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..distance.kernel import SCALE_LIN, SCALE_LOG, StochasticKernel
+from .pdf_norm import pdf_norm_from_kernel, pdf_norm_max_found
+
+Array = jnp.ndarray
+
+
+class AcceptorResult:
+    """Reference-compat result triple (acceptor/acceptor.py:32-65)."""
+
+    def __init__(self, distance, accept, weight=1.0):
+        self.distance = distance
+        self.accept = accept
+        self.weight = weight
+
+
+class Acceptor:
+    """Abstract acceptor.
+
+    Host lifecycle: ``initialize`` / ``update`` / ``get_epsilon_config``
+    (reference acceptor.py:68-190).  Device kernel: :meth:`accept`.
+    """
+
+    def initialize(self, t: int, get_weighted_distances: Optional[Callable],
+                   distance_function=None, x_0=None):
+        pass
+
+    def update(self, t: int, get_weighted_distances: Optional[Callable] = None,
+               prev_temperature: Optional[float] = None,
+               acceptance_rate: Optional[float] = None):
+        pass
+
+    def get_epsilon_config(self, t: int) -> dict:
+        """Hints passed to the epsilon/temperature (reference :176-190)."""
+        return {}
+
+    def requires_calibration(self) -> bool:
+        return False
+
+    # ---- device kernel ---------------------------------------------------
+
+    def get_params(self, t: int, epsilon) -> dict:
+        """Dynamic params for :meth:`accept` (ε or (pdf_norm, T))."""
+        return {"eps": jnp.float32(epsilon(t))}
+
+    def accept(self, key, distance: Array, params: dict):
+        """Pure: ``(accept[N] bool, weight[N] f32)``."""
+        raise NotImplementedError
+
+    def get_config(self):
+        return {"name": type(self).__name__}
+
+
+class UniformAcceptor(Acceptor):
+    """Accept iff distance ≤ ε (reference acceptor.py:279-306)."""
+
+    def __init__(self, use_complete_history: bool = False):
+        self.use_complete_history = use_complete_history
+        self._eps_history: dict = {}
+
+    def get_params(self, t: int, epsilon) -> dict:
+        eps = float(epsilon(t))
+        self._eps_history[t] = eps
+        if self.use_complete_history:
+            eps = min(v for s, v in self._eps_history.items() if s <= t)
+        return {"eps": jnp.float32(eps)}
+
+    def accept(self, key, distance, params):
+        acc = distance <= params["eps"]
+        return acc, jnp.ones_like(distance)
+
+
+class StochasticAcceptor(Acceptor):
+    """Exact stochastic acceptance (reference acceptor.py:309-476)."""
+
+    def __init__(self,
+                 pdf_norm_method: Callable = None,
+                 apply_importance_weighting: bool = True,
+                 log_file: Optional[str] = None):
+        self.pdf_norm_method = pdf_norm_method or pdf_norm_max_found
+        self.apply_importance_weighting = apply_importance_weighting
+        self.log_file = log_file
+        self.pdf_norms: dict = {}  # t -> log c
+        self.kernel_scale: str = SCALE_LOG
+        self.kernel_pdf_max: Optional[float] = None
+
+    def requires_calibration(self) -> bool:
+        return True
+
+    def initialize(self, t, get_weighted_distances=None,
+                   distance_function=None, x_0=None):
+        if isinstance(distance_function, StochasticKernel):
+            self.kernel_scale = distance_function.ret_scale
+            self.kernel_pdf_max = distance_function.pdf_max
+        self._update_pdf_norm(t, get_weighted_distances, None)
+
+    def update(self, t, get_weighted_distances=None, prev_temperature=None,
+               acceptance_rate=None):
+        self._update_pdf_norm(t, get_weighted_distances, prev_temperature)
+
+    def _log_scale(self, values):
+        values = np.asarray(values, dtype=np.float64)
+        if self.kernel_scale == SCALE_LIN:
+            with np.errstate(divide="ignore"):
+                values = np.log(np.maximum(values, 1e-290))
+        return values
+
+    def _update_pdf_norm(self, t, get_weighted_distances, prev_temperature):
+        kernel_val = self.kernel_pdf_max
+        if kernel_val is not None and self.kernel_scale == SCALE_LIN:
+            kernel_val = float(np.log(max(kernel_val, 1e-290)))
+
+        def get_log_weighted():
+            dens, w = get_weighted_distances()
+            return self._log_scale(dens), w
+
+        prev_norm = self.pdf_norms.get(t - 1)
+        self.pdf_norms[t] = float(self.pdf_norm_method(
+            kernel_val=kernel_val,
+            prev_pdf_norm=prev_norm,
+            get_weighted_distances=(get_log_weighted
+                                    if get_weighted_distances else None),
+            prev_temp=prev_temperature,
+        ))
+        if self.log_file:
+            from ..storage.json import save_dict_to_json
+            save_dict_to_json(self.pdf_norms, self.log_file)
+
+    def get_epsilon_config(self, t: int) -> dict:
+        """Consumed by Temperature schemes (reference acceptor.py:425-447)."""
+        return {"pdf_norm": self.pdf_norms.get(t, 0.0),
+                "kernel_scale": SCALE_LOG}  # we always hand over log values
+
+    # ---- device kernel ---------------------------------------------------
+
+    def get_params(self, t: int, epsilon) -> dict:
+        return {
+            "pdf_norm": jnp.float32(self.pdf_norms[t]),
+            "temp": jnp.float32(epsilon(t)),
+        }
+
+    def accept(self, key, distance, params):
+        """``distance`` here is the kernel (log-)density of each candidate."""
+        logdens = distance
+        if self.kernel_scale == SCALE_LIN:
+            logdens = jnp.log(jnp.maximum(distance, 1e-30))
+        log_acc_prob = (logdens - params["pdf_norm"]) / params["temp"]
+        u = jax.random.uniform(key, distance.shape)
+        acc = jnp.log(u) < log_acc_prob
+        if self.apply_importance_weighting:
+            weight = jnp.exp(jnp.maximum(log_acc_prob, 0.0))
+        else:
+            weight = jnp.ones_like(distance)
+        return acc, weight
+
+    def get_config(self):
+        return {"name": type(self).__name__,
+                "pdf_norm_method": getattr(self.pdf_norm_method, "__name__",
+                                           type(self.pdf_norm_method).__name__)}

@@ -1,0 +1,6 @@
+"""Bootstrap CV utilities (parity: pyabc/cv/)."""
+
+from .bootstrap import calc_cv
+from ..transition.predict_population_size import fit_powerlaw, predict_population_size
+
+__all__ = ["calc_cv", "fit_powerlaw", "predict_population_size"]

@@ -1,0 +1,36 @@
+"""Bootstrap cross-validation of KDE fits.
+
+Parity: pyabc/cv/bootstrap.py:43-110 (``calc_cv``): estimate the coefficient
+of variation of a transition's density estimate by refitting on bootstrap
+resamples — used by ``AdaptivePopulationSize``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def calc_cv(n_samples: int, model_weights, transitions: List,
+            n_bootstrap: int, test_points_per_model: List,
+            key=None) -> Tuple[float, list]:
+    """Weighted-average CV across models (reference cv/bootstrap.py:43-110).
+
+    ``transitions[m]`` must be fitted; ``test_points_per_model[m]`` are the
+    evaluation points (typically the current particles).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    model_weights = jnp.asarray(model_weights)
+    model_weights = model_weights / jnp.sum(model_weights)
+    cvs = []
+    for m, trans in enumerate(transitions):
+        key, sub = jax.random.split(key)
+        n_m = max(int(round(float(model_weights[m]) * n_samples)), 2)
+        cvs.append(trans.mean_cv(sub, n_samples=n_m, n_bootstrap=n_bootstrap,
+                                 test_points=test_points_per_model[m]))
+    cvs = jnp.asarray(cvs)
+    total = float(jnp.sum(model_weights * cvs))
+    return total, list(map(float, cvs))

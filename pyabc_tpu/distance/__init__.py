@@ -1,0 +1,56 @@
+"""Distances over summary statistics (parity: pyabc/distance/)."""
+
+from .base import (
+    AcceptAllDistance,
+    Distance,
+    IdentityFakeDistance,
+    NoDistance,
+    SimpleFunctionDistance,
+    to_distance,
+)
+from .distance import (
+    AdaptiveAggregatedDistance,
+    AdaptivePNormDistance,
+    AggregatedDistance,
+    MinMaxDistance,
+    PCADistance,
+    PercentileDistance,
+    PNormDistance,
+    RangeEstimatorDistance,
+    ZScoreDistance,
+)
+from .kernel import (
+    SCALE_LIN,
+    SCALE_LOG,
+    BinomialKernel,
+    IndependentLaplaceKernel,
+    IndependentNormalKernel,
+    NegativeBinomialKernel,
+    NormalKernel,
+    PoissonKernel,
+    SimpleFunctionKernel,
+    StochasticKernel,
+)
+from . import scale
+from .scale import (
+    combined_mean_absolute_deviation,
+    combined_median_absolute_deviation,
+    mean_absolute_deviation,
+    median_absolute_deviation,
+    root_mean_square_deviation,
+    standard_deviation,
+)
+
+__all__ = [
+    "Distance", "NoDistance", "AcceptAllDistance", "IdentityFakeDistance",
+    "SimpleFunctionDistance", "to_distance",
+    "PNormDistance", "AdaptivePNormDistance", "AggregatedDistance",
+    "AdaptiveAggregatedDistance", "ZScoreDistance", "PCADistance",
+    "RangeEstimatorDistance", "MinMaxDistance", "PercentileDistance",
+    "StochasticKernel", "SimpleFunctionKernel", "NormalKernel",
+    "IndependentNormalKernel", "IndependentLaplaceKernel", "BinomialKernel",
+    "PoissonKernel", "NegativeBinomialKernel", "SCALE_LIN", "SCALE_LOG",
+    "scale", "standard_deviation", "median_absolute_deviation",
+    "mean_absolute_deviation", "root_mean_square_deviation",
+    "combined_mean_absolute_deviation", "combined_median_absolute_deviation",
+]

@@ -1,0 +1,168 @@
+"""Distance base contract — split into static structure + dynamic params.
+
+The reference ``Distance`` lifecycle (pyabc/distance/base.py:10-155):
+``initialize(t, get_sum_stats, x_0)`` / ``configure_sampler(sampler)`` /
+``update(t, sum_stats) -> bool`` / ``__call__(x, x_0, t, par)``.
+
+TPU twist: the per-generation sampling round is compiled ONCE; everything
+that changes between generations (adaptive weights, scales, whitening
+matrices) must flow in as traced ARGUMENTS, not be baked into the compiled
+program (recompiles cost tens of seconds).  So every distance exposes:
+
+- ``get_params(t) -> pytree``  (host side, cheap, per generation)
+- ``compute(flat_stats[N,S], flat_obs[S], params) -> f32[N]``  (pure, jitted)
+
+The lifecycle methods mutate only host-side numpy state that feeds
+``get_params``.  ``__call__`` composes the two for eager/single use.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..sumstat import SumStatSpec
+
+Array = jnp.ndarray
+
+
+class Distance:
+    """Abstract distance over summary statistics.
+
+    Subclasses implement :meth:`compute` (pure) and optionally the adaptive
+    lifecycle.  ``spec`` (the sum-stat layout) is bound in
+    :meth:`initialize`.
+    """
+
+    #: whether this distance needs rejected particles recorded
+    #: (reference: configure_sampler flipping record_rejected,
+    #: pyabc/distance/distance.py:210-224)
+    requires_all_sum_stats: bool = False
+
+    def __init__(self):
+        self.spec: Optional[SumStatSpec] = None
+
+    # ---- lifecycle (host) ------------------------------------------------
+
+    def bind(self, spec: SumStatSpec, x_0: Optional[Mapping[str, Array]] = None):
+        """Bind the sum-stat layout (and observed data) BEFORE any sampling.
+
+        TPU addition to the reference lifecycle: the calibration sample is
+        itself drawn by a compiled round that calls :meth:`compute`, so the
+        structural setup (weight-vector expansion, kernel covariances) must
+        happen before the first data-dependent ``initialize``.
+        """
+        self.spec = spec
+        self._on_bind(x_0)
+
+    def _on_bind(self, x_0):
+        pass
+
+    def initialize(self, t: int, get_sample_stats: Optional[Callable],
+                   x_0: Mapping[str, Array], spec: SumStatSpec):
+        """Calibrate from an initial sample.
+
+        ``get_sample_stats()`` lazily returns a batched dict
+        ``{key: [N, ...]}`` of calibration-sample statistics (mirrors the
+        reference's lazy ``get_all_sum_stats``, distance/base.py:45-77).
+        """
+        if self.spec is None or spec is not self.spec:
+            self.bind(spec, x_0)
+
+    def configure_sampler(self, sampler):
+        """Request sampler features (reference: distance/base.py:79-97)."""
+        if self.requires_all_sum_stats:
+            sampler.record_rejected = True
+
+    def update(self, t: int, get_all_stats: Optional[Callable] = None) -> bool:
+        """Per-generation adaptation; return True iff params changed."""
+        return False
+
+    # ---- dynamic params + pure compute ----------------------------------
+
+    def get_params(self, t: int):
+        """Dynamic parameter pytree consumed by :meth:`compute`."""
+        return ()
+
+    def compute(self, stats: Array, obs: Array, params) -> Array:
+        """Pure batched distance: ``[N,S] x [S] -> [N]`` (jit-safe)."""
+        raise NotImplementedError
+
+    # ---- eager convenience (reference __call__ parity) -------------------
+
+    def __call__(self, x: Mapping[str, Array], x_0: Mapping[str, Array],
+                 t: int = 0, par=None) -> Array:
+        if self.spec is None:
+            self.bind(SumStatSpec.from_example(x_0), x_0)
+        x = {k: jnp.asarray(v) for k, v in x.items()}
+        batched = any(
+            jnp.ndim(v) > len(self.spec.shapes[k]) for k, v in x.items()
+        )
+        if batched:
+            stats = self.spec.flatten(x)
+        else:
+            stats = self.spec.flatten_single(x)[None, :]
+        obs = self.spec.flatten_single(x_0)
+        d = self.compute(stats, obs, self.get_params(t))
+        return d if batched else d[0]
+
+    def get_config(self) -> dict:
+        return {"name": type(self).__name__}
+
+    def to_json(self) -> str:
+        import json
+        return json.dumps(self.get_config())
+
+
+class NoDistance(Distance):
+    """Always ``nan`` — placeholder (reference: distance/base.py:158-177)."""
+
+    def compute(self, stats, obs, params):
+        return jnp.full(stats.shape[0], jnp.nan)
+
+
+class AcceptAllDistance(Distance):
+    """Always ``-1`` so any epsilon accepts (reference: base.py:216-233)."""
+
+    def compute(self, stats, obs, params):
+        return -jnp.ones(stats.shape[0])
+
+
+class IdentityFakeDistance(Distance):
+    """Passes the (single-component) statistic through as the distance
+    (reference: distance/base.py:184-214, used when the model returns a
+    distance directly)."""
+
+    def compute(self, stats, obs, params):
+        return stats[:, 0]
+
+
+class SimpleFunctionDistance(Distance):
+    """Wrap a user function ``fn(x_dict, x0_dict) -> f32[N]``.
+
+    Parity: reference distance/base.py:236-269.  ``fn`` must be batched and
+    jit-safe (takes dicts of ``[N, ...]`` arrays).
+    """
+
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self.fn = fn
+
+    def compute(self, stats, obs, params):
+        x = self.spec.unflatten(stats)
+        x0 = self.spec.unflatten(obs)
+        return self.fn(x, x0)
+
+    def get_config(self):
+        return {"name": getattr(self.fn, "__name__", type(self).__name__)}
+
+
+def to_distance(maybe_distance) -> Optional[Distance]:
+    """Coerce None/callable/Distance (reference: distance/base.py:272-295)."""
+    if maybe_distance is None:
+        return NoDistance()
+    if isinstance(maybe_distance, Distance):
+        return maybe_distance
+    return SimpleFunctionDistance(maybe_distance)

@@ -1,0 +1,363 @@
+"""Concrete distances: p-norms, adaptive weighting, aggregation, whitening.
+
+Parity map to pyabc/distance/distance.py:
+- ``PNormDistance``            <- :17-136  (weighted p-norm, factors)
+- ``AdaptivePNormDistance``    <- :139-363 (per-generation inverse-scale
+                                  weights from ALL — incl. rejected — stats)
+- ``AggregatedDistance``       <- :366-511
+- ``AdaptiveAggregatedDistance``<- :514-631
+- ``ZScoreDistance``           <- :634-670
+- ``PCADistance``              <- :673-729 (whitening)
+- ``RangeEstimatorDistance``   <- :732-809
+- ``MinMaxDistance``           <- :812-836
+- ``PercentileDistance``       <- :839-873
+
+TPU design: distances are pure kernels over the dense ``[N, S]`` sum-stat
+block; adaptive weights are host numpy state passed in as traced params so
+the compiled sampling round never recompiles across generations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..sumstat import SumStatSpec
+from .base import Distance, to_distance
+from .scale import SCALE_FUNCTIONS, median_absolute_deviation, standard_deviation
+
+Array = jnp.ndarray
+
+
+class PNormDistance(Distance):
+    """Weighted p-norm over sum-stat components.
+
+    ``d(x, x0) = (Σ_s |f_s · w_s · (x_s - x0_s)|^p)^(1/p)``, ``p = inf`` ->
+    max-norm.  Reference kernel math: distance/distance.py:92-103; weights
+    may be time-indexed dicts ``{t: {key: w}}`` (distance/distance.py:60-78).
+    """
+
+    def __init__(self, p: float = 2.0,
+                 weights: Optional[Mapping] = None,
+                 factors: Optional[Mapping] = None):
+        super().__init__()
+        if p < 1:
+            raise ValueError("p must be >= 1")
+        self.p = float(p)
+        # {t -> {key -> w}} or {key -> w}; resolved to per-component vectors
+        # lazily once the spec is known.
+        self._weights_in = weights
+        self._factors_in = factors
+        self.weights: Dict[int, np.ndarray] = {}
+        self.factors: Optional[np.ndarray] = None
+
+    # -- host side --------------------------------------------------------
+
+    def _timed(self, maybe_timed) -> Dict[int, Mapping]:
+        if maybe_timed is None:
+            return {}
+        first = next(iter(maybe_timed.values()), None)
+        if isinstance(first, Mapping):
+            return dict(maybe_timed)
+        return {0: maybe_timed}
+
+    def _on_bind(self, x_0):
+        for tt, per_key in self._timed(self._weights_in).items():
+            self.weights[tt] = self.spec.expand_key_values(per_key)
+        factors = self._timed(self._factors_in)
+        if factors:
+            self.factors = self.spec.expand_key_values(factors[min(factors)])
+
+    def _weights_for(self, t: int) -> np.ndarray:
+        if not self.weights:
+            return np.ones(self.spec.total_size, dtype=np.float32)
+        # reference: use the latest generation <= t (distance.py:118-126)
+        ts = [tt for tt in self.weights if tt <= t]
+        tt = max(ts) if ts else min(self.weights)
+        return self.weights[tt]
+
+    def get_params(self, t: int):
+        w = self._weights_for(t)
+        f = self.factors if self.factors is not None else np.ones_like(w)
+        return {"w": jnp.asarray(w * f)}
+
+    # -- pure kernel ------------------------------------------------------
+
+    def compute(self, stats: Array, obs: Array, params) -> Array:
+        diff = jnp.abs(params["w"] * (stats - obs))
+        if np.isinf(self.p):
+            return jnp.max(diff, axis=-1)
+        return jnp.sum(diff**self.p, axis=-1) ** (1.0 / self.p)
+
+    def get_config(self):
+        return {"name": type(self).__name__, "p": self.p}
+
+
+class AdaptivePNormDistance(PNormDistance):
+    """p-norm with per-generation inverse-scale weights.
+
+    Each generation the weights are refit as ``w_s = 1 / scale_s`` from the
+    sum-stats of ALL particles (accepted and rejected) of the previous
+    generation — which is why it requests rejected recording via
+    ``configure_sampler`` (reference: distance/distance.py:210-224).
+    """
+
+    requires_all_sum_stats = True
+
+    def __init__(self, p: float = 2.0,
+                 factors: Optional[Mapping] = None,
+                 adaptive: bool = True,
+                 scale_function: Union[str, Callable] = median_absolute_deviation,
+                 normalize_weights: bool = True,
+                 max_weight_ratio: Optional[float] = None):
+        super().__init__(p=p, weights=None, factors=factors)
+        self.adaptive = adaptive
+        if isinstance(scale_function, str):
+            scale_function = SCALE_FUNCTIONS[scale_function]
+        self.scale_function = scale_function
+        self.normalize_weights = normalize_weights
+        self.max_weight_ratio = max_weight_ratio
+        self._x0_flat: Optional[np.ndarray] = None
+
+    def _on_bind(self, x_0):
+        PNormDistance._on_bind(self, x_0)
+        if x_0 is not None:
+            self._x0_flat = np.asarray(self.spec.flatten_single(x_0))
+
+    def initialize(self, t, get_sample_stats, x_0, spec):
+        Distance.initialize(self, t, get_sample_stats, x_0, spec)
+        if get_sample_stats is not None:
+            self._fit(t, spec.flatten(get_sample_stats()))
+
+    def update(self, t, get_all_stats=None) -> bool:
+        if not self.adaptive or get_all_stats is None:
+            return False
+        self._fit(t, self.spec.flatten(get_all_stats()))
+        return True
+
+    def _fit(self, t: int, data: Array):
+        """Refit weights on-device, store host-side (distance.py:268-330)."""
+        scale = np.asarray(self.scale_function(data, jnp.asarray(self._x0_flat)))
+        with np.errstate(divide="ignore"):
+            w = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-30), 0.0)
+        if self.max_weight_ratio is not None:
+            pos = w[w > 0]
+            if pos.size:
+                w = np.minimum(w, pos.min() * self.max_weight_ratio)
+        if self.normalize_weights and w.sum() > 0:
+            w = w * w.size / w.sum()
+        self.weights[t] = w.astype(np.float32)
+
+    def get_config(self):
+        return {
+            "name": type(self).__name__, "p": self.p,
+            "scale_function": getattr(self.scale_function, "__name__", "custom"),
+            "max_weight_ratio": self.max_weight_ratio,
+        }
+
+
+class AggregatedDistance(Distance):
+    """Weighted sum of sub-distances (reference distance.py:366-511).
+
+    ``d = Σ_j factor_j · w_j · d_j(x, x0)``.
+    """
+
+    def __init__(self, distances: Sequence, weights=None, factors=None):
+        super().__init__()
+        self.distances: List[Distance] = [to_distance(d) for d in distances]
+        self.weights: Dict[int, np.ndarray] = {}
+        if weights is not None:
+            self.weights[0] = np.asarray(weights, dtype=np.float32)
+        self.factors = (np.asarray(factors, dtype=np.float32)
+                        if factors is not None
+                        else np.ones(len(self.distances), dtype=np.float32))
+
+    def bind(self, spec, x_0=None):
+        super().bind(spec, x_0)
+        for d in self.distances:
+            d.bind(spec, x_0)
+
+    def initialize(self, t, get_sample_stats, x_0, spec):
+        super().initialize(t, get_sample_stats, x_0, spec)
+        for d in self.distances:
+            d.initialize(t, get_sample_stats, x_0, spec)
+
+    def configure_sampler(self, sampler):
+        super().configure_sampler(sampler)
+        for d in self.distances:
+            d.configure_sampler(sampler)
+
+    def update(self, t, get_all_stats=None) -> bool:
+        changed = False
+        for d in self.distances:
+            changed |= d.update(t, get_all_stats)
+        return changed
+
+    def _weights_for(self, t: int) -> np.ndarray:
+        if not self.weights:
+            return np.ones(len(self.distances), dtype=np.float32)
+        ts = [tt for tt in self.weights if tt <= t]
+        tt = max(ts) if ts else min(self.weights)
+        return self.weights[tt]
+
+    def get_params(self, t: int):
+        return {
+            "w": jnp.asarray(self._weights_for(t) * self.factors),
+            "sub": tuple(d.get_params(t) for d in self.distances),
+        }
+
+    def compute(self, stats, obs, params) -> Array:
+        vals = jnp.stack(
+            [d.compute(stats, obs, p) for d, p in zip(self.distances, params["sub"])],
+            axis=-1,
+        )
+        return jnp.sum(vals * params["w"], axis=-1)
+
+    def get_config(self):
+        return {"name": type(self).__name__,
+                "distances": [d.get_config() for d in self.distances]}
+
+
+class AdaptiveAggregatedDistance(AggregatedDistance):
+    """Aggregated distance with per-generation adaptive sub-distance weights
+    (reference distance.py:514-631): each generation, sub-distance values are
+    computed over the previous population and weights set to inverse scale."""
+
+    requires_all_sum_stats = True
+
+    def __init__(self, distances, scale_function: Optional[Callable] = None,
+                 adaptive: bool = True):
+        super().__init__(distances)
+        from .scale import span
+        self.scale_function = scale_function or span
+        self.adaptive = adaptive
+
+    def _on_bind(self, x_0):
+        if x_0 is not None:
+            self._x0_flat = self.spec.flatten_single(x_0)
+
+    def initialize(self, t, get_sample_stats, x_0, spec):
+        super().initialize(t, get_sample_stats, x_0, spec)
+        if get_sample_stats is not None:
+            self._fit(t, spec.flatten(get_sample_stats()))
+
+    def update(self, t, get_all_stats=None) -> bool:
+        changed = super().update(t, get_all_stats)
+        if self.adaptive and get_all_stats is not None:
+            self._fit(t, self.spec.flatten(get_all_stats()))
+            changed = True
+        return changed
+
+    def _fit(self, t: int, data: Array):
+        obs = self._x0_flat
+        vals = jnp.stack(
+            [d.compute(data, obs, d.get_params(t)) for d in self.distances],
+            axis=-1,
+        )  # [N, n_dist]
+        scale = np.asarray(self.scale_function(vals, None))
+        with np.errstate(divide="ignore"):
+            w = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-30), 1.0)
+        self.weights[t] = w.astype(np.float32)
+
+
+class ZScoreDistance(Distance):
+    """Relative error: Σ |(x - x0) / x0| (reference distance.py:634-670)."""
+
+    def compute(self, stats, obs, params) -> Array:
+        denom = jnp.where(jnp.abs(obs) > 0, jnp.abs(obs), 1.0)
+        rel = jnp.where(jnp.abs(obs) > 0,
+                        jnp.abs((stats - obs) / denom),
+                        jnp.where(jnp.abs(stats) > 0, jnp.inf, 0.0))
+        return jnp.sum(rel, axis=-1)
+
+
+class PCADistance(Distance):
+    """Whitened euclidean distance (reference distance.py:673-729).
+
+    Calibrates a whitening transform ``W = Λ^(-1/2) Vᵀ`` from the initial
+    sample covariance; ``d = ||W (x - x0)||₂``.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._trafo: Optional[np.ndarray] = None
+
+    def _on_bind(self, x_0):
+        # neutral whitening until the calibration sample arrives
+        self._trafo = np.eye(self.spec.total_size, dtype=np.float32)
+
+    def initialize(self, t, get_sample_stats, x_0, spec):
+        super().initialize(t, get_sample_stats, x_0, spec)
+        if get_sample_stats is None:
+            return
+        data = np.asarray(spec.flatten(get_sample_stats()))
+        cov = np.cov(data, rowvar=False)
+        cov = np.atleast_2d(cov) + 1e-8 * np.eye(data.shape[1])
+        evals, evecs = np.linalg.eigh(cov)
+        evals = np.maximum(evals, 1e-12)
+        self._trafo = (evecs / np.sqrt(evals)).T.astype(np.float32)
+
+    def get_params(self, t):
+        return {"W": jnp.asarray(self._trafo)}
+
+    def compute(self, stats, obs, params) -> Array:
+        z = jnp.matmul(stats - obs, params["W"].T,
+                       precision=jax.lax.Precision.HIGHEST)
+        return jnp.sqrt(jnp.sum(z**2, axis=-1))
+
+
+class RangeEstimatorDistance(PNormDistance):
+    """p-norm normalized by a calibrated per-component range
+    (reference distance.py:732-809): the range's inverse IS the p-norm
+    weight vector, so the kernel is inherited from :class:`PNormDistance`.
+    Subclasses define ``lower``/``upper`` over the calibration sample."""
+
+    def __init__(self, p: float = 2.0):
+        super().__init__(p=p)
+        self._inv_range: Optional[np.ndarray] = None
+
+    @staticmethod
+    def lower(data: np.ndarray) -> np.ndarray:
+        return np.min(data, axis=0)
+
+    @staticmethod
+    def upper(data: np.ndarray) -> np.ndarray:
+        return np.max(data, axis=0)
+
+    def _on_bind(self, x_0):
+        super()._on_bind(x_0)
+        self._inv_range = np.ones(self.spec.total_size, dtype=np.float32)
+
+    def initialize(self, t, get_sample_stats, x_0, spec):
+        super().initialize(t, get_sample_stats, x_0, spec)
+        if get_sample_stats is None:
+            return
+        data = np.asarray(spec.flatten(get_sample_stats()))
+        rng = self.upper(data) - self.lower(data)
+        with np.errstate(divide="ignore"):
+            self._inv_range = np.where(rng > 0, 1.0 / np.maximum(rng, 1e-30),
+                                       0.0).astype(np.float32)
+
+    def get_params(self, t):
+        return {"w": jnp.asarray(self._inv_range)}
+
+
+class MinMaxDistance(RangeEstimatorDistance):
+    """Range = max - min (reference distance.py:812-836)."""
+
+
+class PercentileDistance(RangeEstimatorDistance):
+    """Range between percentiles (reference distance.py:839-873)."""
+
+    PERCENTILE = 10
+
+    @classmethod
+    def lower(cls, data):
+        return np.percentile(data, cls.PERCENTILE, axis=0)
+
+    @classmethod
+    def upper(cls, data):
+        return np.percentile(data, 100 - cls.PERCENTILE, axis=0)

@@ -1,0 +1,93 @@
+"""Scale functions for adaptive distance weighting — batched jnp versions.
+
+Parity with pyabc/distance/scale.py:38-156: each function maps the
+population's sum-stat block ``data[N, S]`` (plus the observed ``x_0[S]``) to
+a per-component scale ``[S]``.  The adaptive distance sets weights to the
+inverse scales (pyabc/distance/distance.py:139-363).
+
+Everything runs on-device over the dense block — the reference loops keys in
+Python; here a single reduction handles all components at once.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def standard_deviation(data: Array, x_0: Array = None) -> Array:
+    """std over the sample (reference scale.py:47)."""
+    return jnp.std(data, axis=0)
+
+
+def mean(data: Array, x_0: Array = None) -> Array:
+    return jnp.mean(jnp.abs(data), axis=0)
+
+
+def median(data: Array, x_0: Array = None) -> Array:
+    return jnp.median(jnp.abs(data), axis=0)
+
+
+def span(data: Array, x_0: Array = None) -> Array:
+    return jnp.max(data, axis=0) - jnp.min(data, axis=0)
+
+
+def mean_absolute_deviation(data: Array, x_0: Array = None) -> Array:
+    """mean |x - mean(x)| (reference scale.py:56)."""
+    return jnp.mean(jnp.abs(data - jnp.mean(data, axis=0)), axis=0)
+
+
+def median_absolute_deviation(data: Array, x_0: Array = None) -> Array:
+    """median |x - median(x)| (reference scale.py:38)."""
+    return jnp.median(jnp.abs(data - jnp.median(data, axis=0)), axis=0)
+
+
+def bias(data: Array, x_0: Array) -> Array:
+    """|mean(x) - x_0| (reference scale.py:65)."""
+    return jnp.abs(jnp.mean(data, axis=0) - x_0)
+
+
+def root_mean_square_deviation(data: Array, x_0: Array) -> Array:
+    """sqrt(bias² + std²) = rms deviation from x_0 (reference scale.py:74)."""
+    return jnp.sqrt(bias(data, x_0) ** 2 + standard_deviation(data) ** 2)
+
+
+def standard_deviation_to_observation(data: Array, x_0: Array) -> Array:
+    """std of (x - x_0) deviations (reference scale.py:85)."""
+    return jnp.sqrt(jnp.mean((data - x_0) ** 2, axis=0))
+
+
+def mean_absolute_deviation_to_observation(data: Array, x_0: Array) -> Array:
+    """mean |x - x_0| (reference scale.py:96)."""
+    return jnp.mean(jnp.abs(data - x_0), axis=0)
+
+
+def median_absolute_deviation_to_observation(data: Array, x_0: Array) -> Array:
+    """median |x - x_0| (reference scale.py:107)."""
+    return jnp.median(jnp.abs(data - x_0), axis=0)
+
+
+def combined_mean_absolute_deviation(data: Array, x_0: Array) -> Array:
+    """mad + bias (reference scale.py:118)."""
+    return mean_absolute_deviation(data) + bias(data, x_0)
+
+
+def combined_median_absolute_deviation(data: Array, x_0: Array) -> Array:
+    """median-ad + bias (reference scale.py:131)."""
+    return median_absolute_deviation(data) + bias(data, x_0)
+
+
+SCALE_FUNCTIONS = {
+    fn.__name__: fn
+    for fn in [
+        standard_deviation, mean, median, span,
+        mean_absolute_deviation, median_absolute_deviation,
+        bias, root_mean_square_deviation,
+        standard_deviation_to_observation,
+        mean_absolute_deviation_to_observation,
+        median_absolute_deviation_to_observation,
+        combined_mean_absolute_deviation,
+        combined_median_absolute_deviation,
+    ]
+}

@@ -1,0 +1,24 @@
+"""Acceptance thresholds and temperature schedules (parity: pyabc/epsilon/)."""
+
+from .base import Epsilon, NoEpsilon
+from .epsilon import ConstantEpsilon, ListEpsilon, MedianEpsilon, QuantileEpsilon
+from .temperature import (
+    AcceptanceRateScheme,
+    DalyScheme,
+    EssScheme,
+    ExpDecayFixedIterScheme,
+    ExpDecayFixedRatioScheme,
+    FrielPettittScheme,
+    ListTemperature,
+    PolynomialDecayFixedIterScheme,
+    Temperature,
+    TemperatureBase,
+)
+
+__all__ = [
+    "Epsilon", "NoEpsilon", "ConstantEpsilon", "ListEpsilon",
+    "QuantileEpsilon", "MedianEpsilon", "TemperatureBase", "ListTemperature",
+    "Temperature", "AcceptanceRateScheme", "ExpDecayFixedIterScheme",
+    "ExpDecayFixedRatioScheme", "PolynomialDecayFixedIterScheme",
+    "DalyScheme", "FrielPettittScheme", "EssScheme",
+]

@@ -1,0 +1,57 @@
+"""Epsilon base contract (parity: pyabc/epsilon/base.py:10-167).
+
+Epsilons are pure control-plane: they run once per generation on the host
+(numpy/scipy fine) and emit a single scalar that enters the compiled
+sampling round as a traced argument — so adapting ε never triggers an XLA
+recompile.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class Epsilon:
+    """Acceptance-threshold schedule.
+
+    Lifecycle mirrors the reference: ``initialize(t, ...)`` with calibration
+    distances, ``configure_sampler``, per-generation ``update(t, ...)``,
+    ``__call__(t) -> float``.
+    """
+
+    def initialize(self, t: int,
+                   get_weighted_distances: Optional[Callable] = None,
+                   get_all_records: Optional[Callable] = None,
+                   max_nr_populations: Optional[int] = None,
+                   acceptor_config: Optional[dict] = None):
+        pass
+
+    def configure_sampler(self, sampler):
+        pass
+
+    def update(self, t: int,
+               get_weighted_distances: Optional[Callable] = None,
+               get_all_records: Optional[Callable] = None,
+               acceptance_rate: Optional[float] = None,
+               acceptor_config: Optional[dict] = None):
+        pass
+
+    def __call__(self, t: int) -> float:
+        raise NotImplementedError
+
+    def requires_calibration(self) -> bool:
+        return False
+
+    def get_config(self) -> dict:
+        return {"name": type(self).__name__}
+
+    def to_json(self) -> str:
+        import json
+        return json.dumps(self.get_config())
+
+
+class NoEpsilon(Epsilon):
+    """No threshold — acceptance decided elsewhere (reference base.py:148-167)."""
+
+    def __call__(self, t: int) -> float:
+        return float("nan")

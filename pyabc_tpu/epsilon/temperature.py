@@ -1,0 +1,335 @@
+"""Temperature schedules for exact stochastic acceptance.
+
+Parity with pyabc/epsilon/temperature.py: a ``TemperatureBase`` epsilon does
+not threshold distances — it anneals an acceptance *temperature* T down to 1
+(= exact likelihood acceptance).  A :class:`Temperature` aggregates several
+proposal ``schemes`` and takes the minimum (temperature.py:16-207), always
+enforcing T = 1.0 in the final generation.
+
+Schemes (reference temperature.py:258-733) are pure host-side functions of
+per-generation summaries; the chosen scalar T feeds the compiled acceptance
+kernel as a traced argument.
+
+Scheme call signature (reference :210-255)::
+
+    scheme(t=..., get_weighted_distances=..., get_all_records=...,
+           max_nr_populations=..., pdf_norm=..., kernel_scale=...,
+           prev_temperature=..., acceptance_rate=...) -> float
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+from scipy import optimize as sp_optimize
+
+from ..distance.kernel import SCALE_LIN, SCALE_LOG
+from .base import Epsilon
+
+
+class TemperatureBase(Epsilon):
+    """Marker base: ``__call__(t)`` returns a temperature, not a threshold."""
+
+
+class ListTemperature(TemperatureBase):
+    """Pre-defined temperatures per generation (reference :164-186)."""
+
+    def __init__(self, values: List[float]):
+        self.values = [float(v) for v in values]
+
+    def __call__(self, t: int) -> float:
+        return self.values[t]
+
+
+class Temperature(TemperatureBase):
+    """Adaptive temperature: min over scheme proposals, final T = 1
+    (reference temperature.py:16-161)."""
+
+    def __init__(self, schemes: Optional[List[Callable]] = None,
+                 aggregate_fun: Callable = min,
+                 initial_temperature: Optional[float] = None,
+                 enforce_exact_final_temperature: bool = True,
+                 log_file: Optional[str] = None):
+        if schemes is None:
+            schemes = [AcceptanceRateScheme(), ExpDecayFixedIterScheme()]
+        self.schemes = schemes
+        self.aggregate_fun = aggregate_fun
+        self.initial_temperature = initial_temperature
+        self.enforce_exact_final_temperature = enforce_exact_final_temperature
+        self.log_file = log_file
+        self.temperatures: dict = {}
+        self.temperature_proposals: dict = {}
+        self._max_nr_populations: Optional[int] = None
+
+    def requires_calibration(self) -> bool:
+        return self.initial_temperature is None
+
+    def configure_sampler(self, sampler):
+        for scheme in self.schemes:
+            if getattr(scheme, "requires_all_records", False):
+                sampler.record_rejected = True
+
+    def initialize(self, t, get_weighted_distances=None, get_all_records=None,
+                   max_nr_populations=None, acceptor_config=None):
+        self._max_nr_populations = max_nr_populations
+        self._update(t, get_weighted_distances, get_all_records,
+                     acceptance_rate=1.0, acceptor_config=acceptor_config or {})
+
+    def update(self, t, get_weighted_distances=None, get_all_records=None,
+               acceptance_rate=None, acceptor_config=None):
+        self._update(t, get_weighted_distances, get_all_records,
+                     acceptance_rate, acceptor_config or {})
+
+    def _update(self, t, get_weighted_distances, get_all_records,
+                acceptance_rate, acceptor_config):
+        nr_pop = self._max_nr_populations
+        prev_t = self.temperatures.get(t - 1)
+        if (nr_pop is not None and t >= nr_pop - 1
+                and self.enforce_exact_final_temperature):
+            temp = 1.0
+            self.temperature_proposals[t] = {"final": 1.0}
+        elif prev_t is not None and prev_t <= 1.0:
+            temp = 1.0
+            self.temperature_proposals[t] = {"clamped": 1.0}
+        else:
+            if prev_t is None and self.initial_temperature is not None:
+                temp = float(self.initial_temperature)
+                self.temperature_proposals[t] = {
+                    "initial_temperature": temp}
+            else:
+                proposals = {}
+                for scheme in self.schemes:
+                    try:
+                        val = scheme(
+                            t=t,
+                            get_weighted_distances=get_weighted_distances,
+                            get_all_records=get_all_records,
+                            max_nr_populations=nr_pop,
+                            pdf_norm=acceptor_config.get("pdf_norm", 0.0),
+                            kernel_scale=acceptor_config.get(
+                                "kernel_scale", SCALE_LOG),
+                            prev_temperature=prev_t,
+                            acceptance_rate=acceptance_rate,
+                        )
+                    except Exception:
+                        val = np.inf
+                    if val is not None and np.isfinite(val):
+                        proposals[type(scheme).__name__] = float(val)
+                self.temperature_proposals[t] = proposals
+                if proposals:
+                    temp = float(self.aggregate_fun(proposals.values()))
+                else:
+                    temp = prev_t if prev_t is not None else np.inf
+            # monotone annealing: never exceed the previous temperature
+            # (reference temperature.py:141-149 fallback clamp)
+            if prev_t is not None:
+                temp = min(temp, prev_t)
+            temp = max(temp, 1.0)
+        self.temperatures[t] = temp
+        if self.log_file:
+            from ..storage.json import save_dict_to_json
+            save_dict_to_json(self.temperature_proposals, self.log_file)
+
+    def __call__(self, t: int) -> float:
+        return self.temperatures[t]
+
+    def get_config(self):
+        return {"name": type(self).__name__,
+                "schemes": [type(s).__name__ for s in self.schemes]}
+
+
+# ---------------------------------------------------------------------------
+# Schemes
+# ---------------------------------------------------------------------------
+
+
+def _records_to_arrays(get_all_records, kernel_scale):
+    """Extract (log-density values, importance weights) from records.
+
+    Records (reference smc.py:726-737 via sampler records) are dicts with
+    keys ``distance`` (kernel value), ``transition_pd_prev``,
+    ``transition_pd`` and ``accepted``.
+    """
+    records = get_all_records()
+    logdens = np.asarray([r["distance"] for r in records], dtype=np.float64)
+    if kernel_scale == SCALE_LIN:
+        with np.errstate(divide="ignore"):
+            logdens = np.log(np.maximum(logdens, 1e-290))
+    pd_prev = np.asarray(
+        [r.get("transition_pd_prev", 1.0) for r in records], dtype=np.float64)
+    pd = np.asarray(
+        [r.get("transition_pd", 1.0) for r in records], dtype=np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        w = np.where(pd_prev > 0, pd / pd_prev, 0.0)
+    if w.sum() <= 0:
+        w = np.ones_like(w)
+    return logdens, w / w.sum()
+
+
+class AcceptanceRateScheme:
+    """Solve T so the expected acceptance rate hits ``target_rate``
+    (reference temperature.py:258-364, bisection on the importance-weighted
+    mean of min(1, exp((logdens - c)/T)))."""
+
+    requires_all_records = True
+
+    def __init__(self, target_rate: float = 0.3, min_rate: Optional[float] = None):
+        self.target_rate = float(target_rate)
+        self.min_rate = min_rate
+
+    def __call__(self, t, get_all_records=None, pdf_norm=0.0,
+                 kernel_scale=SCALE_LOG, prev_temperature=None,
+                 acceptance_rate=None, **kwargs):
+        if get_all_records is None:
+            return None
+        logdens, w = _records_to_arrays(get_all_records, kernel_scale)
+        logvals = logdens - pdf_norm
+
+        def rate(beta):  # beta = 1/T
+            return float(np.sum(w * np.exp(np.minimum(logvals * beta, 0.0))))
+
+        # rate(0) = 1 (T=inf); rate decreases with beta
+        if rate(1.0) >= self.target_rate:
+            return 1.0
+        sol = sp_optimize.bisect(
+            lambda b: rate(b) - self.target_rate, 1e-8, 1.0,
+            xtol=1e-6, maxiter=100)
+        return 1.0 / max(sol, 1e-8)
+
+
+class ExpDecayFixedIterScheme:
+    """Geometric decay to T = 1 over the remaining generations
+    (reference temperature.py:367-431): T_t = T_prev^((n_to_go - 1)/n_to_go).
+    """
+
+    def __call__(self, t, max_nr_populations=None, prev_temperature=None,
+                 **kwargs):
+        if prev_temperature is None or max_nr_populations is None:
+            return None
+        if not np.isfinite(max_nr_populations):
+            return None
+        t_to_go = max(max_nr_populations - 1 - t + 1, 1)
+        return float(prev_temperature ** ((t_to_go - 1) / t_to_go))
+
+
+class ExpDecayFixedRatioScheme:
+    """T_t = alpha · T_prev, clamped ≥ 1 (reference temperature.py:434-500).
+
+    Includes the reference's rate guards: decay slows when acceptance gets
+    too low (min_rate) and accelerates above max_rate.
+    """
+
+    def __init__(self, alpha: float = 0.5, min_rate: float = 1e-4,
+                 max_rate: float = 0.5):
+        self.alpha = float(alpha)
+        self.min_rate = min_rate
+        self.max_rate = max_rate
+        self.alphas: dict = {}
+
+    def __call__(self, t, prev_temperature=None, acceptance_rate=None,
+                 **kwargs):
+        if prev_temperature is None:
+            return None
+        alpha = self.alphas.get(t - 1, self.alpha)
+        if acceptance_rate is not None:
+            if acceptance_rate < self.min_rate:
+                alpha = min(np.sqrt(alpha), 0.95)
+            elif acceptance_rate > self.max_rate:
+                alpha = max(alpha**2, 1e-3)
+        self.alphas[t] = alpha
+        return float(max(alpha * prev_temperature, 1.0))
+
+
+class PolynomialDecayFixedIterScheme:
+    """Polynomial decay to 1 over remaining generations
+    (reference temperature.py:503-564): T = 1 + (T_prev - 1)·x^exponent with
+    x = (n_to_go - 1)/n_to_go."""
+
+    def __init__(self, exponent: float = 3.0):
+        self.exponent = float(exponent)
+
+    def __call__(self, t, max_nr_populations=None, prev_temperature=None,
+                 **kwargs):
+        if prev_temperature is None or max_nr_populations is None:
+            return None
+        if not np.isfinite(max_nr_populations):
+            return None
+        t_to_go = max(max_nr_populations - 1 - t + 1, 1)
+        x = (t_to_go - 1) / t_to_go
+        return float(1.0 + (prev_temperature - 1.0) * x**self.exponent)
+
+
+class DalyScheme:
+    """Daly et al. 2017 feedback scheme (reference temperature.py:567-632):
+    keep a step size k_t; shrink it multiplicatively, and halve it whenever
+    the acceptance rate drops below ``min_rate``."""
+
+    def __init__(self, alpha: float = 0.5, min_rate: float = 1e-4):
+        self.alpha = float(alpha)
+        self.min_rate = float(min_rate)
+        self.k: dict = {}
+
+    def __call__(self, t, prev_temperature=None, acceptance_rate=None,
+                 **kwargs):
+        if prev_temperature is None:
+            return None
+        beta = 1.0 / prev_temperature
+        k_prev = self.k.get(t - 1, prev_temperature)
+        if acceptance_rate is not None and acceptance_rate < self.min_rate:
+            k = self.alpha * k_prev
+        else:
+            k = k_prev
+        if beta < 1:
+            k = min(k, self.alpha * (1.0 / beta - 1.0) + 1e-12)
+        self.k[t] = k
+        return float(max(prev_temperature - k, 1.0))
+
+
+class FrielPettittScheme:
+    """Power-posterior schedule β_t = ((t+1)/n)² (reference :635-673)."""
+
+    def __call__(self, t, max_nr_populations=None, prev_temperature=None,
+                 **kwargs):
+        if max_nr_populations is None or not np.isfinite(max_nr_populations):
+            return None
+        n = max_nr_populations
+        beta = ((t + 1) / n) ** 2
+        return float(1.0 / max(beta, 1e-8))
+
+
+class EssScheme:
+    """Match a target relative ESS (reference temperature.py:676-733):
+    find β ∈ [β_prev, 1] s.t. ESS(w_i · exp(Δβ · logdens_i)) = target · N."""
+
+    requires_all_records = False
+
+    def __init__(self, target_relative_ess: float = 0.8):
+        self.target_relative_ess = float(target_relative_ess)
+
+    def __call__(self, t, get_weighted_distances=None, pdf_norm=0.0,
+                 kernel_scale=SCALE_LOG, prev_temperature=None, **kwargs):
+        if get_weighted_distances is None:
+            return None
+        values, weights = get_weighted_distances()
+        logdens = np.asarray(values, dtype=np.float64)
+        if kernel_scale == SCALE_LIN:
+            with np.errstate(divide="ignore"):
+                logdens = np.log(np.maximum(logdens, 1e-290))
+        w = np.asarray(weights, dtype=np.float64)
+        w = w / w.sum()
+        beta_prev = 0.0 if prev_temperature is None else 1.0 / prev_temperature
+        target = self.target_relative_ess * len(w)
+
+        def ess(beta):
+            lw = np.log(np.maximum(w, 1e-290)) + (beta - beta_prev) * logdens
+            lw -= lw.max()
+            ww = np.exp(lw)
+            return np.sum(ww) ** 2 / np.sum(ww**2)
+
+        if ess(1.0) >= target:
+            return 1.0
+        sol = sp_optimize.bisect(
+            lambda b: ess(b) - target, beta_prev + 1e-8, 1.0,
+            xtol=1e-6, maxiter=100)
+        return float(1.0 / max(sol, 1e-8))

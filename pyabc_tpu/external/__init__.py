@@ -1,0 +1,12 @@
+"""External / black-box simulator bridges (parity: pyabc/external/)."""
+
+from .base import (
+    ExternalHandler,
+    ExternalModel,
+    HostFunctionModel,
+    R,
+    create_sum_stat,
+)
+
+__all__ = ["ExternalHandler", "ExternalModel", "HostFunctionModel", "R",
+           "create_sum_stat"]

@@ -1,0 +1,125 @@
+"""Models: batched stochastic forward simulators.
+
+Parity: pyabc/model.py (328 LoC).  The reference's template method runs one
+particle at a time: ``sample`` -> ``summary_statistics`` -> ``distance`` ->
+``accept`` (model.py:163-218).  Here a model is a *batched pure function*
+
+    simulate(key, theta[N, D]) -> {stat_name: Array[N, ...]}
+
+traced once into the per-generation sampling round; distance + acceptance
+are applied by the sampler over the whole batch (the template-method
+composition happens in ``sampler/rounds.py``).  ``vmap`` lifts per-particle
+definitions to batches automatically.
+
+- ``Model``          <- pyabc/model.py:60-218 (subclass ``sample`` +
+                        optional ``summary_statistics``)
+- ``SimpleModel``    <- pyabc/model.py:221-270 (wrap a plain function)
+- ``IntegratedModel``<- pyabc/model.py:273-328: fused simulate+accept for
+                        early rejection.  On TPU early termination becomes
+                        masking: ``integrated_simulate`` may return an
+                        ``early_reject[N]`` mask which the sampler ORs into
+                        rejection (flops are burned either way — SURVEY.md §7
+                        "per-particle early termination").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+class ModelResult:
+    """Reference-compat container (pyabc/model.py:21-57)."""
+
+    def __init__(self, sum_stats=None, distance=None, accepted=None,
+                 weight=None, early_reject=None):
+        self.sum_stats = sum_stats
+        self.distance = distance
+        self.accepted = accepted
+        self.weight = weight
+        self.early_reject = early_reject
+
+
+class Model:
+    """A stochastic forward model over batches of parameters."""
+
+    def __init__(self, name: str = "model"):
+        self.name = name
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+    # ---- override points -------------------------------------------------
+
+    def sample(self, key, theta: Array):
+        """Raw model output for ``theta[N, D]`` (batched, jit-safe)."""
+        raise NotImplementedError
+
+    def summary_statistics(self, raw) -> Dict[str, Array]:
+        """Reduce raw output to summary statistics (default: identity if
+        already a dict — reference model.py:114-137)."""
+        if isinstance(raw, Mapping):
+            return dict(raw)
+        return {"y": raw}
+
+    # ---- composed entry point (used by the sampler round) ----------------
+
+    def simulate(self, key, theta: Array) -> Dict[str, Array]:
+        return self.summary_statistics(self.sample(key, theta))
+
+    def accept(self, key, theta, distance_fn, eps, acceptor, x_0):
+        """Eager single-batch accept chain (reference model.py:163-218) —
+        provided for API parity and tests; production sampling uses the
+        fused round in sampler/rounds.py."""
+        k1, k2 = jax.random.split(key)
+        stats = self.simulate(k1, theta)
+        d = distance_fn(stats, x_0)
+        acc, w = acceptor.accept(k2, d, {"eps": jnp.float32(eps)})
+        return ModelResult(sum_stats=stats, distance=d, accepted=acc, weight=w)
+
+
+class SimpleModel(Model):
+    """Wrap a plain batched function ``fn(key, theta[N, D]) -> dict``.
+
+    If ``vectorized=False`` the function is treated as per-particle
+    ``fn(key, theta[D]) -> dict`` and lifted with ``vmap`` (the TPU
+    equivalent of the reference's one-call-per-particle contract,
+    model.py:221-270).
+    """
+
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 vectorized: bool = True):
+        super().__init__(name or getattr(fn, "__name__", "model"))
+        self._fn = fn
+        self._vectorized = vectorized
+
+    def sample(self, key, theta: Array):
+        if self._vectorized:
+            return self._fn(key, theta)
+        n = theta.shape[0]
+        keys = jax.random.split(key, n)
+        return jax.vmap(self._fn)(keys, theta)
+
+    @staticmethod
+    def assert_model(maybe_model) -> "Model":
+        """Coerce callables to models (reference model.py:249-270)."""
+        if isinstance(maybe_model, Model):
+            return maybe_model
+        return SimpleModel(maybe_model)
+
+
+class IntegratedModel(Model):
+    """Fused simulate + early-reject (reference model.py:273-328)."""
+
+    def integrated_simulate(self, key, theta: Array, eps: Array
+                            ) -> ModelResult:
+        """Return ModelResult with ``sum_stats`` and ``early_reject[N]``."""
+        raise NotImplementedError
+
+    def simulate(self, key, theta: Array) -> Dict[str, Array]:
+        res = self.integrated_simulate(key, theta, jnp.float32(jnp.inf))
+        return res.sum_stats

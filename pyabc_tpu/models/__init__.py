@@ -1,0 +1,20 @@
+"""Batched JAX forward models for the reference's benchmark problems.
+
+These correspond to BASELINE.json's configs (the reference's quickstart and
+example-notebook problems): Gaussian toy, two-Gaussian model selection,
+Lotka-Volterra SDE, SIR tau-leaping epidemic, and generic ODE models.
+"""
+
+from .gaussian import GaussianModel, gaussian_model, make_gaussian_problem
+from .mixture import make_two_gaussians_problem
+from .lotka_volterra import LotkaVolterraSDE, make_lotka_volterra_problem
+from .sir import SIRTauLeap, make_sir_problem
+from .ode import ODEModel
+
+__all__ = [
+    "GaussianModel", "gaussian_model", "make_gaussian_problem",
+    "make_two_gaussians_problem",
+    "LotkaVolterraSDE", "make_lotka_volterra_problem",
+    "SIRTauLeap", "make_sir_problem",
+    "ODEModel",
+]

@@ -1,0 +1,35 @@
+"""1D Gaussian toy model (BASELINE config #1; reference quickstart,
+doc/examples)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..distance import PNormDistance
+from ..model import SimpleModel
+from ..random_variables import RV, Distribution
+
+
+def gaussian_model(key, theta):
+    """y ~ N(mu, sigma²) with sigma fixed to 1; theta[:, 0] = mu."""
+    mu = theta[:, 0]
+    return {"y": mu + jax.random.normal(key, mu.shape)}
+
+
+class GaussianModel(SimpleModel):
+    def __init__(self, sigma: float = 1.0, name: str = "gaussian"):
+        self.sigma = float(sigma)
+
+        def fn(key, theta):
+            mu = theta[:, 0]
+            return {"y": mu + self.sigma * jax.random.normal(key, mu.shape)}
+
+        super().__init__(fn, name=name)
+
+
+def make_gaussian_problem(observed: float = 1.0, prior_scale: float = 1.0):
+    """(models, priors, distance, observed) bundle for quick tests/bench."""
+    model = GaussianModel()
+    prior = Distribution(mu=RV("norm", 0.0, prior_scale))
+    return [model], [prior], PNormDistance(p=2), {"y": observed}

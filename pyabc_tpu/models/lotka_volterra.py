@@ -1,0 +1,86 @@
+"""Lotka-Volterra stochastic predator-prey model (BASELINE config #3).
+
+TPU design: Euler-Maruyama SDE integration under ``lax.scan`` with the
+whole particle batch advanced per step — the time loop is sequential but
+every step is a [N, 2] vectorized update, so N=1e5+ particles integrate in
+lockstep on the MXU/VPU.  Summary statistics are reductions over the stored
+trajectory.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..distance import AdaptivePNormDistance
+from ..model import Model
+from ..random_variables import RV, Distribution
+
+Array = jnp.ndarray
+
+
+class LotkaVolterraSDE(Model):
+    """dX = (a·X − b·X·Y)dt + σ√X dW₁ ; dY = (c·b·X·Y − d·Y)dt + σ√Y dW₂.
+
+    Parameters theta = [log_a, log_b, log_c, log_d] (log scale keeps the
+    prior unbounded while rates stay positive).
+    """
+
+    def __init__(self, x0: float = 10.0, y0: float = 5.0,
+                 t_max: float = 15.0, n_steps: int = 300,
+                 sigma: float = 0.1, n_obs: int = 10,
+                 name: str = "lotka_volterra_sde"):
+        super().__init__(name)
+        self.x0, self.y0 = float(x0), float(y0)
+        self.t_max, self.n_steps = float(t_max), int(n_steps)
+        self.dt = self.t_max / self.n_steps
+        self.sigma = float(sigma)
+        self.n_obs = int(n_obs)
+        # observation indices: n_obs equally spaced time points
+        self.obs_idx = jnp.linspace(0, n_steps - 1, n_obs).astype(jnp.int32)
+
+    def sample(self, key, theta: Array) -> Dict[str, Array]:
+        n = theta.shape[0]
+        a, b, c, d = (jnp.exp(theta[:, i]) for i in range(4))
+        dt, sig = self.dt, self.sigma
+        sqrt_dt = jnp.sqrt(dt)
+
+        def step(state, noise):
+            x, y = state
+            dx = (a * x - b * x * y) * dt + sig * jnp.sqrt(
+                jnp.maximum(x, 0.0)) * sqrt_dt * noise[:, 0]
+            dy = (c * b * x * y - d * y) * dt + sig * jnp.sqrt(
+                jnp.maximum(y, 0.0)) * sqrt_dt * noise[:, 1]
+            x = jnp.maximum(x + dx, 0.0)
+            y = jnp.maximum(y + dy, 0.0)
+            return (x, y), jnp.stack([x, y], axis=-1)
+
+        noises = jax.random.normal(key, (self.n_steps, n, 2))
+        init = (jnp.full((n,), self.x0), jnp.full((n,), self.y0))
+        _, traj = lax.scan(step, init, noises)   # [T, N, 2]
+        obs = traj[self.obs_idx]                 # [n_obs, N, 2]
+        return {
+            "prey": jnp.moveaxis(obs[..., 0], 0, -1),      # [N, n_obs]
+            "predator": jnp.moveaxis(obs[..., 1], 0, -1),  # [N, n_obs]
+        }
+
+
+def make_lotka_volterra_problem(key=None):
+    """(models, priors, distance, observed) with synthetic ground truth."""
+    model = LotkaVolterraSDE()
+    prior = Distribution(
+        log_a=RV("uniform", -1.0, 2.0),
+        log_b=RV("uniform", -3.0, 2.0),
+        log_c=RV("uniform", -2.0, 2.0),
+        log_d=RV("uniform", -1.0, 2.0),
+    )
+    if key is None:
+        key = jax.random.PRNGKey(7)
+    # ground-truth params: a=1.1, b=0.4, c=1.0 (scaling of b), d=0.4
+    theta_true = jnp.log(jnp.asarray([[1.1, 0.4, 1.0, 0.4]]))
+    obs = model.simulate(key, theta_true)
+    observed = {k: v[0] for k, v in obs.items()}
+    return [model], [prior], AdaptivePNormDistance(p=2), observed

@@ -1,0 +1,58 @@
+"""Two competing Gaussian models — the blessed model-selection problem.
+
+Parity: the reference's central integration problem
+``two_competing_gaussians_multiple_population``
+(test/base/test_samplers.py:128-209): two models, y ~ N(x, σ²) with means
+drawn from uniform priors; the analytic model posterior is checked in tests.
+Also BASELINE config #2 (Gaussian mixture model selection at scale).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..distance import PNormDistance
+from ..model import SimpleModel
+from ..random_variables import RV, Distribution
+
+
+def make_two_gaussians_problem(sigma: float = 0.5,
+                               y_observed: float = 1.0,
+                               mu_a: float = -0.5, mu_b: float = 0.5,
+                               prior_width: float = 1.0):
+    """Two models differing only in the prior location of their mean.
+
+    Model j simulates y ~ N(mu, sigma²); prior of model A centers mu_a,
+    model B centers mu_b (mirrors test_samplers.py:130-148).
+    Returns (models, priors, distance, observed, posterior_fn) where
+    ``posterior_fn(y)`` gives the analytic model-B posterior probability.
+    """
+
+    def sample_fn(key, theta):
+        mu = theta[:, 0]
+        return {"y": mu + sigma * jax.random.normal(key, mu.shape)}
+
+    models = [SimpleModel(sample_fn, name="model_a"),
+              SimpleModel(sample_fn, name="model_b")]
+    priors = [Distribution(mu=RV("uniform", mu_a, prior_width)),
+              Distribution(mu=RV("uniform", mu_b, prior_width))]
+    distance = PNormDistance(p=2)
+    observed = {"y": y_observed}
+
+    def posterior_fn(y: float):
+        """Analytic P(model B | y) under uniform model prior: marginal
+        likelihood of y is the uniform-normal convolution
+        (test_samplers.py:186-203 analog)."""
+        from scipy import stats as ss
+
+        def marginal(lo, width):
+            # ∫ N(y; mu, sigma²) · U(mu; lo, lo+width) dmu
+            return (ss.norm.cdf(y, lo, sigma)
+                    - ss.norm.cdf(y, lo + width, sigma)) / width
+
+        pa = marginal(mu_a, prior_width)
+        pb = marginal(mu_b, prior_width)
+        return pb / (pa + pb)
+
+    return models, priors, distance, observed, posterior_fn

@@ -1,0 +1,68 @@
+"""Deterministic ODE models via fixed-step RK4 under ``lax.scan``.
+
+The reference integrates ODEs through the AMICI bridge
+(pyabc/petab/amici.py:26-170); here ODE right-hand sides are plain JAX
+functions batched over the population — the petab bridge
+(pyabc_tpu/petab) builds on this model class.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..model import Model
+
+Array = jnp.ndarray
+
+
+class ODEModel(Model):
+    """Fixed-step RK4 integrator for ``dy/dt = rhs(y, theta)``.
+
+    ``rhs(y[N, S], theta[N, D]) -> [N, S]`` must be batched; ``observe``
+    maps the trajectory ``[T, N, S]`` to a sum-stat dict.  Optional
+    ``noise_scale`` adds measurement noise (making the model stochastic,
+    as ABC expects).
+    """
+
+    def __init__(self, rhs: Callable, y0, t_max: float, n_steps: int,
+                 observe: Optional[Callable] = None,
+                 obs_idx=None, noise_scale: float = 0.0,
+                 name: str = "ode"):
+        super().__init__(name)
+        self.rhs = rhs
+        self.y0 = jnp.asarray(y0, dtype=jnp.float32)
+        self.t_max = float(t_max)
+        self.n_steps = int(n_steps)
+        self.dt = self.t_max / self.n_steps
+        self.observe = observe
+        self.obs_idx = (jnp.asarray(obs_idx, dtype=jnp.int32)
+                        if obs_idx is not None
+                        else jnp.arange(self.n_steps, dtype=jnp.int32))
+        self.noise_scale = float(noise_scale)
+
+    def sample(self, key, theta: Array) -> Dict[str, Array]:
+        n = theta.shape[0]
+        y_init = jnp.broadcast_to(self.y0, (n,) + self.y0.shape)
+        dt = self.dt
+
+        def step(y, _):
+            k1 = self.rhs(y, theta)
+            k2 = self.rhs(y + 0.5 * dt * k1, theta)
+            k3 = self.rhs(y + 0.5 * dt * k2, theta)
+            k4 = self.rhs(y + dt * k3, theta)
+            y = y + dt / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
+            return y, y
+
+        _, traj = lax.scan(step, y_init, None, length=self.n_steps)
+        obs = traj[self.obs_idx]                        # [T_obs, N, S]
+        if self.noise_scale > 0:
+            obs = obs + self.noise_scale * jax.random.normal(key, obs.shape)
+        if self.observe is not None:
+            return self.observe(obs)
+        # default: one stat per state dimension, [N, T_obs]
+        return {f"y{i}": jnp.moveaxis(obs[..., i], 0, -1)
+                for i in range(obs.shape[-1])}

@@ -1,0 +1,5 @@
+"""Hot-op kernels (MXU-native formulations; pallas variants live here)."""
+
+from .kde import weighted_kde_logpdf
+
+__all__ = ["weighted_kde_logpdf"]

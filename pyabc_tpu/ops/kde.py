@@ -1,0 +1,107 @@
+"""Weighted-KDE log-density: the framework's O(M·N) hot op, MXU-native.
+
+For M query points against an N-point weighted Gaussian KDE,
+
+    log p(x_i) = logsumexp_j( log w_j + log N(x_i - X_j; Σ) )
+
+the Mahalanobis block is reformulated as a matmul over whitened
+coordinates (z = L⁻¹ᵀ·):  maha_ij = |z_i|² − 2 z_i·z_j + |z_j|², so the
+dominant cost is the [M, N] cross product Z_x Z_sᵀ — exactly what the MXU
+wants.  The logsumexp is *streamed* over support blocks flash-attention
+style (running max + running sum), so the [M, N] matrix is never
+materialized: memory is O(M + N + block²), which is what makes the
+reference's "1e6 × 1e6 KDE pdf" hard part (SURVEY.md §7) feasible on one
+chip.
+
+This replaces the reference's per-query Python loop over support points
+(pyabc/transition/multivariatenormal.py:99-113) and its noted-but-unused
+[M, N, D] broadcast alternative (:108-111).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.linalg import solve_triangular
+
+Array = jnp.ndarray
+
+#: default block sizes: queries per outer chunk, support per streamed block
+QUERY_BLOCK = 2048
+SUPPORT_BLOCK = 8192
+
+
+def _pad_rows(a: Array, to: int, fill: float = 0.0) -> Array:
+    pad = to - a.shape[0]
+    if pad == 0:
+        return a
+    cfg = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, cfg, constant_values=fill)
+
+
+@partial(jax.jit, static_argnames=("query_block", "support_block"))
+def weighted_kde_logpdf(x: Array, support: Array, log_w: Array, chol: Array,
+                        log_norm: Array,
+                        query_block: int = QUERY_BLOCK,
+                        support_block: int = SUPPORT_BLOCK) -> Array:
+    """log Σ_j exp(log_w_j) N(x_i; X_j, Σ) for all i — streamed.
+
+    x: [M, D]; support: [N, D]; log_w: [N]; chol: [D, D] (lower);
+    log_norm: scalar −D/2·log 2π − Σ log L_dd.
+    """
+    m, d = x.shape
+    n = support.shape[0]
+
+    # center at the support mean (reduces |z|² magnitudes and with them the
+    # f32 cancellation in the maha = |z_x|² − 2 z_x·z_s + |z_s|² expansion),
+    # then whiten once: z = L^{-1} v  (maha = |z_x - z_s|²)
+    center = jnp.mean(support, axis=0)
+    z_x = solve_triangular(chol, (x - center).T, lower=True).T        # [M, D]
+    z_s = solve_triangular(chol, (support - center).T, lower=True).T  # [N, D]
+    sq_x = jnp.sum(z_x**2, axis=-1)                            # [M]
+    sq_s = jnp.sum(z_s**2, axis=-1)                            # [N]
+    # per-support additive term: log w_j + log_norm − ½|z_j|²
+    a_s = log_w + log_norm - 0.5 * sq_s                        # [N]
+
+    # pad support to a block multiple (padding has log_w = −inf ⇒ no-op)
+    n_blocks = -(-n // support_block)
+    n_pad = n_blocks * support_block
+    z_s = _pad_rows(z_s, n_pad)
+    a_s = _pad_rows(a_s, n_pad, fill=-jnp.inf)
+    z_s_blocks = z_s.reshape(n_blocks, support_block, d)
+    a_s_blocks = a_s.reshape(n_blocks, support_block)
+
+    def query_chunk(args):
+        zq, sqq = args                                          # [Q,D], [Q]
+
+        def body(carry, blk):
+            mx, sm = carry                                      # [Q], [Q]
+            zb, ab = blk
+            # cross = z_q · z_sᵀ — the MXU matmul.  HIGHEST precision: the
+            # default lets XLA run this in bf16, which injects O(0.1)
+            # absolute error into the Mahalanobis exponent (measured);
+            # f32 MXU passes cost ~2x bf16 but the exponent needs them.
+            comp = ab[None, :] + jnp.matmul(
+                zq, zb.T, precision=lax.Precision.HIGHEST)      # [Q, K]
+            blk_max = jnp.max(comp, axis=-1)
+            new_mx = jnp.maximum(mx, blk_max)
+            scale = jnp.exp(mx - new_mx)
+            sm = sm * scale + jnp.sum(
+                jnp.exp(comp - new_mx[:, None]), axis=-1)
+            return (new_mx, sm), None
+
+        init = (jnp.full(zq.shape[0], -jnp.inf), jnp.zeros(zq.shape[0]))
+        (mx, sm), _ = lax.scan(body, init, (z_s_blocks, a_s_blocks))
+        return mx + jnp.log(sm) - 0.5 * sqq
+
+    if m <= query_block:
+        return query_chunk((z_x, sq_x))
+    q_blocks = -(-m // query_block)
+    m_pad = q_blocks * query_block
+    z_xp = _pad_rows(z_x, m_pad).reshape(q_blocks, query_block, d)
+    sq_xp = _pad_rows(sq_x, m_pad).reshape(q_blocks, query_block)
+    out = lax.map(query_chunk, (z_xp, sq_xp)).reshape(-1)
+    return out[:m]

@@ -1,0 +1,12 @@
+"""Device-mesh / distributed helpers."""
+
+from .mesh import (
+    PARTICLE_AXIS,
+    initialize_distributed,
+    make_mesh,
+    particle_sharding,
+    replicated,
+)
+
+__all__ = ["PARTICLE_AXIS", "make_mesh", "particle_sharding", "replicated",
+           "initialize_distributed"]

@@ -1,0 +1,50 @@
+"""Device-mesh helpers for particle-sharded sampling.
+
+The reference scales across cores -> nodes -> clusters with queues and a
+Redis blackboard (SURVEY.md §5.8).  The TPU equivalent: one
+``jax.sharding.Mesh`` whose "particles" axis shards the candidate batch
+over every chip; acceptance counting and weight reductions become XLA
+collectives over ICI, and multi-host scale-out is the same program under
+``jax.distributed`` over DCN — no broker, no pickling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PARTICLE_AXIS = "particles"
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              axis_name: str = PARTICLE_AXIS) -> Mesh:
+    """A 1-D mesh over all (or the given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def particle_sharding(mesh: Mesh, axis_name: str = PARTICLE_AXIS
+                      ) -> NamedSharding:
+    """Shard the leading (particle) axis over the mesh."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None):
+    """Multi-host bring-up (replaces the reference's Redis broker for
+    inter-node coordination, redis_eps/sampler.py:15-153): each host joins
+    the same SPMD program via jax.distributed over DCN."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,154 @@
+"""Parameter handling: named parameter spaces over dense arrays.
+
+The reference (pyabc/parameters.py:38-93) represents a single particle's
+parameters as a dict-subclass with attribute access, and flattens nested dicts
+(pyabc/parameters.py:14-24).  On TPU, per-particle dicts of Python scalars are
+the wrong data structure: the whole population lives as one dense
+``f32[N, D]`` array so that simulation, distance and KDE math run batched on
+the MXU.  ``ParameterSpace`` is the bridge: a fixed, ordered name -> column
+mapping resolved once at setup time.  ``Parameter`` remains available as a
+lightweight dict view for user-facing scalar access (priors, observed values,
+single-particle inspection) with the same dot-access/arithmetic conveniences
+as the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def flatten_dict(dct: Mapping, sep: str = ".") -> dict:
+    """Flatten a nested dict into one level, joining keys with ``sep``.
+
+    Mirrors the reference's ``ParameterStructure.flatten_dict``
+    (pyabc/parameters.py:14-24) but uses a '.'-separator instead of tuple
+    keys so flattened names remain valid column labels.
+    """
+    out = {}
+    for key, value in dct.items():
+        if isinstance(value, Mapping):
+            for sub_key, sub_value in flatten_dict(value, sep).items():
+                out[f"{key}{sep}{sub_key}"] = sub_value
+        else:
+            out[key] = value
+    return out
+
+
+class Parameter(dict):
+    """A single particle's parameters: dict with attribute access + arithmetic.
+
+    Parity with the reference ``Parameter`` (pyabc/parameters.py:38-93).
+    Nested dicts are flattened on construction.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+        merged: dict = {}
+        for arg in args:
+            if isinstance(arg, Mapping):
+                merged.update(arg)
+            else:
+                merged.update(dict(arg))
+        merged.update(kwargs)
+        super().update(flatten_dict(merged))
+
+    def __getattr__(self, item):
+        try:
+            return self[item]
+        except KeyError as e:
+            raise AttributeError(item) from e
+
+    def __add__(self, other: "Parameter") -> "Parameter":
+        return Parameter({key: self[key] + other[key] for key in self})
+
+    def __sub__(self, other: "Parameter") -> "Parameter":
+        return Parameter({key: self[key] - other[key] for key in self})
+
+    def __repr__(self):
+        return f"<Parameter {dict(self)}>"
+
+    def copy(self) -> "Parameter":
+        return Parameter(self)
+
+
+class ParameterSpace:
+    """Fixed, ordered mapping between parameter names and array columns.
+
+    Every model in a run resolves its parameter names once into a
+    ``ParameterSpace``; thereafter all on-device math works on dense
+    ``[N, dim]`` arrays.  When multiple models with different parameter sets
+    take part in a run (model selection), each model gets its own space and
+    arrays are padded to the max dimension by the orchestrator.
+    """
+
+    def __init__(self, names: Sequence[str]):
+        self.names: tuple = tuple(names)
+        self._index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+        if len(self._index) != len(self.names):
+            raise ValueError(f"duplicate parameter names: {names}")
+
+    @property
+    def dim(self) -> int:
+        return len(self.names)
+
+    def index(self, name: str) -> int:
+        return self._index[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def __iter__(self):
+        return iter(self.names)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ParameterSpace) and self.names == other.names
+
+    def __repr__(self):
+        return f"ParameterSpace({list(self.names)})"
+
+    # ---- conversions -----------------------------------------------------
+
+    def dict_to_array(self, par: Mapping[str, Union[float, np.ndarray]]):
+        """Pack a name->scalar dict into a ``[dim]`` array (row of theta)."""
+        par = flatten_dict(par)
+        return jnp.stack(
+            [jnp.asarray(par[name], dtype=jnp.float32) for name in self.names]
+        )
+
+    def dicts_to_array(self, pars: Iterable[Mapping[str, float]]):
+        """Pack an iterable of dicts into ``[N, dim]``."""
+        rows = [[flatten_dict(p)[name] for name in self.names] for p in pars]
+        return jnp.asarray(np.asarray(rows, dtype=np.float32))
+
+    def array_to_dict(self, row) -> Parameter:
+        """Unpack a ``[dim]`` row into a :class:`Parameter`."""
+        row = np.asarray(row)
+        return Parameter({name: float(row[i]) for i, name in enumerate(self.names)})
+
+    def array_to_dicts(self, theta) -> list:
+        """Unpack ``[N, dim]`` into a list of :class:`Parameter`."""
+        theta = np.asarray(theta)
+        return [
+            Parameter({name: float(theta[j, i]) for i, name in enumerate(self.names)})
+            for j in range(theta.shape[0])
+        ]
+
+    def columns(self, theta) -> Dict[str, jnp.ndarray]:
+        """View ``[N, dim]`` as name -> ``[N]`` columns (no copy per jnp)."""
+        return {name: theta[..., i] for i, name in enumerate(self.names)}
+
+    def pad_to(self, theta, dim: int):
+        """Zero-pad the trailing parameter axis of ``theta`` up to ``dim``."""
+        d = theta.shape[-1]
+        if d == dim:
+            return theta
+        if d > dim:
+            raise ValueError(f"cannot pad dim {d} down to {dim}")
+        pad = [(0, 0)] * (theta.ndim - 1) + [(0, dim - d)]
+        return jnp.pad(theta, pad)

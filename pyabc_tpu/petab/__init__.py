@@ -1,0 +1,5 @@
+"""PEtab bridge (parity: pyabc/petab/)."""
+
+from .base import PetabImporter
+
+__all__ = ["PetabImporter"]

@@ -1,0 +1,20 @@
+"""Default sampler per platform.
+
+Parity: pyabc/platform_factory.py:5-16 (MulticoreEvalParallel on
+Linux/macOS, SingleCore on Windows).  Here the choice is by device
+topology: one accelerator -> :class:`VectorizedSampler`; several devices ->
+:class:`ShardedSampler` over a particles mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .sampler.sharded import ShardedSampler
+from .sampler.vectorized import VectorizedSampler
+
+
+def DefaultSampler(**kwargs):
+    if len(jax.devices()) > 1:
+        return ShardedSampler(**kwargs)
+    return VectorizedSampler(**kwargs)

@@ -1,0 +1,167 @@
+"""Population: struct-of-arrays particle container (a JAX pytree).
+
+The reference keeps a ``Particle`` object per sample and a ``Population`` as
+a list of particles (pyabc/population.py:19-145).  On TPU the population IS
+the unit of computation, so it is one dense pytree:
+
+    m:         i32[N]    model index per particle
+    theta:     f32[N,D]  parameters (padded to the max model dimension)
+    weight:    f32[N]    raw importance weight (global, un-normalized)
+    distance:  f32[N]    accepted distance
+    accepted:  bool[N]
+    sum_stats: dict[str, Array[N, ...]]  summary statistics (optional)
+
+All reference semantics are preserved as array ops: per-model weight
+normalization and model probabilities (pyabc/population.py:123-145),
+weighted distances (population.py:178-205), distance re-computation after a
+distance-function update (population.py:147-176).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+@jax.tree_util.register_pytree_node_class
+class Population:
+    """Dense weighted particle population."""
+
+    def __init__(
+        self,
+        m: Array,
+        theta: Array,
+        weight: Array,
+        distance: Array,
+        sum_stats: Optional[Dict[str, Array]] = None,
+        accepted: Optional[Array] = None,
+    ):
+        self.m = m
+        self.theta = theta
+        self.weight = weight
+        self.distance = distance
+        self.sum_stats = sum_stats if sum_stats is not None else {}
+        if accepted is None:
+            accepted = (np.ones(len(m), dtype=bool)
+                        if isinstance(m, np.ndarray)
+                        else jnp.ones(m.shape, dtype=bool))
+        self.accepted = accepted
+
+    # ---- pytree protocol -------------------------------------------------
+
+    def tree_flatten(self):
+        children = (self.m, self.theta, self.weight, self.distance,
+                    self.sum_stats, self.accepted)
+        return children, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        m, theta, weight, distance, sum_stats, accepted = children
+        return cls(m, theta, weight, distance, sum_stats, accepted)
+
+    # ---- basics ----------------------------------------------------------
+
+    def __len__(self):
+        return int(self.m.shape[0])
+
+    @property
+    def n(self) -> int:
+        return int(self.m.shape[0])
+
+    def get_list(self):
+        """Reference-compat: list of per-particle views (host-side)."""
+        m = np.asarray(self.m)
+        theta = np.asarray(self.theta)
+        w = np.asarray(self.weight)
+        d = np.asarray(self.distance)
+        return [
+            {"m": int(m[i]), "parameter": theta[i], "weight": float(w[i]),
+             "distance": float(d[i])}
+            for i in range(len(m))
+        ]
+
+    # ---- weights & model probabilities ----------------------------------
+    # Reference: Population._normalize_weights (population.py:123-145) —
+    # model probability = total weight share per model; in-model weights
+    # renormalized to 1.
+
+    def get_model_probabilities(self, nr_models: Optional[int] = None) -> Array:
+        nr = nr_models if nr_models is not None else int(np.max(np.asarray(self.m))) + 1
+        if isinstance(self.m, np.ndarray):
+            # host path (control plane): zero device dispatches
+            totals = np.bincount(self.m, weights=self.weight, minlength=nr)
+            return totals / totals.sum()
+        totals = jnp.zeros(nr).at[self.m].add(self.weight)
+        return totals / jnp.sum(totals)
+
+    def get_alive_models(self):
+        probs = np.asarray(self.get_model_probabilities())
+        return [int(m) for m in np.nonzero(probs > 0)[0]]
+
+    def nr_of_models_alive(self) -> int:
+        return len(self.get_alive_models())
+
+    def normalized_weights(self) -> Array:
+        """Weights normalized globally (Σ = 1)."""
+        return self.weight / self.weight.sum()
+
+    def in_model_weights(self, nr_models: Optional[int] = None) -> Array:
+        """Weights renormalized within each particle's model (Σ_model = 1)."""
+        nr = nr_models if nr_models is not None else int(np.max(np.asarray(self.m))) + 1
+        if isinstance(self.m, np.ndarray):
+            totals = np.bincount(self.m, weights=self.weight, minlength=nr)
+        else:
+            totals = jnp.zeros(nr).at[self.m].add(self.weight)
+        return self.weight / totals[self.m]
+
+    # ---- distances -------------------------------------------------------
+
+    def get_weighted_distances(self):
+        """(distances[N], normalized weights[N]) — reference population.py:178."""
+        return self.distance, self.normalized_weights()
+
+    def update_distances(self, distance_fn: Callable) -> "Population":
+        """Recompute distances from stored sum_stats after a distance update.
+
+        Reference: population.py:147-176 (called from smc.py:1009-1013 when
+        an adaptive distance changed and requires re-weighting).
+        ``distance_fn(sum_stats) -> f32[N]`` must be batched (device fn;
+        one dispatch).
+        """
+        if not self.sum_stats:
+            raise ValueError("no summary statistics stored; cannot update distances")
+        new_d = distance_fn({k: jnp.asarray(v)
+                             for k, v in self.sum_stats.items()})
+        if isinstance(self.distance, np.ndarray):
+            new_d = np.asarray(new_d)
+        return Population(self.m, self.theta, self.weight, new_d,
+                          self.sum_stats, self.accepted)
+
+    # ---- selection / combination ----------------------------------------
+
+    def select_model(self, m: int) -> "Population":
+        """Host-side filter to one model's particles (for KDE refits)."""
+        mask = np.asarray(self.m) == m
+        idx = np.nonzero(mask)[0]
+        take = lambda a: np.asarray(a)[idx]
+        return Population(
+            take(self.m), take(self.theta), take(self.weight), take(self.distance),
+            {k: take(v) for k, v in self.sum_stats.items()},
+            take(self.accepted),
+        )
+
+    def to_dict(self) -> dict:
+        """Per-model dict of particle arrays (reference population.py:266-289)."""
+        out = {}
+        for m in self.get_alive_models():
+            out[m] = self.select_model(m)
+        return out
+
+    def __repr__(self):
+        return (f"<Population n={self.n} dim={self.theta.shape[-1]} "
+                f"models={int(jnp.max(self.m)) + 1 if self.n else 0}>")

@@ -1,0 +1,18 @@
+"""Samplers (parity: pyabc/sampler/ — collapsed onto compiled rejection
+rounds; see sampler/vectorized.py module docstring for the mapping)."""
+
+from .base import RoundResult, Sample, Sampler, SamplingError
+from .rounds import RoundKernel
+from .sharded import ShardedSampler
+from .vectorized import (
+    MulticoreEvalParallelSampler,
+    MulticoreParticleParallelSampler,
+    SingleCoreSampler,
+    VectorizedSampler,
+)
+
+__all__ = [
+    "Sampler", "Sample", "SamplingError", "RoundResult", "RoundKernel",
+    "VectorizedSampler", "ShardedSampler", "SingleCoreSampler",
+    "MulticoreEvalParallelSampler", "MulticoreParticleParallelSampler",
+]

@@ -1,0 +1,223 @@
+"""Sampler contract + the Sample accumulator.
+
+Parity: pyabc/sampler/base.py (233 LoC).  The reference contract is
+
+    sampler.sample_until_n_accepted(n, simulate_one, ...) -> Sample
+
+where ``simulate_one`` is a per-particle closure farmed out to processes.
+The TPU contract replaces the closure with a *compiled round function*
+
+    round_fn(key, params) -> RoundResult   (a fixed-shape batch of B
+                                            candidate particles)
+
+and ``sample_until_n_accepted`` becomes a host-controlled loop of
+over-provisioned fixed-shape rounds (SURVEY.md §7): simulate B ≥ n
+candidates, mask-accept, accumulate, repeat.  Because rounds are
+deterministic in submission order, the reference's sort-by-id + truncate
+de-biasing protocol (multicore_evaluation_parallel.py:134-136,
+redis_eps/sampler.py:141-144) is satisfied trivially: accepted particles
+are concatenated in round order and truncated to the first n.
+
+``nr_evaluations_`` bookkeeping matches sampler/base.py:189 (= rounds × B).
+The output-size assertion of ``SamplerMeta`` (base.py:144-169) lives in
+:meth:`Sample.get_accepted_population`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..population import Population
+
+Array = jnp.ndarray
+
+
+class RoundResult:
+    """One fixed-shape batch of candidates (a pytree of arrays)."""
+
+    def __init__(self, m, theta, distance, accepted, log_weight, stats,
+                 valid=None):
+        self.m = m                  # i32[B]
+        self.theta = theta          # f32[B, D]
+        self.distance = distance    # f32[B]
+        self.accepted = accepted    # bool[B]
+        self.log_weight = log_weight  # f32[B]
+        self.stats = stats          # f32[B, S] flattened sum-stats
+        self.valid = valid if valid is not None else accepted
+
+    def tree_flatten(self):
+        return ((self.m, self.theta, self.distance, self.accepted,
+                 self.log_weight, self.stats, self.valid), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+import jax.tree_util as _tree_util  # noqa: E402
+
+_tree_util.register_pytree_node_class(RoundResult)
+
+
+class SamplingError(Exception):
+    pass
+
+
+class Sample:
+    """Host-side accumulator over rounds (parity: sampler/base.py:17-120).
+
+    ``record_rejected`` mirrors ``SampleFactory.record_rejected``
+    (sampler/base.py:60-77): when set (by adaptive distances / temperature
+    schemes via configure_sampler), ALL candidate sum-stats are kept up to
+    ``max_records`` so per-generation adaptation can see rejected particles.
+    """
+
+    def __init__(self, record_rejected: bool = False,
+                 max_records: int = 1 << 21):
+        self.record_rejected = record_rejected
+        self.max_records = max_records
+        self._acc: List[dict] = []
+        self._rec: List[dict] = []
+        self._n_recorded = 0
+        self.nr_evaluations = 0
+        #: ALL acceptances observed, incl. over-provisioned beyond the
+        #: requested n (for unbiased acceptance-rate accounting)
+        self.raw_accepted = 0
+
+    def append_round(self, rr: RoundResult):
+        acc_mask = np.asarray(rr.accepted)
+        self.nr_evaluations += int(acc_mask.shape[0])
+        self.raw_accepted += int(acc_mask.sum())
+        idx = np.nonzero(acc_mask)[0]
+        if idx.size:
+            self._acc.append({
+                "m": np.asarray(rr.m)[idx],
+                "theta": np.asarray(rr.theta)[idx],
+                "distance": np.asarray(rr.distance)[idx],
+                "log_weight": np.asarray(rr.log_weight)[idx],
+                "stats": np.asarray(rr.stats)[idx],
+            })
+        if self.record_rejected and self._n_recorded < self.max_records:
+            valid = np.nonzero(np.asarray(rr.valid))[0]
+            take = valid[: self.max_records - self._n_recorded]
+            self._rec.append({
+                "stats": np.asarray(rr.stats)[take],
+                "distance": np.asarray(rr.distance)[take],
+                "accepted": acc_mask[take],
+            })
+            self._n_recorded += take.size
+
+    def append_device_batch(self, out: dict, n_evals: int):
+        """Ingest one on-device generation batch (sampler/device_loop.py):
+        a single host transfer of the compacted accepted buffers (+ records).
+        """
+        import jax
+        out = jax.device_get(out)  # ONE bulk d2h transfer, not one per key
+        self.nr_evaluations += int(n_evals)
+        count = int(out["count"])
+        self.raw_accepted += count
+        take = min(count, out["m"].shape[0])
+        if take:
+            self._acc.append({
+                "m": np.asarray(out["m"][:take]),
+                "theta": np.asarray(out["theta"][:take]),
+                "distance": np.asarray(out["distance"][:take]),
+                "log_weight": np.asarray(out["log_weight"][:take]),
+                "stats": np.asarray(out["stats"][:take]),
+            })
+        if self.record_rejected and "rec_count" in out:
+            rc = min(int(out["rec_count"]),
+                     self.max_records - self._n_recorded)
+            if rc > 0:
+                self._rec.append({
+                    "stats": np.asarray(out["rec_stats"][:rc]),
+                    "distance": np.asarray(out["rec_distance"][:rc]),
+                    "accepted": np.asarray(out["rec_accepted"][:rc]),
+                })
+                self._n_recorded += rc
+
+    @property
+    def n_accepted(self) -> int:
+        return sum(a["m"].shape[0] for a in self._acc)
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Unbiased: raw acceptances (incl. beyond-n) / evaluations."""
+        return self.raw_accepted / max(self.nr_evaluations, 1)
+
+    def _concat(self, dicts: List[dict], key: str) -> np.ndarray:
+        return np.concatenate([d[key] for d in dicts], axis=0)
+
+    def get_accepted_population(self, n: int) -> Population:
+        """First n accepted particles in deterministic round order."""
+        if self.n_accepted < n:
+            raise SamplingError(
+                f"expected {n} accepted particles, have {self.n_accepted} "
+                "(contract check, cf. reference sampler/base.py:154-157)")
+        m = self._concat(self._acc, "m")[:n]
+        theta = self._concat(self._acc, "theta")[:n]
+        dist = self._concat(self._acc, "distance")[:n]
+        logw = self._concat(self._acc, "log_weight")[:n]
+        stats = self._concat(self._acc, "stats")[:n]
+        # normalize in log space for f32 safety; arrays stay numpy — the
+        # population is control-plane state (fits, quantiles, DB writes)
+        # and must not cost device dispatches
+        logw = logw - logw.max() if logw.size else logw
+        w = np.exp(np.asarray(logw, dtype=np.float64))
+        s = w.sum()
+        if not np.isfinite(s) or s <= 0:
+            raise SamplingError("all accepted particles have zero weight")
+        return Population(
+            m=m, theta=theta,
+            weight=(w / s).astype(np.float32), distance=dist,
+            sum_stats={"__flat__": stats},
+        )
+
+    def get_all_stats(self) -> np.ndarray:
+        """All recorded candidate stats ``[R, S]`` (incl. rejected)."""
+        if not self._rec:
+            return self._concat(self._acc, "stats") if self._acc else \
+                np.zeros((0, 0), np.float32)
+        return self._concat(self._rec, "stats")
+
+    def get_all_records(self) -> List[dict]:
+        """Per-candidate records for temperature schemes (reference
+        smc.py:726-737).  transition densities are folded into log_weight at
+        round time, so records expose distance + accepted; the importance
+        ratio pd/pd_prev is approximated as 1 (documented deviation)."""
+        out = []
+        for rec in self._rec:
+            for i in range(rec["distance"].shape[0]):
+                out.append({
+                    "distance": float(rec["distance"][i]),
+                    "transition_pd_prev": 1.0,
+                    "transition_pd": 1.0,
+                    "accepted": bool(rec["accepted"][i]),
+                })
+        return out
+
+
+class Sampler:
+    """Abstract sampler (parity: pyabc/sampler/base.py:171-233)."""
+
+    def __init__(self):
+        self.nr_evaluations_ = 0
+        self.record_rejected = False
+        self.show_progress = False
+        self.sample_factory = self  # reference-compat alias
+
+    def sample_until_n_accepted(
+            self, n: int,
+            round_fn: Callable,
+            key,
+            params,
+            max_eval: float = np.inf,
+            all_accepted: bool = False,
+            **kwargs) -> Sample:
+        raise NotImplementedError
+
+    def stop(self):
+        """Teardown hook (reference redis sampler parity)."""

@@ -1,0 +1,198 @@
+"""Fused per-generation round kernels.
+
+This is the TPU replacement for the reference's ``simulate_one`` closure
+(pyabc/smc.py:544-608): instead of a Python closure called once per
+particle on a worker process, the whole proposal -> simulate -> distance ->
+accept -> weight pipeline for a fixed-shape batch of B candidates is ONE
+jitted function.  Call-stack parity (reference smc.py:610-724):
+
+- ``_generate_valid_proposal`` (smc.py:610-662): model-source draw via
+  categorical, model jump via ``ModelPerturbationKernel``, theta via the
+  fitted KDE transition.  The reference's resample-until-prior-positive
+  loop becomes a validity mask: invalid proposals are marked rejected,
+  which after weight normalization is statistically equivalent (the
+  conditioning constant P(valid) cancels across the generation).
+- ``_evaluate_proposal`` (smc.py:664-724): batched simulate per model with
+  masked selection, distance kernel, acceptor kernel.
+- ``_create_weight_function`` (smc.py:768-811): importance weight
+  ``prior·acc_weight / Σ_m p_m·jump_pmf·transition_pdf`` — in log space.
+
+Everything dynamic (model probabilities, transition fits, adaptive distance
+weights, ε/temperature) arrives via the ``params`` pytree, so one XLA
+compilation serves every generation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..acceptor import Acceptor
+from ..distance.base import Distance
+from ..model import IntegratedModel, Model
+from ..random_variables import Distribution, ModelPerturbationKernel
+from ..sumstat import SumStatSpec
+from .base import RoundResult
+
+Array = jnp.ndarray
+
+
+class RoundKernel:
+    """Builds the jitted prior-round and generation-round functions.
+
+    Static configuration (models, priors, spec, observed stats, component
+    *structure*) is closed over; per-generation values flow through params.
+    """
+
+    import itertools as _itertools
+    _uid_counter = _itertools.count()
+
+    def __init__(self,
+                 models: Sequence[Model],
+                 parameter_priors: Sequence[Distribution],
+                 model_prior_logits: Array,
+                 model_perturbation_kernel: ModelPerturbationKernel,
+                 transitions,
+                 distance: Distance,
+                 acceptor: Acceptor,
+                 spec: SumStatSpec,
+                 obs_flat: Array,
+                 dim: int):
+        self.models = list(models)
+        self.priors = list(parameter_priors)
+        self.model_prior_logits = jnp.asarray(model_prior_logits)
+        self.pert = model_perturbation_kernel
+        # (rvs_from_params, log_pdf_from_params) per model, resolved from
+        # the transition INSTANCES (GridSearchCV etc. delegate to their base
+        # estimator's class) — stable function identities for jit caching
+        self.transition_fns = [tr.static_fns() for tr in transitions]
+        self.distance = distance
+        self.acceptor = acceptor
+        self.spec = spec
+        self.obs_flat = jnp.asarray(obs_flat)
+        self.dim = int(dim)
+        self.M = len(self.models)
+        # unique token for sampler jit caches: id() of a freed kernel can
+        # be reused by a new one, which would serve a stale compiled round
+        import itertools
+        self._uid = next(RoundKernel._uid_counter)
+
+    # ---- shared helpers --------------------------------------------------
+
+    def _simulate_all(self, key, theta: Array, m: Array, eps: Array):
+        """Simulate every model on the full batch, select by model index.
+
+        With one model this is exact; with several, flops are burned on
+        masked lanes — the fixed-shape trade the TPU wants (SURVEY.md §2.2
+        STAT/DYN translation note).
+        """
+        B = theta.shape[0]
+        stats = jnp.zeros((B, self.spec.total_size), dtype=jnp.float32)
+        early = jnp.zeros((B,), dtype=bool)
+        for j, model in enumerate(self.models):
+            kj = jax.random.fold_in(key, j)
+            d_j = self.priors[j].dim
+            theta_j = theta[:, :d_j]
+            if isinstance(model, IntegratedModel):
+                res = model.integrated_simulate(kj, theta_j, eps)
+                s_j = self.spec.flatten(res.sum_stats)
+                e_j = (res.early_reject if res.early_reject is not None
+                       else jnp.zeros((B,), dtype=bool))
+            else:
+                s_j = self.spec.flatten(model.simulate(kj, theta_j))
+                e_j = jnp.zeros((B,), dtype=bool)
+            sel = (m == j)
+            stats = jnp.where(sel[:, None], s_j, stats)
+            early = jnp.where(sel, e_j, early)
+        return stats, early
+
+    def _eps_hint(self, acceptor_params: dict) -> Array:
+        return acceptor_params.get("eps", jnp.float32(jnp.inf))
+
+    # ---- prior (calibration) round: reference smc.py:454-542 -------------
+
+    def prior_round(self, key, params: dict, B: int,
+                    all_accepted: bool = False) -> RoundResult:
+        km, kth, ksim, kacc = jax.random.split(key, 4)
+        m = jax.random.categorical(km, self.model_prior_logits, shape=(B,))
+        theta = jnp.zeros((B, self.dim), dtype=jnp.float32)
+        for j, prior in enumerate(self.priors):
+            th_j = prior.rvs_array(jax.random.fold_in(kth, j), B)
+            th_j = jnp.pad(th_j, ((0, 0), (0, self.dim - th_j.shape[-1])))
+            theta = jnp.where((m == j)[:, None], th_j, theta)
+        eps = self._eps_hint(params.get("acceptor", {}))
+        stats, early = self._simulate_all(ksim, theta, m, eps)
+        d = self.distance.compute(stats, self.obs_flat, params["distance"])
+        if all_accepted:
+            accepted = jnp.ones((B,), dtype=bool)
+            log_acc_w = jnp.zeros((B,))
+        else:
+            acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])
+            log_acc_w = jnp.log(jnp.maximum(acc_w, 1e-38))
+            accepted = acc & ~early & jnp.isfinite(d)
+        return RoundResult(
+            m=m, theta=theta, distance=d, accepted=accepted,
+            log_weight=log_acc_w, stats=stats,
+            valid=jnp.ones((B,), dtype=bool))
+
+    # ---- generation round: reference smc.py:588-724 ----------------------
+
+    def generation_round(self, key, params: dict, B: int) -> RoundResult:
+        km, kj, kth, ksim, kacc = jax.random.split(key, 5)
+        model_log_probs = params["model_log_probs"]          # [M]
+        trans_params = params["transition"]                  # tuple per model
+
+        # 1. source model + jump (smc.py:640-653)
+        m_s = jax.random.categorical(km, model_log_probs, shape=(B,))
+        m = self.pert.rvs(kj, m_s)
+
+        # 2. theta from the jumped model's transition
+        theta = jnp.zeros((B, self.dim), dtype=jnp.float32)
+        for j in range(self.M):
+            th_j = self.transition_fns[j][0](
+                jax.random.fold_in(kth, j), trans_params[j], B)
+            th_j = jnp.pad(th_j, ((0, 0), (0, self.dim - th_j.shape[-1])))
+            theta = jnp.where((m == j)[:, None], th_j, theta)
+
+        # 3. prior validity (replaces resample-until-positive, smc.py:654)
+        log_prior = jnp.full((B,), -jnp.inf)
+        for j, prior in enumerate(self.priors):
+            lp_j = prior.log_pdf_array(theta[:, :prior.dim])
+            log_prior = jnp.where(m == j, lp_j, log_prior)
+        log_model_prior = self.model_prior_logits - jax.scipy.special.logsumexp(
+            self.model_prior_logits)
+        log_prior = log_prior + log_model_prior[m]
+        valid = jnp.isfinite(log_prior)
+
+        # 4. simulate + distance + accept (smc.py:664-724)
+        eps = self._eps_hint(params.get("acceptor", {}))
+        stats, early = self._simulate_all(ksim, theta, m, eps)
+        d = self.distance.compute(stats, self.obs_flat, params["distance"])
+        acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])
+        accepted = acc & valid & ~early & ~jnp.isnan(d)
+
+        # 5. importance weight (smc.py:739-750, 793-809), log space.
+        # proposal density of (m, theta):
+        #   [Σ_s p_s · jump_pmf(s -> m)] · q_m(theta)
+        # i.e. the TARGET model's KDE evaluated at theta, times the summed
+        # model-jump factor (reference transition_pdf, smc.py:739-750).
+        lp_target = jnp.full((B,), -jnp.inf)
+        for j in range(self.M):
+            q_j = self.transition_fns[j][1](
+                theta[:, :self.priors[j].dim], trans_params[j])
+            lp_target = jnp.where(m == j, q_j, lp_target)
+        all_m = jnp.arange(self.M)
+        log_jump = self.pert.log_pmf(
+            m[None, :], all_m[:, None])                      # [M, B]
+        log_mix = jax.scipy.special.logsumexp(
+            model_log_probs[:, None] + log_jump, axis=0)     # [B]
+        log_denom = log_mix + lp_target
+        log_acc_w = jnp.log(jnp.maximum(acc_w, 1e-38))
+        log_weight = log_prior + log_acc_w - log_denom
+        log_weight = jnp.where(accepted, log_weight, -jnp.inf)
+
+        return RoundResult(m=m, theta=theta, distance=d, accepted=accepted,
+                           log_weight=log_weight, stats=stats, valid=valid)

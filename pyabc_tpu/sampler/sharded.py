@@ -1,0 +1,89 @@
+"""Mesh-sharded sampler: SPMD rejection rounds over a device mesh.
+
+The distributed data plane (SURVEY.md §5.8 "TPU-native equivalent"): the
+candidate batch is sharded over the mesh's "particles" axis via
+``shard_map``; every device runs the identical fused round kernel on its
+shard with a deterministically folded key; gathering accepted particles and
+acceptance counts are XLA collectives over ICI — this replaces the
+reference's mp.Queue / Redis RPUSH result channels and lock-protected
+shared counters (multicore_evaluation_parallel.py:95-115,
+redis_eps/cli.py:113-159).
+
+The on-device generation loop (sampler/device_loop.py) wraps the sharded
+round: the ``lax.while_loop`` runs in the replicated program, each
+iteration fanning the round out over the mesh and compacting accepted
+particles globally — still ONE host dispatch per generation.
+
+The same program scales multi-host under ``jax.distributed`` (DCN), which
+is the reference's Redis-cluster scale-out path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..parallel.mesh import PARTICLE_AXIS, make_mesh
+from .vectorized import VectorizedSampler
+
+
+class ShardedSampler(VectorizedSampler):
+    """VectorizedSampler whose rounds are shard_mapped over a mesh."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 axis_name: str = PARTICLE_AXIS, **kwargs):
+        super().__init__(**kwargs)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.axis_name = axis_name
+        self.n_devices = int(np.prod([self.mesh.shape[a]
+                                      for a in self.mesh.axis_names]))
+        # every round's batch must split evenly over devices
+        self.min_batch_size = max(self.min_batch_size, self.n_devices)
+
+    def _round_to_valid_batch(self, b: float) -> int:
+        B = super()._round_to_valid_batch(b)
+        # power-of-two ladder + pow-of-two device counts always divide; for
+        # exotic device counts round up to a multiple
+        if B % self.n_devices:
+            B = ((B // self.n_devices) + 1) * self.n_devices
+        return B
+
+    def _raw_round(self, round_fn: Callable, B: int,
+                   **static_kwargs) -> Callable:
+        B_local = B // self.n_devices
+        axis = self.axis_name
+
+        def per_device(dev_keys, params):
+            # dev_keys: this device's [1]-shaped shard of the key array
+            key = jax.random.fold_in(
+                dev_keys[0], jax.lax.axis_index(axis))
+            return round_fn(key, params, B_local, **static_kwargs)
+
+        try:
+            sharded = shard_map(
+                per_device, mesh=self.mesh,
+                in_specs=(P(axis), P()),
+                out_specs=P(axis),
+                check_vma=False,
+            )
+        except TypeError:  # older jax spells it check_rep
+            sharded = shard_map(
+                per_device, mesh=self.mesh,
+                in_specs=(P(axis), P()),
+                out_specs=P(axis),
+                check_rep=False,
+            )
+
+        def run(key, params):
+            keys = jax.random.split(key, self.n_devices)
+            return sharded(keys, params)
+
+        return run

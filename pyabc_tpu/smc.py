@@ -1,0 +1,475 @@
+"""ABCSMC orchestrator: the generation loop.
+
+Parity: pyabc/smc.py (1079 LoC) — the central class composing the strategy
+components (distance / epsilon / acceptor / transition / population-size /
+sampler), with calibration, per-generation adaptation, model selection,
+stopping criteria and durable resume (call-stack map in SURVEY.md §3.1).
+
+TPU architecture: the control plane (this file) is thin host Python running
+once per generation; the data plane is the fused round kernel
+(sampler/rounds.py) compiled once and fed per-generation params.  Per-model
+KDE supports are zero-weight-PADDED to the full population size so array
+shapes — and therefore the compiled program — stay identical across
+generations and across alive/dead model sets.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .acceptor import Acceptor, StochasticAcceptor, UniformAcceptor
+from .distance import Distance, PNormDistance, StochasticKernel, to_distance
+from .epsilon import Epsilon, MedianEpsilon, TemperatureBase
+from .model import Model, SimpleModel
+from .population import Population
+from .populationstrategy import ConstantPopulationSize, PopulationStrategy
+from .random_variables import Distribution, ModelPerturbationKernel
+from .sampler.base import Sample, Sampler
+from .sampler.rounds import RoundKernel
+from .storage.history import PRE_TIME, History
+from .sumstat import SumStatSpec
+from .transition import MultivariateNormalTransition, Transition
+from .weighted_statistics import effective_sample_size
+
+logger = logging.getLogger("ABC")
+
+
+def _default_sampler() -> Sampler:
+    from .platform_factory import DefaultSampler
+    return DefaultSampler()
+
+
+class ABCSMC:
+    """ABC-SMC with on-device populations (reference smc.py:46-1079)."""
+
+    def __init__(self,
+                 models: Union[Model, Callable, Sequence],
+                 parameter_priors: Union[Distribution, Sequence[Distribution]],
+                 distance_function: Optional[Distance] = None,
+                 population_size: Union[int, PopulationStrategy] = 100,
+                 summary_statistics: Optional[Callable] = None,
+                 model_prior=None,
+                 model_perturbation_kernel: Optional[ModelPerturbationKernel] = None,
+                 transitions: Optional[Sequence[Transition]] = None,
+                 eps: Optional[Epsilon] = None,
+                 acceptor: Optional[Acceptor] = None,
+                 sampler: Optional[Sampler] = None,
+                 stop_if_only_single_model_alive: bool = False,
+                 max_nr_recorded_particles: int = 1 << 21,
+                 seed: int = 0):
+        if not isinstance(models, (list, tuple)):
+            models = [models]
+        self.models = [SimpleModel.assert_model(m) for m in models]
+        if isinstance(parameter_priors, Distribution):
+            parameter_priors = [parameter_priors]
+        self.parameter_priors = list(parameter_priors)
+        if len(self.models) != len(self.parameter_priors):
+            raise ValueError("#models != #parameter_priors")
+        self.M = len(self.models)
+        self.dim = max(p.dim for p in self.parameter_priors)
+
+        self.distance_function = (to_distance(distance_function)
+                                  if distance_function is not None
+                                  else PNormDistance(p=2))
+        self.summary_statistics = summary_statistics
+        if model_prior is None:
+            model_prior = np.zeros(self.M)  # uniform logits
+        self.model_prior_logits = np.asarray(model_prior, dtype=np.float32)
+        self.model_perturbation_kernel = (
+            model_perturbation_kernel
+            or ModelPerturbationKernel(self.M, probability_to_stay=0.7))
+        if transitions is None:
+            transitions = [MultivariateNormalTransition()
+                           for _ in range(self.M)]
+        if not isinstance(transitions, (list, tuple)):
+            transitions = [transitions]
+        self.transitions: List[Transition] = list(transitions)
+        if isinstance(population_size, int):
+            population_size = ConstantPopulationSize(population_size)
+        self.population_strategy = population_size
+        self.eps = eps if eps is not None else MedianEpsilon()
+        self.acceptor = acceptor if acceptor is not None else UniformAcceptor()
+        self.sampler = sampler if sampler is not None else _default_sampler()
+        self.stop_if_only_single_model_alive = stop_if_only_single_model_alive
+        self.max_nr_recorded_particles = max_nr_recorded_particles
+        self.key = jax.random.PRNGKey(seed)
+
+        self._sanity_check()
+
+        self.history: Optional[History] = None
+        self.x_0: Optional[Dict] = None
+        self.spec: Optional[SumStatSpec] = None
+        self._obs_flat = None
+        self._kernel: Optional[RoundKernel] = None
+        self._trans_params: Optional[tuple] = None
+        self.minimum_epsilon = 0.0
+        self.max_nr_populations = np.inf
+        self.min_acceptance_rate = 0.0
+
+    def _sanity_check(self):
+        """Stochastic triple consistency (reference smc.py:238-248)."""
+        stoch = [isinstance(self.acceptor, StochasticAcceptor),
+                 isinstance(self.eps, TemperatureBase),
+                 isinstance(self.distance_function, StochasticKernel)]
+        if any(stoch) and not all(stoch):
+            raise ValueError(
+                "StochasticAcceptor, Temperature and a StochasticKernel "
+                "must be used together (reference pyabc/smc.py:238-248)")
+
+    def _split(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    # ------------------------------------------------------------------
+    # run registration / resume (reference smc.py:255-389)
+    # ------------------------------------------------------------------
+
+    def new(self, db: str, observed_sum_stat: Dict,
+            gt_model: Optional[int] = None,
+            gt_par: Optional[dict] = None,
+            meta_info: Optional[dict] = None) -> History:
+        if self.summary_statistics is not None:
+            observed_sum_stat = self.summary_statistics(observed_sum_stat)
+        self.x_0 = {k: jnp.asarray(v, dtype=jnp.float32)
+                    for k, v in observed_sum_stat.items()}
+        self.history = History(db)
+        self.history.store_initial_data(
+            gt_model, meta_info or {}, observed_sum_stat, gt_par,
+            [m.name for m in self.models],
+            self.distance_function.to_json(), self.eps.to_json(),
+            self.population_strategy.to_json())
+        self._bind()
+        return self.history
+
+    def load(self, db: str, abc_id: int = 1) -> History:
+        """Resume a stored run (reference smc.py:355-389): observed stats
+        come back from the DB and the loop continues at max_t + 1."""
+        self.history = History(db, abc_id=abc_id)
+        self.x_0 = {k: jnp.asarray(v, dtype=jnp.float32)
+                    for k, v in self.history.observed_sum_stat().items()}
+        self._bind()
+        return self.history
+
+    def _bind(self):
+        self.spec = SumStatSpec.from_example(self.x_0)
+        self._obs_flat = self.spec.flatten_single(self.x_0)
+        self.distance_function.bind(self.spec, self.x_0)
+        self._kernel = RoundKernel(
+            models=self.models,
+            parameter_priors=self.parameter_priors,
+            model_prior_logits=self.model_prior_logits,
+            model_perturbation_kernel=self.model_perturbation_kernel,
+            transitions=self.transitions,
+            distance=self.distance_function,
+            acceptor=self.acceptor,
+            spec=self.spec,
+            obs_flat=self._obs_flat,
+            dim=self.dim)
+
+    # ------------------------------------------------------------------
+    # transition fitting with fixed-shape padding
+    # ------------------------------------------------------------------
+
+    def _pad_trans_params(self, params: dict, n_pad: int) -> dict:
+        # host-side numpy: padding is control plane, runs every generation
+        out = {}
+        for k, v in params.items():
+            if not hasattr(v, "shape") or np.ndim(v) == 0 or k in (
+                    "chol", "log_norm", "step_log_probs", "n_steps"):
+                out[k] = v
+                continue
+            v = np.asarray(v)
+            n = v.shape[0]
+            if n >= n_pad:
+                out[k] = v[:n_pad]
+                continue
+            pad_n = n_pad - n
+            if k == "log_w":
+                out[k] = np.concatenate(
+                    [v, np.full((pad_n,), -1e30, dtype=v.dtype)])
+            elif k == "chols":
+                eye = np.broadcast_to(
+                    np.eye(v.shape[-1], dtype=v.dtype),
+                    (pad_n,) + v.shape[1:])
+                out[k] = np.concatenate([v, eye])
+            else:
+                pad = [(0, pad_n)] + [(0, 0)] * (v.ndim - 1)
+                out[k] = np.pad(v, pad)
+        return out
+
+    def _dummy_trans_params(self, m: int, n_pad: int) -> dict:
+        dim_m = self.parameter_priors[m].dim
+        tr = self.transitions[m]
+        tr.fit(np.zeros((1, dim_m), dtype=np.float32),
+               np.ones((1,), dtype=np.float32))
+        return self._pad_trans_params(tr.get_params(), n_pad)
+
+    def _fit_transitions(self, t: int, population=None):
+        """KDE refit from the last generation (reference smc.py:1065-1079),
+        padded to the population size for shape stability.  The in-memory
+        population is used when at hand; the DB read only serves resume."""
+        if t == 0:
+            return
+        pop = (population if population is not None
+               else self.history.get_population(t - 1))
+        n_pad = len(pop)
+        m_arr = np.asarray(pop.m)
+        params = []
+        for m in range(self.M):
+            idx = np.nonzero(m_arr == m)[0]
+            if idx.size == 0:
+                params.append(self._dummy_trans_params(m, n_pad))
+                continue
+            dim_m = self.parameter_priors[m].dim
+            theta_m = np.asarray(pop.theta)[idx, :dim_m]
+            w_m = np.asarray(pop.weight)[idx]
+            self.transitions[m].fit(theta_m, w_m)
+            params.append(
+                self._pad_trans_params(self.transitions[m].get_params(),
+                                       n_pad))
+        self._trans_params = tuple(params)
+
+    def _adapt_population_size(self, t: int):
+        """reference smc.py:1042-1063."""
+        if t == 0:
+            return
+        probs = self._model_probabilities(t - 1)
+        alive = [m for m in range(self.M) if probs[m] > 0]
+        try:
+            self.population_strategy.update(
+                [self.transitions[m] for m in alive],
+                np.asarray([probs[m] for m in alive]), t=t)
+        except Exception as e:  # adaptive sizing must never kill a run
+            logger.warning("population size adaptation failed: %s", e)
+
+    def _model_probabilities(self, t: int) -> np.ndarray:
+        probs = np.zeros(self.M)
+        series = self.history.get_model_probabilities(t)
+        for m, p in series.items():
+            probs[int(m)] = float(p)
+        return probs
+
+    # ------------------------------------------------------------------
+    # calibration (reference smc.py:391-542)
+    # ------------------------------------------------------------------
+
+    def _calibrate(self, t0: int):
+        n = self.population_strategy(t0)
+        # draw the calibration sample from the prior, all accepted; the
+        # distance is bound (spec/x_0) but not yet data-calibrated, so the
+        # round's distances are provisional and recomputed below
+        params = {"distance": self.distance_function.get_params(t0),
+                  "acceptor": {}}
+
+        sample = self.sampler.sample_until_n_accepted(
+            n, self._kernel.prior_round, self._split(), params,
+            all_accepted=True)
+        pop = sample.get_accepted_population(n)
+        stats_flat = pop.sum_stats["__flat__"]
+
+        def get_stats_dict():
+            return self.spec.unflatten(stats_flat)
+
+        self.distance_function.initialize(
+            t0, get_stats_dict, self.x_0, self.spec)
+
+        # recompute calibration distances with the *initialized* distance
+        # (one device dispatch; result pulled to host for the control plane)
+        d0 = np.asarray(self.distance_function.compute(
+            jnp.asarray(stats_flat), self._obs_flat,
+            self.distance_function.get_params(t0)))
+        pop = Population(pop.m, pop.theta, pop.weight, d0, pop.sum_stats)
+
+        def get_weighted_distances():
+            return np.asarray(pop.distance), np.asarray(pop.weight)
+
+        self.acceptor.initialize(
+            t0, get_weighted_distances, self.distance_function, self.x_0)
+
+        # temperature schemes need per-candidate records; the calibration
+        # round records nothing (all_accepted), so build them from the
+        # calibration population (reference smc.py:434-449)
+        d0_np = np.asarray(d0)
+
+        def get_records():
+            return [{"distance": float(v), "transition_pd_prev": 1.0,
+                     "transition_pd": 1.0, "accepted": True} for v in d0_np]
+
+        self.eps.initialize(
+            t0, get_weighted_distances,
+            get_records,
+            self.max_nr_populations,
+            self.acceptor.get_epsilon_config(t0))
+
+        # persist calibration sample under PRE_TIME (reference smc.py:474-476)
+        self.history.append_population(
+            PRE_TIME, np.inf, pop, sample.nr_evaluations,
+            [m.name for m in self.models], self._param_names())
+        logger.info("Calibration sample t=-1 done (n=%d)", n)
+
+    def _initialize_from_history(self, t0: int):
+        """Resume: re-initialize the adaptive components from the last
+        stored generation (reference smc.py:454-542: the initial population
+        of a resumed run is loaded from the DB, smc.py:467-470)."""
+        pop = self.history.get_population(t0 - 1)
+
+        def get_weighted_distances():
+            return (np.asarray(pop.distance),
+                    np.asarray(pop.normalized_weights()))
+
+        get_stats = None
+        if "__flat__" in pop.sum_stats:
+            flat = pop.sum_stats["__flat__"]
+            get_stats = lambda: self.spec.unflatten(flat)  # noqa: E731
+        self.distance_function.initialize(
+            t0, get_stats, self.x_0, self.spec)
+        self.acceptor.initialize(
+            t0, get_weighted_distances, self.distance_function, self.x_0)
+        self.eps.initialize(
+            t0, get_weighted_distances, lambda: [],
+            self.max_nr_populations,
+            self.acceptor.get_epsilon_config(t0))
+
+    def _param_names(self) -> list:
+        return [list(p.get_parameter_names()) for p in self.parameter_priors]
+
+    # ------------------------------------------------------------------
+    # the master loop (reference smc.py:813-958)
+    # ------------------------------------------------------------------
+
+    def run(self,
+            minimum_epsilon: float = 0.0,
+            max_nr_populations: Union[int, float] = np.inf,
+            min_acceptance_rate: float = 0.0,
+            max_total_nr_simulations: Union[int, float] = np.inf) -> History:
+        if self.history is None:
+            raise RuntimeError("call new(db, observed) or load(db) first")
+        self.minimum_epsilon = minimum_epsilon
+        self.max_nr_populations = max_nr_populations
+        self.min_acceptance_rate = min_acceptance_rate
+
+        t0 = self.history.max_t + 1
+        self._fit_transitions(t0)
+        self._adapt_population_size(t0)
+        if t0 == 0:
+            self._calibrate(t0)
+        else:
+            self._initialize_from_history(t0)
+        self.distance_function.configure_sampler(self.sampler)
+        self.eps.configure_sampler(self.sampler)
+
+        t = t0
+        t_max = (t0 + max_nr_populations
+                 if np.isfinite(max_nr_populations) else np.inf)
+        total_sims = 0
+        while t < t_max:
+            current_eps = float(self.eps(t))
+
+            n = self.population_strategy(t)
+            max_eval = (n / min_acceptance_rate
+                        if min_acceptance_rate > 0 else np.inf)
+            params = {
+                "distance": self.distance_function.get_params(t),
+                "acceptor": self.acceptor.get_params(t, self.eps),
+            }
+            if t == 0:
+                round_fn = self._kernel.prior_round
+            else:
+                round_fn = self._kernel.generation_round
+                probs = self._model_probabilities(t - 1)
+                with np.errstate(divide="ignore"):
+                    params["model_log_probs"] = np.log(
+                        np.maximum(probs, 1e-300)).astype(np.float32)
+                params["transition"] = self._trans_params
+
+            logger.info("t: %d, eps: %.8g", t, current_eps)
+            sample = self.sampler.sample_until_n_accepted(
+                n, round_fn, self._split(), params, max_eval=max_eval)
+            if sample.n_accepted < n:
+                logger.info(
+                    "Stopping: acceptance rate fell below min_acceptance_rate"
+                    " (%d/%d accepted)", sample.n_accepted, n)
+                break
+            population = sample.get_accepted_population(n)
+            total_sims += sample.nr_evaluations
+            # ALL acceptances (incl. over-provisioned beyond n) so the
+            # rate is unbiased by the batch ladder's rounding
+            acceptance_rate = sample.acceptance_rate
+            ess = float(effective_sample_size(population.weight))
+            self.history.append_population(
+                t, current_eps, population, sample.nr_evaluations,
+                [m.name for m in self.models], self._param_names())
+            logger.info(
+                "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
+                t, acceptance_rate, ess, sample.nr_evaluations)
+
+            # ---- stopping criteria (reference smc.py:940-949) ------------
+            if (not isinstance(self.eps, TemperatureBase)
+                    and current_eps <= minimum_epsilon):
+                logger.info("Stopping: minimum epsilon reached")
+                break
+            if isinstance(self.eps, TemperatureBase) and current_eps <= 1.0:
+                logger.info("Stopping: temperature reached 1")
+                break
+            if (self.stop_if_only_single_model_alive
+                    and population.nr_of_models_alive() <= 1 and self.M > 1):
+                logger.info("Stopping: single model alive")
+                break
+            if acceptance_rate < min_acceptance_rate:
+                logger.info("Stopping: acceptance rate too low")
+                break
+            if total_sims >= max_total_nr_simulations:
+                logger.info("Stopping: simulation budget exhausted")
+                break
+            if t + 1 >= t_max:
+                break
+
+            self._prepare_next_iteration(
+                t + 1, sample, population, acceptance_rate)
+            t += 1
+
+        self.history.done()
+        return self.history
+
+    # ------------------------------------------------------------------
+    # per-generation adaptation (reference smc.py:960-1040)
+    # ------------------------------------------------------------------
+
+    def _prepare_next_iteration(self, t: int, sample: Sample,
+                                population: Population,
+                                acceptance_rate: float):
+        self._fit_transitions(t, population=population)
+        self._adapt_population_size(t)
+
+        def get_all_stats_dict():
+            flat = sample.get_all_stats()
+            return self.spec.unflatten(jnp.asarray(flat))
+
+        changed = self.distance_function.update(t, get_all_stats_dict)
+        if changed:
+            # re-evaluate population distances under the new distance for
+            # the epsilon update (reference smc.py:1009-1013)
+            new_params = self.distance_function.get_params(t)
+            population = population.update_distances(
+                lambda ss: self.distance_function.compute(
+                    ss["__flat__"], self._obs_flat, new_params))
+
+        def get_weighted_distances():
+            return (np.asarray(population.distance),
+                    np.asarray(population.normalized_weights()))
+
+        prev_temp = None
+        if isinstance(self.eps, TemperatureBase):
+            try:
+                prev_temp = float(self.eps(t - 1))
+            except Exception:
+                prev_temp = None
+        self.acceptor.update(t, get_weighted_distances, prev_temp,
+                             acceptance_rate)
+        self.eps.update(t, get_weighted_distances, sample.get_all_records,
+                        acceptance_rate, self.acceptor.get_epsilon_config(t))

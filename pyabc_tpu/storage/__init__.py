@@ -1,0 +1,6 @@
+"""Storage (parity: pyabc/storage/)."""
+
+from .history import PRE_TIME, History
+from .json import load_dict_from_json, save_dict_to_json
+
+__all__ = ["History", "PRE_TIME", "save_dict_to_json", "load_dict_from_json"]

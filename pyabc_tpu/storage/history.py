@@ -1,0 +1,299 @@
+"""History: durable generation-by-generation storage + resume.
+
+Parity: pyabc/storage/history.py (1799 LoC) + the ORM schema
+pyabc/storage/db_model.py:35-127 (ABCSMC -> Population -> Model -> Particle
+-> Parameter/Sample/SummaryStatistic).
+
+TPU re-design: the reference's row-per-particle ORM insert
+(history.py:617-693) is a known bottleneck at large N (SURVEY.md §7 hard
+part "DB write throughput at 1e6 particles/generation").  Here each
+(population, model) stores its particles as *array blobs* (float32
+theta/weight/distance matrices + the flattened sum-stat block) in stdlib
+sqlite3 — one INSERT per model per generation regardless of N, written
+straight from device arrays.  Row-level access for analysis/export is
+reconstructed on read (``get_distribution`` returns a pandas DataFrame like
+the reference's, history.py:269-330).
+
+The observed data, per-generation ε, sample counts and component configs
+are stored for full ``ABCSMC.load`` resume parity (reference
+smc.py:355-389; every generation is durable before the next starts,
+smc.py:921 / SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import datetime
+import io
+import json
+import os
+import sqlite3
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from ..population import Population
+
+PRE_TIME = -1  # calibration-sample time index (reference history.py:135)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS abc_smc (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    start_time TEXT,
+    json_parameters TEXT,
+    distance TEXT,
+    epsilon TEXT,
+    population_strategy TEXT
+);
+CREATE TABLE IF NOT EXISTS populations (
+    abc_smc_id INTEGER,
+    t INTEGER,
+    epsilon REAL,
+    nr_samples INTEGER,
+    population_end_time TEXT,
+    PRIMARY KEY (abc_smc_id, t)
+);
+CREATE TABLE IF NOT EXISTS model_populations (
+    abc_smc_id INTEGER,
+    t INTEGER,
+    m INTEGER,
+    name TEXT,
+    p_model REAL,
+    n_particles INTEGER,
+    theta BLOB,
+    weight BLOB,
+    distance BLOB,
+    stats BLOB,
+    param_names TEXT,
+    stat_spec TEXT,
+    PRIMARY KEY (abc_smc_id, t, m)
+);
+CREATE TABLE IF NOT EXISTS observed_data (
+    abc_smc_id INTEGER,
+    key TEXT,
+    value BLOB,
+    PRIMARY KEY (abc_smc_id, key)
+);
+"""
+
+
+def _pack(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _unpack(blob: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(blob), allow_pickle=False)
+
+
+class History:
+    """SQLite-backed run history.
+
+    ``db`` may be a path, ``"sqlite://"`` (in-memory, for benchmarking —
+    reference smc.py:272-277) or ``"sqlite:///path"``.
+    """
+
+    def __init__(self, db: str, abc_id: Optional[int] = None):
+        if db.startswith("sqlite:///"):
+            db = db[len("sqlite:///"):]
+        self.in_memory = db in ("sqlite://", ":memory:", "")
+        self.db_path = ":memory:" if self.in_memory else db
+        self._conn = sqlite3.connect(self.db_path)
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+        self.id = abc_id
+
+    # ---- run registration ------------------------------------------------
+
+    def store_initial_data(self, ground_truth_model: Optional[int],
+                           options: dict,
+                           observed_sum_stat: Dict,
+                           ground_truth_parameter: Optional[dict],
+                           model_names: List[str],
+                           distance_function_json: str = "{}",
+                           eps_function_json: str = "{}",
+                           population_strategy_json: str = "{}"):
+        """Register a new run (reference history.py:374-418)."""
+        cur = self._conn.execute(
+            "INSERT INTO abc_smc (start_time, json_parameters, distance,"
+            " epsilon, population_strategy) VALUES (?,?,?,?,?)",
+            (datetime.datetime.now().isoformat(),
+             json.dumps({"ground_truth_model": ground_truth_model,
+                         "model_names": model_names, **(options or {})}),
+             distance_function_json, eps_function_json,
+             population_strategy_json))
+        self.id = cur.lastrowid
+        for key, val in observed_sum_stat.items():
+            self._conn.execute(
+                "INSERT OR REPLACE INTO observed_data VALUES (?,?,?)",
+                (self.id, key, _pack(np.asarray(val, dtype=np.float32))))
+        self._conn.commit()
+        return self.id
+
+    def observed_sum_stat(self) -> Dict[str, np.ndarray]:
+        rows = self._conn.execute(
+            "SELECT key, value FROM observed_data WHERE abc_smc_id=?",
+            (self.id,)).fetchall()
+        return {k: _unpack(v) for k, v in rows}
+
+    # ---- append (the per-generation durable write) -----------------------
+
+    def append_population(self, t: int, current_epsilon: float,
+                          population: Population, nr_simulations: int,
+                          model_names: List[str],
+                          param_names: Optional[List[str]] = None):
+        """Bulk array-blob write (replaces reference history.py:617-693)."""
+        probs = np.asarray(population.get_model_probabilities(
+            nr_models=len(model_names)))
+        self._conn.execute(
+            "INSERT OR REPLACE INTO populations VALUES (?,?,?,?,?)",
+            (self.id, t, float(current_epsilon), int(nr_simulations),
+             datetime.datetime.now().isoformat()))
+        m_arr = np.asarray(population.m)
+        theta = np.asarray(population.theta)
+        w = np.asarray(population.weight)
+        d = np.asarray(population.distance)
+        stats = population.sum_stats.get("__flat__")
+        stats = np.asarray(stats) if stats is not None else None
+        per_model_names = (param_names
+                           and isinstance(param_names[0], (list, tuple)))
+        for m in range(len(model_names)):
+            idx = np.nonzero(m_arr == m)[0]
+            if idx.size == 0:
+                continue
+            names_m = (param_names[m] if per_model_names else param_names)
+            self._conn.execute(
+                "INSERT OR REPLACE INTO model_populations VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                (self.id, t, m, model_names[m], float(probs[m]),
+                 int(idx.size),
+                 _pack(theta[idx]), _pack(w[idx]), _pack(d[idx]),
+                 _pack(stats[idx]) if stats is not None else None,
+                 json.dumps(list(names_m or [])), None))
+        self._conn.commit()
+
+    # ---- queries (reference history.py:269-330, 732-780, 1004-1078) ------
+
+    @property
+    def max_t(self) -> int:
+        row = self._conn.execute(
+            "SELECT MAX(t) FROM populations WHERE abc_smc_id=? AND t>=0",
+            (self.id,)).fetchone()
+        return row[0] if row and row[0] is not None else -1
+
+    @property
+    def n_populations(self) -> int:
+        return self.max_t + 1
+
+    def alive_models(self, t: Optional[int] = None) -> List[int]:
+        t = self.max_t if t is None else t
+        rows = self._conn.execute(
+            "SELECT m FROM model_populations WHERE abc_smc_id=? AND t=? "
+            "AND p_model>0 ORDER BY m", (self.id, t)).fetchall()
+        return [r[0] for r in rows]
+
+    def get_model_probabilities(self, t: Optional[int] = None) -> pd.DataFrame:
+        if t is None:
+            rows = self._conn.execute(
+                "SELECT t, m, p_model FROM model_populations WHERE "
+                "abc_smc_id=? AND t>=0 ORDER BY t, m", (self.id,)).fetchall()
+            df = pd.DataFrame(rows, columns=["t", "m", "p"])
+            return df.pivot(index="t", columns="m", values="p").fillna(0.0)
+        rows = self._conn.execute(
+            "SELECT m, p_model FROM model_populations WHERE abc_smc_id=? "
+            "AND t=? ORDER BY m", (self.id, t)).fetchall()
+        probs = pd.Series({m: p for m, p in rows})
+        return probs
+
+    def get_distribution(self, m: int = 0, t: Optional[int] = None
+                         ) -> Tuple[pd.DataFrame, np.ndarray]:
+        """(parameter DataFrame, normalized weights) — reference
+        history.py:269-330."""
+        t = self.max_t if t is None else t
+        row = self._conn.execute(
+            "SELECT theta, weight, param_names FROM model_populations "
+            "WHERE abc_smc_id=? AND t=? AND m=?", (self.id, t, m)).fetchone()
+        if row is None:
+            return pd.DataFrame(), np.zeros(0)
+        theta, w = _unpack(row[0]), _unpack(row[1])
+        names = json.loads(row[2]) or [f"p{i}" for i in range(theta.shape[1])]
+        df = pd.DataFrame(theta[:, :len(names)], columns=names)
+        return df, w / w.sum()
+
+    def get_all_populations(self) -> pd.DataFrame:
+        rows = self._conn.execute(
+            "SELECT t, epsilon, nr_samples, population_end_time FROM "
+            "populations WHERE abc_smc_id=? ORDER BY t", (self.id,)).fetchall()
+        return pd.DataFrame(
+            rows, columns=["t", "epsilon", "samples", "population_end_time"])
+
+    def get_nr_particles_per_population(self) -> pd.Series:
+        rows = self._conn.execute(
+            "SELECT t, SUM(n_particles) FROM model_populations WHERE "
+            "abc_smc_id=? GROUP BY t ORDER BY t", (self.id,)).fetchall()
+        return pd.Series({t: n for t, n in rows})
+
+    def get_weighted_distances(self, t: Optional[int] = None) -> pd.DataFrame:
+        t = self.max_t if t is None else t
+        rows = self._conn.execute(
+            "SELECT distance, weight FROM model_populations WHERE "
+            "abc_smc_id=? AND t=?", (self.id, t)).fetchall()
+        ds = np.concatenate([_unpack(r[0]) for r in rows]) if rows else np.zeros(0)
+        ws = np.concatenate([_unpack(r[1]) for r in rows]) if rows else np.zeros(0)
+        return pd.DataFrame({"distance": ds, "w": ws / max(ws.sum(), 1e-300)})
+
+    def get_population(self, t: Optional[int] = None) -> Population:
+        """Reconstruct the dense Population (reference history.py:1004-1078)."""
+        t = self.max_t if t is None else t
+        rows = self._conn.execute(
+            "SELECT m, theta, weight, distance, stats FROM model_populations "
+            "WHERE abc_smc_id=? AND t=? ORDER BY m", (self.id, t)).fetchall()
+        ms, thetas, ws, ds, stats = [], [], [], [], []
+        dim = max((_unpack(r[1]).shape[1] for r in rows), default=0)
+        for m, tb, wb, db, sb in rows:
+            th = _unpack(tb)
+            n = th.shape[0]
+            if th.shape[1] < dim:
+                th = np.pad(th, ((0, 0), (0, dim - th.shape[1])))
+            ms.append(np.full(n, m, dtype=np.int32))
+            thetas.append(th)
+            ws.append(_unpack(wb))
+            ds.append(_unpack(db))
+            if sb is not None:
+                stats.append(_unpack(sb))
+        # numpy arrays: resumed populations feed host-side fits/quantiles
+        sum_stats = ({"__flat__": np.concatenate(stats)}
+                     if stats and len(stats) == len(rows) else {})
+        return Population(
+            m=np.concatenate(ms),
+            theta=np.concatenate(thetas),
+            weight=np.concatenate(ws),
+            distance=np.concatenate(ds),
+            sum_stats=sum_stats)
+
+    def get_population_strategy(self) -> dict:
+        row = self._conn.execute(
+            "SELECT population_strategy FROM abc_smc WHERE id=?",
+            (self.id,)).fetchone()
+        return json.loads(row[0]) if row and row[0] else {}
+
+    def all_runs(self) -> pd.DataFrame:
+        rows = self._conn.execute(
+            "SELECT id, start_time FROM abc_smc").fetchall()
+        return pd.DataFrame(rows, columns=["id", "start_time"])
+
+    def model_names(self) -> List[str]:
+        row = self._conn.execute(
+            "SELECT json_parameters FROM abc_smc WHERE id=?",
+            (self.id,)).fetchone()
+        if row is None:
+            return []
+        return json.loads(row[0]).get("model_names", [])
+
+    def done(self):
+        self._conn.commit()
+
+    def close(self):
+        self._conn.close()

@@ -1,0 +1,39 @@
+"""Side-channel JSON logs for adaptive-component trajectories.
+
+Parity: pyabc/storage/json.py:6-23 (``save_dict_to_json`` used by adaptive
+distances, temperature schemes and pdf norms for provenance not in the DB).
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+import os
+
+
+def _sanitize(obj):
+    if isinstance(obj, dict):
+        return {str(k): _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    if isinstance(obj, numbers.Number):
+        return float(obj)
+    if hasattr(obj, "tolist"):
+        return _sanitize(obj.tolist())
+    return obj
+
+
+def save_dict_to_json(dct: dict, log_file: str):
+    tmp = f"{log_file}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(_sanitize(dct), f)
+    os.replace(tmp, log_file)
+
+
+def load_dict_from_json(log_file: str, key_type=int) -> dict:
+    with open(log_file) as f:
+        raw = json.load(f)
+    try:
+        return {key_type(k): v for k, v in raw.items()}
+    except (ValueError, TypeError):
+        return raw

@@ -1,0 +1,105 @@
+"""Summary-statistic handling: named pytrees <-> dense ``[N, S]`` blocks.
+
+The reference passes summary statistics as per-particle dicts of scalars or
+arrays (pyabc/model.py:114-116, distance/distance.py:92-103 iterates dict
+keys in Python).  On TPU, distances are computed for the whole population at
+once, so sum-stats live as a dict of batched arrays ``{key: Array[N, ...]}``
+and are flattened once into a dense ``f32[N, S]`` block for the distance
+kernels.  ``SumStatSpec`` fixes the (sorted) key order and per-key sizes so
+per-key weights broadcast to per-component weight vectors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+class SumStatSpec:
+    """Fixed ordering/shapes of summary-statistic keys.
+
+    Keys are sorted alphabetically (the reference iterates sorted weight
+    keys, distance/distance.py:255-258, so ordering is deterministic there
+    too).
+    """
+
+    def __init__(self, shapes: Mapping[str, Tuple[int, ...]]):
+        self.keys: tuple = tuple(sorted(shapes.keys()))
+        self.shapes: Dict[str, Tuple[int, ...]] = {
+            k: tuple(shapes[k]) for k in self.keys
+        }
+        self.sizes: Dict[str, int] = {
+            k: int(np.prod(self.shapes[k], dtype=int)) for k in self.keys
+        }
+        offsets = np.cumsum([0] + [self.sizes[k] for k in self.keys])
+        self.offsets: Dict[str, int] = {
+            k: int(offsets[i]) for i, k in enumerate(self.keys)
+        }
+        self.total_size: int = int(offsets[-1])
+
+    @classmethod
+    def from_example(cls, x: Mapping[str, Array], batched: bool = False) -> "SumStatSpec":
+        """Infer the spec from one observed dict (or a batched dict)."""
+        shapes = {}
+        for k, v in x.items():
+            v = jnp.asarray(v)
+            shapes[k] = tuple(v.shape[1:]) if batched else tuple(jnp.shape(v))
+        return cls(shapes)
+
+    # ---- flattening ------------------------------------------------------
+
+    def flatten(self, x: Mapping[str, Array]) -> Array:
+        """``{key: [N, ...]} -> f32[N, S]`` (jit-safe)."""
+        parts = []
+        for k in self.keys:
+            v = jnp.asarray(x[k], dtype=jnp.float32)
+            n = v.shape[0]
+            parts.append(v.reshape(n, -1))
+        return jnp.concatenate(parts, axis=-1)
+
+    def flatten_single(self, x0: Mapping[str, Array]) -> Array:
+        """``{key: [...]} -> f32[S]`` for the observed data (jit-safe)."""
+        parts = [
+            jnp.asarray(x0[k], dtype=jnp.float32).reshape(-1) for k in self.keys
+        ]
+        return jnp.concatenate(parts, axis=-1)
+
+    def unflatten(self, flat: Array) -> Dict[str, Array]:
+        """``f32[..., S] -> {key: [..., *shape]}``."""
+        out = {}
+        for k in self.keys:
+            o, s = self.offsets[k], self.sizes[k]
+            out[k] = flat[..., o:o + s].reshape(flat.shape[:-1] + self.shapes[k])
+        return out
+
+    def expand_key_values(self, per_key: Mapping[str, float],
+                          default: float = 1.0) -> np.ndarray:
+        """Per-key scalars -> per-component ``f32[S]`` vector.
+
+        This is how the reference's per-key weight dicts
+        (distance/distance.py:60-78) map onto the dense block.
+        """
+        vec = np.full(self.total_size, default, dtype=np.float32)
+        for k, val in per_key.items():
+            if k not in self.offsets:
+                raise KeyError(f"unknown sum-stat key {k!r}; have {self.keys}")
+            o, s = self.offsets[k], self.sizes[k]
+            vec[o:o + s] = np.asarray(val, dtype=np.float32).reshape(-1)
+        return vec
+
+    def collapse_to_keys(self, vec: np.ndarray) -> Dict[str, np.ndarray]:
+        """Per-component vector -> per-key arrays (for logging/provenance)."""
+        vec = np.asarray(vec)
+        return {
+            k: vec[self.offsets[k]:self.offsets[k] + self.sizes[k]].reshape(
+                self.shapes[k] if self.shapes[k] else ()
+            )
+            for k in self.keys
+        }
+
+    def __repr__(self):
+        return f"SumStatSpec({self.shapes})"

@@ -1,0 +1,21 @@
+"""Perturbation kernels / proposal transitions (parity: pyabc/transition/)."""
+
+from .base import AggregatedTransition, NotFittedError, Transition
+from .local_transition import LocalTransition
+from .model_selection import GridSearchCV
+from .multivariatenormal import (
+    MultivariateNormalTransition,
+    scott_rule_of_thumb,
+    silverman_rule_of_thumb,
+    smart_cov,
+)
+from .predict_population_size import predict_population_size
+from .randomwalk import DiscreteRandomWalkTransition
+
+__all__ = [
+    "Transition", "NotFittedError", "AggregatedTransition",
+    "MultivariateNormalTransition", "LocalTransition",
+    "DiscreteRandomWalkTransition", "GridSearchCV",
+    "silverman_rule_of_thumb", "scott_rule_of_thumb", "smart_cov",
+    "predict_population_size",
+]

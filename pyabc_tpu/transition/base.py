@@ -1,0 +1,196 @@
+"""Transition (perturbation-kernel) base contract.
+
+Parity: pyabc/transition/base.py:15-185 — ``fit(X, w)`` / ``rvs`` / ``pdf``
+plus the bootstrap KDE-uncertainty machinery ``mean_cv`` /
+``required_nr_samples`` used by adaptive population sizing.
+
+TPU split (see SURVEY.md §7): ``fit`` runs once per (generation, model) on
+the host but its math is jnp; the fitted state is exposed as a *params
+pytree* (``get_params()``) consumed by the pure static kernels
+``rvs_from_params`` / ``log_pdf_from_params`` which are traced into the
+compiled per-generation sampling round.  Dynamic values (support points,
+weights, covariance cholesky) are passed as traced arguments so refits never
+recompile.
+
+The reference's ``TransitionMeta`` (transitionmeta.py:8-62) auto-handles the
+zero-parameter case and weight renormalization; here that logic lives in
+:meth:`Transition.fit` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+class Transition:
+    """Abstract perturbation kernel over parameter space."""
+
+    def __init__(self):
+        self.theta: Optional[Array] = None   # support [N, D]
+        self.w: Optional[Array] = None       # normalized weights [N]
+        self._fitted = False
+
+    # ---- host lifecycle --------------------------------------------------
+
+    def fit(self, theta: Array, w: Array):
+        """Fit from weighted particles ``theta[N, D]``, ``w[N]``.
+
+        numpy inputs are fitted on the host (the control-plane path used
+        by the orchestrator: zero device dispatches per refit); jax inputs
+        stay on device.
+        """
+        if isinstance(theta, np.ndarray):
+            theta = np.atleast_2d(np.asarray(theta, dtype=np.float32))
+            w = np.asarray(w, dtype=np.float32)
+        else:
+            theta = jnp.atleast_2d(jnp.asarray(theta, dtype=jnp.float32))
+            w = jnp.asarray(w, dtype=jnp.float32)
+        w = w / w.sum()
+        self.theta, self.w = theta, w
+        self._fitted = True
+        if theta.shape[-1] > 0:
+            self._fit(theta, w)
+        return self
+
+    def _fit(self, theta: Array, w: Array):
+        raise NotImplementedError
+
+    def get_params(self) -> dict:
+        """Fitted state as a pytree for the compiled sampling round."""
+        raise NotImplementedError
+
+    # ---- pure device kernels --------------------------------------------
+
+    @staticmethod
+    def rvs_from_params(key, params: dict, n: int) -> Array:
+        raise NotImplementedError
+
+    @staticmethod
+    def log_pdf_from_params(x: Array, params: dict) -> Array:
+        raise NotImplementedError
+
+    def static_fns(self):
+        """(rvs_from_params, log_pdf_from_params) with stable identity, for
+        closing into the compiled round.  Wrappers (GridSearchCV) override
+        to delegate to their base estimator's class."""
+        return (type(self).rvs_from_params, type(self).log_pdf_from_params)
+
+    # ---- eager convenience (reference API parity) ------------------------
+
+    def rvs(self, key, size: Optional[int] = None) -> Array:
+        self._check_fitted()
+        n = 1 if size is None else size
+        if self.theta.shape[-1] == 0:
+            out = jnp.zeros((n, 0))
+        else:
+            out = self.rvs_from_params(key, self.get_params(), n)
+        return out[0] if size is None else out
+
+    def log_pdf(self, x: Array) -> Array:
+        self._check_fitted()
+        x = jnp.asarray(x, dtype=jnp.float32)
+        single = x.ndim == 1
+        x2 = jnp.atleast_2d(x)
+        if self.theta.shape[-1] == 0:
+            out = jnp.zeros(x2.shape[0])
+        else:
+            out = self.log_pdf_from_params(x2, self.get_params())
+        return out[0] if single else out
+
+    def pdf(self, x: Array) -> Array:
+        return jnp.exp(self.log_pdf(x))
+
+    def _check_fitted(self):
+        if not self._fitted:
+            raise NotFittedError(type(self).__name__)
+
+    # ---- bootstrap KDE uncertainty (reference base.py:121-185) ----------
+
+    def mean_cv(self, key, n_samples: Optional[int] = None,
+                n_bootstrap: int = 5, test_points: Optional[Array] = None
+                ) -> float:
+        """Mean coefficient of variation of the fitted density over test
+        points, estimated by refitting on multinomial bootstrap resamples
+        (reference base.py:121-169; cv/bootstrap.py:43-110).
+
+        Vectorized: all bootstrap refits and density evaluations run as one
+        batched program per replicate.
+        """
+        self._check_fitted()
+        n = int(self.theta.shape[0]) if n_samples is None else int(n_samples)
+        test = self.theta if test_points is None else test_points
+        densities = []
+        for i in range(n_bootstrap):
+            key, k1, k2 = jax.random.split(key, 3)
+            idx = jax.random.choice(k1, self.theta.shape[0], (n,), p=self.w)
+            boot = type(self)()
+            # carry over hyperparameters
+            boot.__dict__.update({k: v for k, v in self.__dict__.items()
+                                  if k not in ("theta", "w", "_fitted")})
+            boot.fit(self.theta[idx], jnp.ones(n))
+            densities.append(boot.pdf(test))
+        dens = jnp.stack(densities)  # [B, M]
+        cv = jnp.std(dens, axis=0) / jnp.maximum(jnp.mean(dens, axis=0), 1e-30)
+        return float(jnp.sum(self.w * cv))
+
+    def required_nr_samples(self, key, coefficient_of_variation: float,
+                            n_bootstrap: int = 5) -> int:
+        """Predict the population size achieving a target CV via power-law
+        extrapolation (reference base.py:171-185,
+        transition/predict_population_size.py:11-60)."""
+        from .predict_population_size import predict_population_size
+        cvs = {}
+        current = int(self.theta.shape[0])
+        for n in sorted({max(current // 4, 8), max(current // 2, 8), current}):
+            key, sub = jax.random.split(key)
+            cvs[n] = self.mean_cv(sub, n_samples=n, n_bootstrap=n_bootstrap)
+        return predict_population_size(cvs, coefficient_of_variation)
+
+
+class NotFittedError(Exception):
+    """Raised when rvs/pdf is called before fit (reference base.py:10-13)."""
+
+
+class AggregatedTransition(Transition):
+    """Map disjoint parameter blocks to separate sub-transitions.
+
+    TPU equivalent of composing transitions over parameter subsets: each
+    sub-transition handles a contiguous column slice of theta.
+    """
+
+    def __init__(self, mapping: dict):
+        """``mapping: {(start, stop): Transition}`` over theta columns."""
+        super().__init__()
+        self.mapping = dict(mapping)
+
+    def _fit(self, theta, w):
+        for (a, b), sub in self.mapping.items():
+            sub.fit(theta[:, a:b], w)
+
+    def get_params(self):
+        return {f"{a}:{b}": sub.get_params()
+                for (a, b), sub in self.mapping.items()}
+
+    def rvs(self, key, size: Optional[int] = None):
+        self._check_fitted()
+        n = 1 if size is None else size
+        keys = jax.random.split(key, len(self.mapping))
+        cols = []
+        for k, ((a, b), sub) in zip(keys, self.mapping.items()):
+            cols.append(jnp.atleast_2d(sub.rvs(k, n)))
+        out = jnp.concatenate(cols, axis=-1)
+        return out[0] if size is None else out
+
+    def log_pdf(self, x: Array) -> Array:
+        self._check_fitted()
+        x2 = jnp.atleast_2d(jnp.asarray(x, dtype=jnp.float32))
+        total = jnp.zeros(x2.shape[0])
+        for (a, b), sub in self.mapping.items():
+            total = total + sub.log_pdf(x2[:, a:b])
+        return total[0] if jnp.ndim(x) == 1 else total

@@ -1,0 +1,118 @@
+"""Local (k-NN covariance) KDE transition à la Filippi et al.
+
+Parity: pyabc/transition/local_transition.py:13-145 — per-particle local
+covariances estimated from the k nearest neighbors; proposal mixes
+per-particle Gaussians; pdf via batched Mahalanobis (the reference's einsum,
+local_transition.py:120-135).
+
+TPU twist: the reference uses a host cKDTree; here neighbor search is a
+chunked pairwise-distance + ``lax.top_k`` pass on device — O(N²·D) matmul
+work that maps straight onto the MXU, no tree, no host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.linalg import solve_triangular
+
+from .base import Transition
+
+Array = jnp.ndarray
+
+_CHUNK = 1024
+
+
+class LocalTransition(Transition):
+    """KDE with per-particle local covariances (reference default k ≈ N/4,
+    ``scaling=1.0`` — local_transition.py:36-58)."""
+
+    def __init__(self, k: Optional[int] = None, k_fraction: float = 0.25,
+                 scaling: float = 1.0):
+        super().__init__()
+        self.k = k
+        self.k_fraction = float(k_fraction)
+        self.scaling = float(scaling)
+        self._chols: Optional[Array] = None      # [N, D, D]
+        self._log_norms: Optional[Array] = None  # [N]
+
+    def _fit(self, theta: Array, w: Array):
+        n, d = theta.shape
+        k = self.k if self.k is not None else max(int(self.k_fraction * n), d + 1)
+        k = min(max(k, d + 1), n)
+
+        def neighbors(chunk_x: Array) -> Array:  # [C, D] -> [C, k]
+            d2 = jnp.sum((chunk_x[:, None, :] - theta[None, :, :]) ** 2, -1)
+            _, idx = lax.top_k(-d2, k)
+            return idx
+
+        if n <= _CHUNK:
+            nbr = neighbors(theta)
+        else:
+            n_chunks = -(-n // _CHUNK)
+            pad = n_chunks * _CHUNK - n
+            xp = jnp.pad(theta, ((0, pad), (0, 0))).reshape(n_chunks, _CHUNK, d)
+            nbr = lax.map(neighbors, xp).reshape(-1, k)[:n]
+
+        # per-particle weighted covariance over the k neighbors
+        nb_theta = theta[nbr]                  # [N, k, D]
+        nb_w = w[nbr]
+        nb_w = nb_w / jnp.sum(nb_w, axis=1, keepdims=True)
+        mean = jnp.sum(nb_theta * nb_w[..., None], axis=1, keepdims=True)
+        cent = nb_theta - mean
+        cov = jnp.einsum("nkd,nke,nk->nde", cent, cent, nb_w,
+                         precision=lax.Precision.HIGHEST) * self.scaling
+        cov = cov + 1e-6 * jnp.eye(d) * jnp.maximum(
+            jnp.trace(cov, axis1=1, axis2=2)[:, None, None] / d, 1e-8)
+        self._chols = jnp.linalg.cholesky(cov)
+        self._log_norms = (
+            -0.5 * d * jnp.log(2 * jnp.pi)
+            - jnp.sum(jnp.log(jnp.diagonal(self._chols, axis1=1, axis2=2)),
+                      axis=1)
+        )
+
+    def get_params(self) -> dict:
+        return {
+            "support": self.theta,
+            "log_w": jnp.log(jnp.maximum(self.w, 1e-38)),
+            "chols": self._chols,
+            "log_norms": self._log_norms,
+        }
+
+    @staticmethod
+    def rvs_from_params(key, params: dict, n: int) -> Array:
+        k1, k2 = jax.random.split(key)
+        support, log_w = params["support"], params["log_w"]
+        idx = jax.random.categorical(k1, log_w, shape=(n,))
+        noise = jax.random.normal(k2, (n, support.shape[-1]),
+                                  dtype=support.dtype)
+        chols = params["chols"][idx]           # [n, D, D]
+        return support[idx] + jnp.einsum("nde,ne->nd", chols, noise)
+
+    @staticmethod
+    def log_pdf_from_params(x: Array, params: dict, chunk: int = _CHUNK
+                            ) -> Array:
+        support, log_w = params["support"], params["log_w"]
+        chols, log_norms = params["chols"], params["log_norms"]
+        m, d = x.shape
+        n = support.shape[0]
+
+        def chunk_logpdf(xc):
+            diff = xc[:, None, :] - support[None, :, :]  # [C, N, D]
+            z = jax.vmap(
+                lambda L, v: solve_triangular(L, v.T, lower=True).T,
+                in_axes=(0, 1), out_axes=1,
+            )(chols, diff)                               # [C, N, D]
+            maha = jnp.sum(z**2, axis=-1)
+            comp = log_w[None, :] - 0.5 * maha + log_norms[None, :]
+            return jax.scipy.special.logsumexp(comp, axis=-1)
+
+        if m <= chunk:
+            return chunk_logpdf(x)
+        n_chunks = -(-m // chunk)
+        pad = n_chunks * chunk - m
+        xp = jnp.pad(x, ((0, pad), (0, 0))).reshape(n_chunks, chunk, d)
+        return lax.map(chunk_logpdf, xp).reshape(-1)[:m]

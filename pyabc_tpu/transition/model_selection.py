@@ -1,0 +1,71 @@
+"""Hyperparameter search for transitions.
+
+Parity: pyabc/transition/model_selection.py:9-74 (``GridSearchCV`` adapter
+around sklearn): pick the transition hyperparameters (e.g. KDE ``scaling``)
+minimizing the bootstrap CV of the density estimate.  Implemented directly
+(no sklearn dependency): exhaustive grid over constructor kwargs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional, Sequence
+
+import jax
+
+from .base import Transition
+
+
+class GridSearchCV(Transition):
+    """Fit every grid point, keep the one with the lowest mean CV."""
+
+    def __init__(self, base: Optional[Transition] = None,
+                 param_grid: Optional[Dict[str, Sequence]] = None,
+                 n_bootstrap: int = 3, seed: int = 0):
+        super().__init__()
+        if base is None:
+            from .multivariatenormal import MultivariateNormalTransition
+            base = MultivariateNormalTransition()
+        if param_grid is None:
+            param_grid = {"scaling": [0.25, 0.5, 1.0, 2.0]}
+        self.base = base
+        self.param_grid = dict(param_grid)
+        self.n_bootstrap = int(n_bootstrap)
+        self.seed = seed
+        self.best_params_: Optional[dict] = None
+        self.best_estimator_: Optional[Transition] = None
+
+    def _fit(self, theta, w):
+        key = jax.random.PRNGKey(self.seed)
+        names = list(self.param_grid)
+        best_cv, best = float("inf"), None
+        for combo in itertools.product(*(self.param_grid[n] for n in names)):
+            params = dict(zip(names, combo))
+            cand = type(self.base)(**{**self._base_kwargs(), **params})
+            cand.fit(theta, w)
+            key, sub = jax.random.split(key)
+            cv = cand.mean_cv(sub, n_bootstrap=self.n_bootstrap)
+            if cv < best_cv:
+                best_cv, best, self.best_params_ = cv, cand, params
+        self.best_estimator_ = best
+
+    def _base_kwargs(self) -> dict:
+        return {k: v for k, v in self.base.__dict__.items()
+                if k not in ("theta", "w", "_fitted") and not k.startswith("_")}
+
+    def get_params(self):
+        return self.best_estimator_.get_params()
+
+    def rvs(self, key, size=None):
+        self._check_fitted()
+        return self.best_estimator_.rvs(key, size)
+
+    def log_pdf(self, x):
+        self._check_fitted()
+        return self.best_estimator_.log_pdf(x)
+
+    def static_fns(self):
+        # the grid varies hyperparameters, not the estimator class, so the
+        # base type's kernels are stable even before the first fit
+        cls = type(self.base)
+        return (cls.rvs_from_params, cls.log_pdf_from_params)

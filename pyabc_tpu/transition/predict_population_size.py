@@ -1,0 +1,40 @@
+"""Predict the population size achieving a target KDE CV.
+
+Parity: pyabc/transition/predict_population_size.py:11-60 +
+pyabc/cv/powerlaw.py:13-17 — fit cv(n) = a·n^b from bootstrap estimates and
+invert for the target cv.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def fit_powerlaw(ns, cvs):
+    """Least-squares fit of log cv = log a + b log n (cv/powerlaw.py:13-17)."""
+    ns = np.asarray(ns, dtype=np.float64)
+    cvs = np.maximum(np.asarray(cvs, dtype=np.float64), 1e-12)
+    A = np.stack([np.ones_like(ns), np.log(ns)], axis=1)
+    coef, *_ = np.linalg.lstsq(A, np.log(cvs), rcond=None)
+    log_a, b = coef
+    return np.exp(log_a), b
+
+
+def predict_population_size(cv_estimates: Dict[int, float],
+                            target_cv: float,
+                            min_size: int = 8,
+                            max_size: int = 10**7) -> int:
+    """Invert the fitted power law at ``target_cv``."""
+    ns = list(cv_estimates.keys())
+    cvs = [cv_estimates[n] for n in ns]
+    if len(ns) < 2:
+        return int(ns[0]) if ns else min_size
+    a, b = fit_powerlaw(ns, cvs)
+    if b >= 0:  # cv not decreasing in n: keep current size
+        return int(max(ns))
+    n_req = (target_cv / a) ** (1.0 / b)
+    if not np.isfinite(n_req):
+        return int(max(ns))
+    return int(np.clip(n_req, min_size, max_size))

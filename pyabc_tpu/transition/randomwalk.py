@@ -1,0 +1,67 @@
+"""Discrete grid random-walk transition for integer parameters.
+
+Parity: pyabc/transition/randomwalk.py:9-136 (``DiscreteRandomWalkTransition``):
+a perturbed particle is a weighted-resampled support particle plus an
+integer step per dimension.  pmf of a query = Σᵢ wᵢ · Πd p(step = x_d − X_id)
+— fully batched here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Transition
+
+Array = jnp.ndarray
+
+
+class DiscreteRandomWalkTransition(Transition):
+    def __init__(self, n_steps: int = 1, p_stay: float = 0.5):
+        """Steps are drawn uniformly from {-n_steps..n_steps}\\{0} with total
+        probability 1 - p_stay, else stay."""
+        super().__init__()
+        self.n_steps = int(n_steps)
+        self.p_stay = float(p_stay)
+
+    def _fit(self, theta, w):
+        pass  # nothing beyond support + weights
+
+    def _step_log_probs(self) -> Array:
+        """log p(step) over offsets [-n_steps .. n_steps]."""
+        n_off = 2 * self.n_steps + 1
+        p_move = (1.0 - self.p_stay) / (n_off - 1)
+        probs = jnp.full((n_off,), p_move)
+        probs = probs.at[self.n_steps].set(self.p_stay)
+        return jnp.log(probs)
+
+    def get_params(self) -> dict:
+        return {
+            "support": self.theta,
+            "log_w": jnp.log(jnp.maximum(self.w, 1e-38)),
+            "step_log_probs": self._step_log_probs(),
+            "n_steps": self.n_steps,
+        }
+
+    @staticmethod
+    def rvs_from_params(key, params: dict, n: int) -> Array:
+        k1, k2 = jax.random.split(key)
+        support, log_w = params["support"], params["log_w"]
+        n_steps = params["n_steps"]
+        idx = jax.random.categorical(k1, log_w, shape=(n,))
+        steps = jax.random.categorical(
+            k2, params["step_log_probs"],
+            shape=(n, support.shape[-1])) - n_steps
+        return support[idx] + steps.astype(support.dtype)
+
+    @staticmethod
+    def log_pdf_from_params(x: Array, params: dict) -> Array:
+        support, log_w = params["support"], params["log_w"]
+        slp = params["step_log_probs"]
+        n_steps = params["n_steps"]
+        diff = jnp.round(x[:, None, :] - support[None, :, :]).astype(jnp.int32)
+        in_range = jnp.abs(diff) <= n_steps
+        idx = jnp.clip(diff + n_steps, 0, slp.shape[0] - 1)
+        per_dim = jnp.where(in_range, slp[idx], -jnp.inf)
+        comp = log_w[None, :] + jnp.sum(per_dim, axis=-1)
+        return jax.scipy.special.logsumexp(comp, axis=-1)

@@ -1,0 +1,5 @@
+"""Web UI for browsing History DBs (parity: pyabc/visserver/)."""
+
+from .server import run_app
+
+__all__ = ["run_app"]

@@ -1,0 +1,139 @@
+"""Web visualization server (parity: pyabc/visserver/server.py:198-202).
+
+The reference serves a Flask+Bokeh UI over a History DB (routes
+``/abc/<id>``, ``/abc/<id>/model/<m>/t/<t>``).  Flask/Bokeh are not in this
+image, so the same routes are served with the stdlib ``http.server`` and
+matplotlib-rendered PNGs — zero extra dependencies, same capability:
+browse runs, populations, model probabilities, posterior KDEs.
+
+Run: ``python -m pyabc_tpu.visserver.server --db abc.db --port 8765``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from ..storage.history import History
+
+_PAGE = """<!doctype html><html><head><title>pyabc_tpu</title>
+<style>body{{font-family:sans-serif;margin:2em}}img{{max-width:45em}}</style>
+</head><body>{body}</body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    db_path: str = ""
+
+    def _send(self, content, ctype="text/html"):
+        data = content if isinstance(content, bytes) else content.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        try:
+            self._route()
+        except Exception as e:  # pragma: no cover - defensive
+            self._send(_PAGE.format(body=f"<pre>error: {e}</pre>"))
+
+    def _route(self):
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if not parts:
+            return self._index()
+        if parts[0] == "abc" and len(parts) == 2:
+            return self._run(int(parts[1]))
+        if (parts[0] == "abc" and len(parts) == 6 and parts[2] == "model"
+                and parts[4] == "t"):
+            return self._population(int(parts[1]), int(parts[3]),
+                                    int(parts[5]))
+        if parts[0] == "plot" and len(parts) == 4:
+            return self._kde_png(int(parts[1]), int(parts[2]), int(parts[3]))
+        self._send(_PAGE.format(body="<p>not found</p>"))
+
+    def _index(self):
+        h = History(self.db_path, abc_id=1)
+        runs = h.all_runs()
+        rows = "".join(
+            f'<li><a href="/abc/{r.id}">run {r.id}</a> ({r.start_time})</li>'
+            for r in runs.itertuples())
+        self._send(_PAGE.format(body=f"<h1>ABC runs</h1><ul>{rows}</ul>"))
+
+    def _run(self, abc_id: int):
+        h = History(self.db_path, abc_id=abc_id)
+        pops = h.get_all_populations()
+        probs = h.get_model_probabilities()
+        links = "".join(
+            f'<li><a href="/abc/{abc_id}/model/{m}/t/{h.max_t}">'
+            f"model {m} @ t={h.max_t}</a></li>"
+            for m in h.alive_models())
+        self._send(_PAGE.format(body=(
+            f"<h1>run {abc_id}</h1><h2>populations</h2>"
+            f"{pops.to_html(index=False)}"
+            f"<h2>model probabilities</h2>{probs.to_html()}"
+            f"<h2>posteriors</h2><ul>{links}</ul>")))
+
+    def _population(self, abc_id: int, m: int, t: int):
+        h = History(self.db_path, abc_id=abc_id)
+        df, w = h.get_distribution(m=m, t=t)
+        imgs = "".join(
+            f'<h3>{name}</h3><img src="/plot/{abc_id}/{m}/{t}?{i}">'
+            for i, name in enumerate(df.columns))
+        self._send(_PAGE.format(body=(
+            f"<h1>run {abc_id} / model {m} / t={t}</h1>"
+            f"<p>{len(df)} particles</p>"
+            f'<img src="/plot/{abc_id}/{m}/{t}">')))
+
+    def _kde_png(self, abc_id: int, m: int, t: int):
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        from ..visualization import plot_kde_1d, plot_kde_matrix
+
+        h = History(self.db_path, abc_id=abc_id)
+        df, w = h.get_distribution(m=m, t=t)
+        if len(df.columns) == 1:
+            ax = plot_kde_1d(df, w, df.columns[0])
+            fig = ax.figure
+        else:
+            axes = plot_kde_matrix(df, w)
+            fig = axes[0][0].figure
+        buf = io.BytesIO()
+        fig.savefig(buf, format="png", dpi=80)
+        plt.close(fig)
+        self._send(buf.getvalue(), ctype="image/png")
+
+
+def run_app(db: str, port: int = 8765, host: str = "127.0.0.1",
+            blocking: bool = True):
+    """Start the server (reference visserver/server.py:198-202)."""
+    _Handler.db_path = db
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    if blocking:
+        print(f"serving {db} on http://{host}:{port}")
+        httpd.serve_forever()
+    return httpd
+
+
+def main():
+    import click
+
+    @click.command("abc-server")
+    @click.option("--db", required=True)
+    @click.option("--port", default=8765, type=int)
+    @click.option("--host", default="127.0.0.1")
+    def cli(db, port, host):
+        run_app(db, port, host)
+
+    cli()
+
+
+if __name__ == "__main__":
+    main()

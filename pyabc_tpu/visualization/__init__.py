@@ -1,0 +1,23 @@
+"""Visualization (parity: pyabc/visualization/, matplotlib-based)."""
+
+from .kde import kde_1d, kde_2d, plot_kde_1d, plot_kde_2d, plot_kde_matrix
+from .run_plots import (
+    plot_acceptance_rates_trajectory,
+    plot_credible_intervals,
+    plot_data_callback,
+    plot_effective_sample_sizes,
+    plot_epsilons,
+    plot_histogram_1d,
+    plot_histogram_2d,
+    plot_model_probabilities,
+    plot_sample_numbers,
+    plot_total_sample_numbers,
+)
+
+__all__ = [
+    "kde_1d", "kde_2d", "plot_kde_1d", "plot_kde_2d", "plot_kde_matrix",
+    "plot_epsilons", "plot_sample_numbers", "plot_total_sample_numbers",
+    "plot_acceptance_rates_trajectory", "plot_model_probabilities",
+    "plot_effective_sample_sizes", "plot_credible_intervals",
+    "plot_histogram_1d", "plot_histogram_2d", "plot_data_callback",
+]

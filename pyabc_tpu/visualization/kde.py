@@ -1,0 +1,110 @@
+"""Posterior KDE plots (parity: pyabc/visualization/kde.py:19-515).
+
+The density grids are evaluated with the same on-device weighted-KDE kernel
+the framework proposes with (transition/multivariatenormal.py) — matplotlib
+only renders the resulting numpy grids.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def kde_1d(df, w, x: str, xmin=None, xmax=None, numx: int = 50,
+           kde=None):
+    """Weighted 1D KDE grid (reference kde.py:19-71)."""
+    from ..transition import MultivariateNormalTransition
+
+    vals = df[x].to_numpy()
+    if xmin is None:
+        xmin = vals.min()
+    if xmax is None:
+        xmax = vals.max()
+    pad = 0.05 * max(xmax - xmin, 1e-10)
+    grid = np.linspace(xmin - pad, xmax + pad, numx)
+    tr = kde or MultivariateNormalTransition(scaling=1.0)
+    tr.fit(jnp.asarray(vals[:, None]), jnp.asarray(w))
+    dens = np.asarray(tr.pdf(jnp.asarray(grid[:, None], dtype=jnp.float32)))
+    return grid, dens
+
+
+def plot_kde_1d(df, w, x: str, xmin=None, xmax=None, numx: int = 50,
+                ax=None, refval=None, kde=None, **kwargs):
+    """Reference kde.py:74-141."""
+    import matplotlib.pyplot as plt
+
+    grid, dens = kde_1d(df, w, x, xmin, xmax, numx, kde)
+    if ax is None:
+        _, ax = plt.subplots()
+    ax.plot(grid, dens, **kwargs)
+    ax.set_xlabel(x)
+    ax.set_ylabel("Posterior")
+    if refval is not None and x in refval:
+        ax.axvline(refval[x], color="C1", linestyle="dotted")
+    return ax
+
+
+def kde_2d(df, w, x: str, y: str, xmin=None, xmax=None, ymin=None,
+           ymax=None, numx: int = 50, numy: int = 50, kde=None):
+    """Weighted 2D KDE grid (reference kde.py:144-192)."""
+    from ..transition import MultivariateNormalTransition
+
+    xv, yv = df[x].to_numpy(), df[y].to_numpy()
+    xmin = xv.min() if xmin is None else xmin
+    xmax = xv.max() if xmax is None else xmax
+    ymin = yv.min() if ymin is None else ymin
+    ymax = yv.max() if ymax is None else ymax
+    gx = np.linspace(xmin, xmax, numx)
+    gy = np.linspace(ymin, ymax, numy)
+    mx, my = np.meshgrid(gx, gy)
+    pts = np.stack([mx.ravel(), my.ravel()], axis=-1)
+    tr = kde or MultivariateNormalTransition(scaling=1.0)
+    tr.fit(jnp.asarray(np.stack([xv, yv], axis=-1)), jnp.asarray(w))
+    dens = np.asarray(tr.pdf(jnp.asarray(pts, dtype=jnp.float32)))
+    return mx, my, dens.reshape(numy, numx)
+
+
+def plot_kde_2d(df, w, x: str, y: str, ax=None, colorbar: bool = True,
+                refval=None, shading="auto", **kwargs):
+    """Reference kde.py:195-263."""
+    import matplotlib.pyplot as plt
+
+    mx, my, dens = kde_2d(df, w, x, y, **{k: v for k, v in kwargs.items()
+                                          if k in ("xmin", "xmax", "ymin",
+                                                   "ymax", "numx", "numy")})
+    if ax is None:
+        _, ax = plt.subplots()
+    mesh = ax.pcolormesh(mx, my, dens, shading=shading)
+    ax.set_xlabel(x)
+    ax.set_ylabel(y)
+    if colorbar:
+        plt.colorbar(mesh, ax=ax, label="Posterior")
+    if refval is not None:
+        ax.scatter([refval[x]], [refval[y]], color="C1", marker="x")
+    return ax
+
+
+def plot_kde_matrix(df, w, limits: Optional[dict] = None, refval=None,
+                    kde=None, names: Optional[list] = None):
+    """Pairwise KDE matrix (reference kde.py:266-515)."""
+    import matplotlib.pyplot as plt
+
+    names = names or list(df.columns)
+    n = len(names)
+    fig, axes = plt.subplots(n, n, figsize=(2.5 * n, 2.5 * n),
+                             squeeze=False)
+    for i, yi in enumerate(names):
+        for j, xj in enumerate(names):
+            ax = axes[i][j]
+            if i == j:
+                plot_kde_1d(df, w, xj, ax=ax, refval=refval, kde=kde)
+            elif i > j:
+                plot_kde_2d(df, w, xj, yi, ax=ax, colorbar=False,
+                            refval=refval)
+            else:
+                ax.axis("off")
+    fig.tight_layout()
+    return axes

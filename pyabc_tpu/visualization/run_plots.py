@@ -1,0 +1,184 @@
+"""Run-trajectory plots: epsilons, sample numbers, acceptance rates, model
+probabilities, ESS, credible intervals, histograms.
+
+Parity map to pyabc/visualization/:
+- ``plot_epsilons``              <- epsilon.py:11
+- ``plot_sample_numbers``        <- sample.py:10-120
+- ``plot_total_sample_numbers``  <- sample.py:123-180
+- ``plot_acceptance_rates_trajectory`` <- sample.py:183-347
+- ``plot_model_probabilities``   <- model_probabilities.py:6
+- ``plot_effective_sample_sizes``<- effective_sample_size.py:11
+- ``plot_credible_intervals``    <- credible.py:12-392
+- ``plot_histogram_1d/2d``       <- histogram.py
+- ``plot_data_callback``         <- data.py:13
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Union
+
+import numpy as np
+
+from ..weighted_statistics import effective_sample_size, weighted_quantile
+
+
+def _axes(ax):
+    import matplotlib.pyplot as plt
+    if ax is None:
+        _, ax = plt.subplots()
+    return ax
+
+
+def _histories(histories):
+    return histories if isinstance(histories, (list, tuple)) else [histories]
+
+
+def plot_epsilons(histories, labels: Optional[List[str]] = None, ax=None,
+                  scale: str = "log"):
+    ax = _axes(ax)
+    for i, h in enumerate(_histories(histories)):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        label = labels[i] if labels else f"run {h.id}"
+        ax.plot(pops.t, pops.epsilon, "x-", label=label)
+    if scale == "log":
+        ax.set_yscale("log")
+    ax.set_xlabel("Population index t")
+    ax.set_ylabel("Epsilon")
+    ax.legend()
+    return ax
+
+
+def plot_sample_numbers(histories, labels=None, ax=None, rotation: int = 0):
+    ax = _axes(ax)
+    for i, h in enumerate(_histories(histories)):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        label = labels[i] if labels else f"run {h.id}"
+        ax.bar(pops.t + i * 0.2, pops.samples, width=0.2, label=label)
+    ax.set_xlabel("Population index t")
+    ax.set_ylabel("Samples")
+    ax.legend()
+    return ax
+
+
+def plot_total_sample_numbers(histories, labels=None, ax=None):
+    ax = _axes(ax)
+    hs = _histories(histories)
+    totals = [h.get_all_populations().samples.sum() for h in hs]
+    names = labels or [f"run {h.id}" for h in hs]
+    ax.bar(names, totals)
+    ax.set_ylabel("Total samples")
+    return ax
+
+
+def plot_acceptance_rates_trajectory(histories, labels=None, ax=None):
+    ax = _axes(ax)
+    for i, h in enumerate(_histories(histories)):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        n_particles = h.get_nr_particles_per_population()
+        rates = [n_particles.get(t, 0) / s if s else np.nan
+                 for t, s in zip(pops.t, pops.samples)]
+        label = labels[i] if labels else f"run {h.id}"
+        ax.plot(pops.t, rates, "x-", label=label)
+    ax.set_xlabel("Population index t")
+    ax.set_ylabel("Acceptance rate")
+    ax.legend()
+    return ax
+
+
+def plot_model_probabilities(history, ax=None):
+    ax = _axes(ax)
+    probs = history.get_model_probabilities()
+    probs.plot.bar(ax=ax)
+    ax.set_ylabel("Model probability")
+    return ax
+
+
+def plot_effective_sample_sizes(histories, labels=None, ax=None):
+    ax = _axes(ax)
+    for i, h in enumerate(_histories(histories)):
+        ts, esss = [], []
+        for t in range(h.max_t + 1):
+            df = h.get_weighted_distances(t)
+            if len(df):
+                ts.append(t)
+                esss.append(float(effective_sample_size(df["w"].to_numpy())))
+        label = labels[i] if labels else f"run {h.id}"
+        ax.plot(ts, esss, "x-", label=label)
+    ax.set_xlabel("Population index t")
+    ax.set_ylabel("ESS")
+    ax.legend()
+    return ax
+
+
+def plot_credible_intervals(history, m: int = 0, par_names=None,
+                            levels=(0.95,), show_mean: bool = True,
+                            axes=None):
+    """Per-generation credible-interval trajectories (credible.py:12-392)."""
+    import matplotlib.pyplot as plt
+
+    df0, _ = history.get_distribution(m=m)
+    par_names = par_names or list(df0.columns)
+    n = len(par_names)
+    if axes is None:
+        _, axes = plt.subplots(n, 1, figsize=(6, 2.5 * n), squeeze=False)
+        axes = axes[:, 0]
+    for k, par in enumerate(par_names):
+        ax = axes[k]
+        ts = list(range(history.max_t + 1))
+        for level in levels:
+            lows, highs = [], []
+            for t in ts:
+                df, w = history.get_distribution(m=m, t=t)
+                vals = df[par].to_numpy()
+                lows.append(float(weighted_quantile(
+                    vals, w, alpha=(1 - level) / 2)))
+                highs.append(float(weighted_quantile(
+                    vals, w, alpha=1 - (1 - level) / 2)))
+            ax.fill_between(ts, lows, highs, alpha=0.3,
+                            label=f"{level:.0%} CI")
+        if show_mean:
+            means = []
+            for t in ts:
+                df, w = history.get_distribution(m=m, t=t)
+                means.append(float(np.sum(df[par].to_numpy() * w)))
+            ax.plot(ts, means, "x-", label="mean")
+        ax.set_xlabel("Population index t")
+        ax.set_ylabel(par)
+        ax.legend()
+    return axes
+
+
+def plot_histogram_1d(df, w, x: str, bins: int = 50, ax=None, **kwargs):
+    ax = _axes(ax)
+    ax.hist(df[x].to_numpy(), weights=w, bins=bins, density=True, **kwargs)
+    ax.set_xlabel(x)
+    return ax
+
+
+def plot_histogram_2d(df, w, x: str, y: str, bins: int = 50, ax=None,
+                      **kwargs):
+    ax = _axes(ax)
+    ax.hist2d(df[x].to_numpy(), df[y].to_numpy(), weights=w, bins=bins,
+              **kwargs)
+    ax.set_xlabel(x)
+    ax.set_ylabel(y)
+    return ax
+
+
+def plot_data_callback(history, f_plot: Callable, t=None, n: int = 10,
+                       ax=None):
+    """Plot stored sum-stats of sampled particles via a user callback
+    (reference data.py:13)."""
+    ax = _axes(ax)
+    pop = history.get_population(history.max_t if t is None else t)
+    flat = pop.sum_stats.get("__flat__")
+    if flat is None:
+        raise ValueError("no summary statistics stored for this generation")
+    flat = np.asarray(flat)
+    idx = np.linspace(0, flat.shape[0] - 1, min(n, flat.shape[0])).astype(int)
+    for i in idx:
+        f_plot(flat[i], ax)
+    return ax

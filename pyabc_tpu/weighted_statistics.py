@@ -1,0 +1,115 @@
+"""Weighted statistics on-device: quantiles, moments, ESS, resampling.
+
+Parity with the reference (pyabc/weighted_statistics.py:27-160), but as pure
+``jax.numpy`` functions over arrays — sort/cumsum based, fully jit/shard-safe,
+so epsilon-schedule updates and ESS diagnostics never leave the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+def _xp(*arrays):
+    """numpy for host inputs, jnp otherwise — the control plane calls these
+    with numpy arrays once per generation, and a TPU dispatch through a
+    remote relay costs ~200ms, so host math must stay on the host."""
+    if all(a is None or isinstance(a, (np.ndarray, float, int))
+           for a in arrays):
+        return np
+    return jnp
+
+
+def weighted_quantile(points: Array, weights: Array = None, alpha: float = 0.5) -> Array:
+    """Weighted ``alpha``-quantile (reference: weighted_statistics.py:27-56).
+
+    Uses the same convention as the reference: the smallest point whose
+    cumulative normalized weight reaches ``alpha``.
+    """
+    xp = _xp(points, weights)
+    points = xp.asarray(points)
+    if weights is None:
+        weights = xp.full(points.shape, 1.0 / points.shape[0])
+    weights = weights / xp.sum(weights)
+    order = xp.argsort(points)
+    pts = points[order]
+    cum = xp.cumsum(weights[order])
+    idx = xp.searchsorted(cum, alpha, side="left")
+    idx = xp.clip(idx, 0, pts.shape[0] - 1)
+    return pts[idx]
+
+
+def weighted_median(points: Array, weights: Array = None) -> Array:
+    return weighted_quantile(points, weights, alpha=0.5)
+
+
+def weighted_mean(points: Array, weights: Array) -> Array:
+    xp = _xp(points, weights)
+    weights = weights / xp.sum(weights)
+    return xp.sum(points * weights)
+
+
+def weighted_std(points: Array, weights: Array) -> Array:
+    xp = _xp(points, weights)
+    weights = weights / xp.sum(weights)
+    mean = xp.sum(points * weights)
+    return xp.sqrt(xp.sum(weights * (points - mean) ** 2))
+
+
+def weighted_var(points: Array, weights: Array) -> Array:
+    xp = _xp(points, weights)
+    weights = weights / xp.sum(weights)
+    mean = xp.sum(points * weights)
+    return xp.sum(weights * (points - mean) ** 2)
+
+
+def weighted_mse(points: Array, weights: Array, refval: Array) -> Array:
+    """Weighted mean squared error around a reference value."""
+    xp = _xp(points, weights)
+    weights = weights / xp.sum(weights)
+    return xp.sum(weights * (points - refval) ** 2)
+
+
+def effective_sample_size(weights: Array) -> Array:
+    """ESS = (Σw)² / Σw² (reference: weighted_statistics.py:73-87)."""
+    xp = _xp(weights)
+    return xp.sum(weights) ** 2 / xp.sum(weights**2)
+
+
+def resample(key, points: Array, weights: Array, n: int) -> Array:
+    """Multinomial resampling of ``n`` points with probability ∝ weights."""
+    weights = weights / jnp.sum(weights)
+    idx = jax.random.choice(key, points.shape[0], (n,), p=weights)
+    return points[idx]
+
+
+def resample_indices_deterministic(weights: Array, n: int) -> Array:
+    """Systematic/deterministic residual resampling indices.
+
+    Parity with ``resample_deterministic`` (weighted_statistics.py:111-160):
+    each point is replicated ``floor(n * w)`` times, the residual mass is
+    assigned by largest remainder.  Fixed output size ``n``, jit-safe.
+    """
+    weights = weights / jnp.sum(weights)
+    scaled = weights * n
+    base = jnp.floor(scaled).astype(jnp.int32)
+    residual = scaled - base
+    n_base = jnp.sum(base)
+    # Assign the remaining n - n_base slots to the largest residuals.
+    n_points = weights.shape[0]
+    rank = jnp.argsort(-residual)
+    extra_mask = jnp.arange(n_points) < (n - n_base)
+    extra = jnp.zeros(n_points, dtype=jnp.int32).at[rank].set(
+        extra_mask.astype(jnp.int32)
+    )
+    counts = base + extra
+    # Expand counts -> indices with fixed output shape n.
+    ends = jnp.cumsum(counts)
+    starts = ends - counts
+    pos = jnp.arange(n)
+    # idx[j] = i such that starts[i] <= j < ends[i]
+    return jnp.searchsorted(ends, pos, side="right").astype(jnp.int32)

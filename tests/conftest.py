@@ -1,0 +1,31 @@
+"""Test config: 8 virtual CPU devices so sharding tests run without TPUs.
+
+Must set XLA flags before jax initializes (see repo instructions: tests run
+on a virtual CPU mesh; the real chip is only used by bench.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(42)
+
+
+@pytest.fixture
+def db_path(tmp_path):
+    """Shared sqlite tmp path (parity: reference test/base/conftest.py:8-18)."""
+    return str(tmp_path / "abc.db")

@@ -1,0 +1,66 @@
+"""Acceptor tests (parity: reference test/base/test_acceptor.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+
+
+def test_uniform_acceptor(key):
+    acc = pt.UniformAcceptor()
+    eps = pt.ConstantEpsilon(2.0)
+    params = acc.get_params(0, eps)
+    d = jnp.asarray([1.0, 2.0, 3.0])
+    accepted, w = acc.accept(key, d, params)
+    assert np.asarray(accepted).tolist() == [True, True, False]
+    assert np.allclose(np.asarray(w), 1.0)
+
+
+def test_uniform_acceptor_complete_history(key):
+    acc = pt.UniformAcceptor(use_complete_history=True)
+    eps = pt.ListEpsilon([1.0, 5.0])
+    acc.get_params(0, eps)
+    params = acc.get_params(1, eps)
+    # nested check: must satisfy BOTH eps(0)=1 and eps(1)=5
+    assert float(params["eps"]) == 1.0
+
+
+def test_stochastic_acceptor_probabilities(key):
+    acc = pt.StochasticAcceptor()
+    acc.kernel_scale = pt.SCALE_LOG
+    acc.pdf_norms = {0: 0.0}
+    params = {"pdf_norm": jnp.float32(0.0), "temp": jnp.float32(1.0)}
+    logdens = jnp.log(jnp.asarray([0.5] * 20000))
+    accepted, w = acc.accept(key, logdens, params)
+    assert np.asarray(accepted).mean() == pytest.approx(0.5, abs=0.02)
+    # densities above the norm always accept, with importance weight
+    logdens_hi = jnp.asarray([1.0] * 10)
+    accepted, w = acc.accept(key, logdens_hi, params)
+    assert np.asarray(accepted).all()
+    assert np.allclose(np.asarray(w), np.e, rtol=1e-3)
+
+
+def test_stochastic_acceptor_temperature_softens(key):
+    acc = pt.StochasticAcceptor()
+    params_hot = {"pdf_norm": jnp.float32(0.0), "temp": jnp.float32(10.0)}
+    params_cold = {"pdf_norm": jnp.float32(0.0), "temp": jnp.float32(1.0)}
+    logdens = jnp.log(jnp.full(20000, 0.01))
+    hot, _ = acc.accept(key, logdens, params_hot)
+    cold, _ = acc.accept(key, logdens, params_cold)
+    assert np.asarray(hot).mean() > np.asarray(cold).mean()
+
+
+def test_pdf_norm_methods():
+    assert pt.pdf_norm_from_kernel(kernel_val=-3.0) == -3.0
+    norm = pt.pdf_norm_max_found(
+        prev_pdf_norm=-5.0,
+        get_weighted_distances=lambda: (np.asarray([-4.0, -2.0]), None))
+    assert norm == -2.0
+    scaled = pt.ScaledPDFNorm(factor=10.0, alpha=0.5)
+    val = scaled(prev_pdf_norm=0.0,
+                 get_weighted_distances=lambda: (np.asarray([-1.0]), None),
+                 prev_temp=4.0)
+    # offset = log(factor) * next_temp, next_temp = alpha * prev_temp
+    assert val == pytest.approx(0.0 - np.log(10.0) * 2.0)

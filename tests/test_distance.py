@@ -1,0 +1,183 @@
+"""Distance tests (parity: reference test/base/test_distance_function.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as ss
+
+import pyabc_tpu as pt
+from pyabc_tpu.sumstat import SumStatSpec
+
+
+@pytest.fixture
+def spec():
+    return SumStatSpec({"a": (), "b": (3,)})
+
+
+def _batched(a, b):
+    return {"a": jnp.asarray(a), "b": jnp.asarray(b)}
+
+
+def test_sumstat_spec_roundtrip(spec):
+    x = _batched([1.0, 2.0], [[1, 2, 3], [4, 5, 6]])
+    flat = spec.flatten(x)
+    assert flat.shape == (2, 4)
+    back = spec.unflatten(flat)
+    assert np.allclose(np.asarray(back["b"]), np.asarray(x["b"]))
+    vec = spec.expand_key_values({"a": 2.0}, default=1.0)
+    assert vec.tolist() == [2.0, 1.0, 1.0, 1.0]
+
+
+def test_pnorm_distance():
+    d = pt.PNormDistance(p=2)
+    x = {"a": jnp.asarray([1.0, 3.0])}
+    x0 = {"a": jnp.asarray(0.0)}
+    vals = np.asarray(d(x, x0))
+    assert np.allclose(vals, [1.0, 3.0])
+    # max norm
+    d_inf = pt.PNormDistance(p=np.inf)
+    x = {"a": jnp.asarray([[1.0, -4.0]])}
+    x0 = {"a": jnp.asarray([0.0, 0.0])}
+    assert float(d_inf(x, x0)[0]) == 4.0
+
+
+def test_pnorm_weights(spec):
+    d = pt.PNormDistance(p=1, weights={"a": 10.0})
+    x0 = {"a": jnp.asarray(0.0), "b": jnp.zeros(3)}
+    d.bind(spec, x0)
+    x = {"a": jnp.asarray([1.0]), "b": jnp.ones((1, 3))}
+    assert float(d(x, x0)[0]) == pytest.approx(13.0)
+
+
+def test_adaptive_pnorm_weights_inverse_scale():
+    d = pt.AdaptivePNormDistance(p=2, scale_function="standard_deviation",
+                                 normalize_weights=False)
+    x0 = {"a": jnp.asarray(0.0), "b": jnp.asarray(0.0)}
+    spec = SumStatSpec.from_example(x0)
+    d.bind(spec, x0)
+    rng = np.random.default_rng(0)
+    stats = {"a": jnp.asarray(rng.normal(0, 1.0, 500)),
+             "b": jnp.asarray(rng.normal(0, 10.0, 500))}
+    d.initialize(0, lambda: stats, x0, spec)
+    w = np.asarray(d.get_params(0)["w"])
+    # component b has 10x the scale -> 1/10 the weight
+    assert w[0] / w[1] == pytest.approx(10.0, rel=0.15)
+
+
+def test_adaptive_requests_rejected_recording():
+    d = pt.AdaptivePNormDistance()
+    sampler = pt.VectorizedSampler()
+    assert not sampler.record_rejected
+    d.configure_sampler(sampler)
+    assert sampler.record_rejected
+
+
+def test_aggregated_distance():
+    d = pt.AggregatedDistance(
+        [pt.PNormDistance(p=1), pt.PNormDistance(p=2)],
+        weights=[1.0, 2.0])
+    x0 = {"a": jnp.asarray(0.0)}
+    x = {"a": jnp.asarray([3.0])}
+    assert float(d(x, x0)[0]) == pytest.approx(3.0 + 2 * 3.0)
+
+
+def test_zscore_distance():
+    d = pt.ZScoreDistance()
+    x0 = {"a": jnp.asarray(2.0)}
+    x = {"a": jnp.asarray([3.0])}
+    assert float(d(x, x0)[0]) == pytest.approx(0.5)
+
+
+def test_pca_distance_whitens():
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(500, 2)) * np.asarray([1.0, 100.0])
+    x0 = {"a": jnp.asarray([0.0, 0.0])}
+    spec = SumStatSpec.from_example(x0)
+    d = pt.PCADistance()
+    d.bind(spec, x0)
+    d.initialize(0, lambda: {"a": jnp.asarray(data)}, x0, spec)
+    d1 = float(d({"a": jnp.asarray([[1.0, 0.0]])}, x0)[0])
+    d2 = float(d({"a": jnp.asarray([[0.0, 100.0]])}, x0)[0])
+    # one std in each direction should have comparable whitened distance
+    assert d1 == pytest.approx(d2, rel=0.25)
+
+
+def test_minmax_distance():
+    rng = np.random.default_rng(2)
+    x0 = {"a": jnp.asarray(0.0)}
+    spec = SumStatSpec.from_example(x0)
+    d = pt.MinMaxDistance(p=1)
+    d.bind(spec, x0)
+    data = {"a": jnp.asarray(np.linspace(-1, 3, 100))}
+    d.initialize(0, lambda: data, x0, spec)
+    assert float(d({"a": jnp.asarray([4.0])}, x0)[0]) == pytest.approx(1.0)
+
+
+# ---- stochastic kernels (reference test_distance_function.py:200-413) ----
+
+
+def _kernel_env(kernel, x0):
+    spec = SumStatSpec.from_example(x0)
+    kernel.bind(spec, x0)
+    return spec
+
+
+def test_normal_kernel_log_density():
+    x0 = {"y": jnp.asarray([0.0, 0.0])}
+    k = pt.NormalKernel(cov=np.eye(2) * 4.0)
+    _kernel_env(k, x0)
+    x = {"y": jnp.asarray([[1.0, 1.0]])}
+    expected = ss.multivariate_normal.logpdf([0.0, 0.0], [1.0, 1.0],
+                                             np.eye(2) * 4.0)
+    assert float(k(x, x0)[0]) == pytest.approx(expected, abs=1e-3)
+    assert k.pdf_max == pytest.approx(
+        ss.multivariate_normal.logpdf([0, 0], [0, 0], np.eye(2) * 4.0),
+        abs=1e-3)
+
+
+def test_independent_normal_matches_full():
+    x0 = {"y": jnp.asarray([0.0, 0.0])}
+    kf = pt.NormalKernel(cov=np.diag([4.0, 9.0]))
+    ki = pt.IndependentNormalKernel(var=[4.0, 9.0])
+    _kernel_env(kf, x0)
+    _kernel_env(ki, x0)
+    x = {"y": jnp.asarray([[1.0, -2.0]])}
+    assert float(kf(x, x0)[0]) == pytest.approx(float(ki(x, x0)[0]), abs=1e-3)
+
+
+def test_laplace_kernel():
+    x0 = {"y": jnp.asarray(0.0)}
+    k = pt.IndependentLaplaceKernel(scale=[2.0])
+    _kernel_env(k, x0)
+    x = {"y": jnp.asarray([1.0])}
+    assert float(k(x, x0)[0]) == pytest.approx(
+        ss.laplace.logpdf(0.0, 1.0, 2.0), abs=1e-3)
+
+
+def test_poisson_kernel():
+    x0 = {"y": jnp.asarray(3.0)}
+    k = pt.PoissonKernel()
+    _kernel_env(k, x0)
+    x = {"y": jnp.asarray([2.5])}
+    assert float(k(x, x0)[0]) == pytest.approx(
+        ss.poisson.logpmf(3, 2.5), abs=1e-3)
+
+
+def test_binomial_kernel():
+    x0 = {"y": jnp.asarray(3.0)}
+    k = pt.BinomialKernel(p=0.5)
+    _kernel_env(k, x0)
+    x = {"y": jnp.asarray([10.0])}
+    assert float(k(x, x0)[0]) == pytest.approx(
+        ss.binom.logpmf(3, 10, 0.5), abs=1e-3)
+    # pdf_max bounds any achievable density
+    assert k.pdf_max >= float(k(x, x0)[0])
+
+
+def test_negative_binomial_kernel():
+    x0 = {"y": jnp.asarray(3.0)}
+    k = pt.NegativeBinomialKernel(p=0.5)
+    _kernel_env(k, x0)
+    x = {"y": jnp.asarray([5.0])}
+    assert float(k(x, x0)[0]) == pytest.approx(
+        ss.nbinom.logpmf(3, 5.0, 0.5), abs=1e-3)

@@ -1,0 +1,69 @@
+"""End-to-end slice: 1D Gaussian conjugate problem (BASELINE config #1).
+
+Mirrors the reference's blessed integration problem strategy
+(test/base/test_samplers.py:128-209 and
+test_nondeterministic/test_abc_smc_algorithm.py): run full ABC-SMC and check
+the posterior against the analytic solution.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+
+
+def gaussian_model(key, theta):
+    # y ~ N(mu, 1), one observation summarized by its value
+    mu = theta[:, 0]
+    y = mu + jax.random.normal(key, mu.shape)
+    return {"y": y}
+
+
+def test_gaussian_posterior(db_path):
+    """Prior N(0,1), likelihood N(mu,1), observe y=1:
+    posterior N(0.5, 0.5)."""
+    prior = pt.Distribution(mu=pt.RV("norm", 0.0, 1.0))
+    abc = pt.ABCSMC(
+        models=pt.SimpleModel(gaussian_model, name="gauss"),
+        parameter_priors=prior,
+        distance_function=pt.PNormDistance(p=2),
+        population_size=1000,
+        sampler=pt.VectorizedSampler(),
+        seed=1)
+    abc.new(db_path, {"y": 1.0})
+    history = abc.run(max_nr_populations=6, minimum_epsilon=0.01)
+
+    df, w = history.get_distribution(m=0)
+    mu_est = float(np.sum(df["mu"].to_numpy() * w))
+    var_est = float(np.sum(w * (df["mu"].to_numpy() - mu_est) ** 2))
+    # ABC with eps>0 inflates variance somewhat; generous tolerances
+    assert abs(mu_est - 0.5) < 0.15
+    assert 0.3 < var_est < 0.9
+    assert history.max_t >= 2
+
+
+def test_resume(db_path):
+    prior = pt.Distribution(mu=pt.RV("norm", 0.0, 1.0))
+
+    def make_abc():
+        return pt.ABCSMC(
+            models=pt.SimpleModel(gaussian_model, name="gauss"),
+            parameter_priors=prior,
+            distance_function=pt.PNormDistance(p=2),
+            population_size=200,
+            sampler=pt.VectorizedSampler(),
+            seed=2)
+
+    abc = make_abc()
+    abc.new(db_path, {"y": 1.0})
+    h1 = abc.run(max_nr_populations=2)
+    t_first = h1.max_t
+    assert t_first >= 0
+
+    # resume (reference test/base/test_resume_run.py:11-35)
+    abc2 = make_abc()
+    abc2.load(db_path, abc_id=h1.id)
+    h2 = abc2.run(max_nr_populations=2)
+    assert h2.max_t > t_first

@@ -1,0 +1,118 @@
+"""Epsilon/temperature tests (parity: reference test/base/test_epsilon.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.distance.kernel import SCALE_LOG
+
+
+def test_constant_epsilon():
+    eps = pt.ConstantEpsilon(42.0)
+    assert eps(0) == 42.0
+    assert eps(5) == 42.0
+
+
+def test_list_epsilon():
+    eps = pt.ListEpsilon([3.0, 2.0, 1.0])
+    assert eps(1) == 2.0
+
+
+def test_quantile_epsilon_updates():
+    eps = pt.QuantileEpsilon(alpha=0.5)
+    dists = np.asarray([1.0, 2.0, 3.0, 4.0])
+    w = np.ones(4) / 4
+
+    eps.initialize(0, lambda: (dists, w), None, 5, {})
+    assert eps(0) == pytest.approx(2.0)
+    eps.update(1, lambda: (dists / 2, w))
+    assert eps(1) == pytest.approx(1.0)
+
+
+def test_median_epsilon_weighting():
+    eps = pt.MedianEpsilon()
+    dists = np.asarray([1.0, 10.0])
+    w = np.asarray([0.9, 0.1])
+    eps.initialize(0, lambda: (dists, w), None, 5, {})
+    assert eps(0) == pytest.approx(1.0)
+
+
+def test_temperature_decay_to_one():
+    temp = pt.Temperature(schemes=[pt.ExpDecayFixedIterScheme()],
+                          initial_temperature=64.0)
+    dists = np.log(np.asarray([0.1, 0.2, 0.3]))
+    w = np.ones(3) / 3
+    records = lambda: [{"distance": d, "accepted": True} for d in dists]
+    temp.initialize(0, lambda: (dists, w), records, 4, {"pdf_norm": 0.0,
+                                                        "kernel_scale": SCALE_LOG})
+    ts = [temp(0)]
+    for t in range(1, 4):
+        temp.update(t, lambda: (dists, w), records, 0.5,
+                    {"pdf_norm": 0.0, "kernel_scale": SCALE_LOG})
+        ts.append(temp(t))
+    assert ts[0] == 64.0
+    assert all(ts[i + 1] < ts[i] for i in range(3))
+    assert ts[-1] == 1.0  # enforced exact final temperature
+
+
+def test_temperature_monotone():
+    """Temperature must never increase (code-review regression test)."""
+    temp = pt.Temperature(schemes=[pt.AcceptanceRateScheme()],
+                          initial_temperature=10.0)
+    dists = np.asarray([-100.0, -50.0, -10.0])
+    w = np.ones(3) / 3
+    records = lambda: [
+        {"distance": d, "transition_pd_prev": 1.0, "transition_pd": 1.0,
+         "accepted": True} for d in dists]
+    temp.initialize(0, lambda: (dists, w), records, 100,
+                    {"pdf_norm": 0.0, "kernel_scale": SCALE_LOG})
+    prev = temp(0)
+    for t in range(1, 5):
+        temp.update(t, lambda: (dists, w), records, 0.001,
+                    {"pdf_norm": 0.0, "kernel_scale": SCALE_LOG})
+        assert temp(t) <= prev
+        prev = temp(t)
+
+
+def test_acceptance_rate_scheme_solves_target():
+    scheme = pt.AcceptanceRateScheme(target_rate=0.3)
+    # densities low enough that T=1 would under-shoot the target rate,
+    # forcing an interior bisection solve
+    logdens = np.log(np.random.default_rng(0).uniform(1e-8, 1e-2, 200))
+    records = lambda: [
+        {"distance": d, "transition_pd_prev": 1.0, "transition_pd": 1.0,
+         "accepted": True} for d in logdens]
+    T = scheme(t=1, get_all_records=records, pdf_norm=0.0,
+               kernel_scale=SCALE_LOG, prev_temperature=50.0)
+    # check the solved T indeed gives ~ the target rate
+    rate = np.mean(np.exp(np.minimum(logdens / T, 0.0)))
+    assert rate == pytest.approx(0.3, abs=0.05)
+
+
+def test_ess_scheme():
+    scheme = pt.EssScheme(target_relative_ess=0.5)
+    rng = np.random.default_rng(1)
+    dists = rng.normal(-5, 2, size=100)
+    w = np.ones(100) / 100
+    T = scheme(t=1, get_weighted_distances=lambda: (dists, w),
+               pdf_norm=0.0, kernel_scale=SCALE_LOG, prev_temperature=None)
+    assert T >= 1.0
+
+
+def test_exp_decay_fixed_ratio():
+    scheme = pt.ExpDecayFixedRatioScheme(alpha=0.5)
+    T = scheme(t=1, prev_temperature=8.0, acceptance_rate=0.3)
+    assert T == 4.0
+
+
+def test_daly_scheme():
+    scheme = pt.DalyScheme(alpha=0.5, min_rate=1e-4)
+    T1 = scheme(t=1, prev_temperature=10.0, acceptance_rate=0.5)
+    assert 1.0 <= T1 < 10.0
+
+
+def test_friel_pettitt():
+    scheme = pt.FrielPettittScheme()
+    T = scheme(t=0, max_nr_populations=4, prev_temperature=None)
+    assert T == pytest.approx(16.0)

@@ -1,0 +1,65 @@
+"""Model-zoo tests: shapes, determinism-under-key, and physical sanity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyabc_tpu.models import (
+    LotkaVolterraSDE,
+    ODEModel,
+    SIRTauLeap,
+    make_lotka_volterra_problem,
+    make_sir_problem,
+)
+
+
+def test_lotka_volterra_shapes(key):
+    model = LotkaVolterraSDE(n_steps=50, n_obs=5)
+    theta = jnp.log(jnp.asarray([[1.0, 0.4, 1.0, 0.4]] * 7))
+    out = model.simulate(key, theta)
+    assert out["prey"].shape == (7, 5)
+    assert out["predator"].shape == (7, 5)
+    assert np.all(np.asarray(out["prey"]) >= 0)
+    # same key -> same trajectories
+    out2 = model.simulate(key, theta)
+    assert np.allclose(np.asarray(out["prey"]), np.asarray(out2["prey"]))
+
+
+def test_sir_conservation(key):
+    model = SIRTauLeap(n_pop=500, i0=5, n_steps=60, n_obs=6)
+    theta = jnp.log(jnp.asarray([[0.8, 0.2]] * 4))
+    out = model.simulate(key, theta)
+    inf = np.asarray(out["infected"])
+    assert inf.shape == (4, 6)
+    assert (inf >= 0).all() and (inf <= 500).all()
+    assert (np.asarray(out["peak"]) >= inf.max(axis=1) - 1e-6).all()
+
+
+def test_sir_beta_drives_peak(key):
+    """Higher transmission -> larger epidemic peak (physical sanity)."""
+    model = SIRTauLeap(n_pop=1000, i0=10)
+    lo = jnp.log(jnp.asarray([[0.25, 0.2]] * 32))
+    hi = jnp.log(jnp.asarray([[2.0, 0.2]] * 32))
+    peak_lo = np.asarray(model.simulate(key, lo)["peak"]).mean()
+    peak_hi = np.asarray(model.simulate(key, hi)["peak"]).mean()
+    assert peak_hi > peak_lo * 2
+
+
+def test_ode_model_rk4_accuracy(key):
+    """Exponential decay integrates to analytic solution."""
+    model = ODEModel(
+        rhs=lambda y, theta: -jnp.exp(theta[:, :1]) * y,
+        y0=[1.0], t_max=2.0, n_steps=100,
+        obs_idx=[99])
+    theta = jnp.asarray([[0.0]])  # rate = 1
+    out = model.simulate(key, theta)
+    assert float(out["y0"][0, 0]) == pytest.approx(np.exp(-2.0), rel=1e-3)
+
+
+def test_problem_factories():
+    for make in (make_lotka_volterra_problem, make_sir_problem):
+        models, priors, distance, observed = make()
+        assert len(models) == len(priors) == 1
+        for v in observed.values():
+            assert np.all(np.isfinite(np.asarray(v)))

@@ -1,0 +1,96 @@
+"""Distribution / RV parity tests (reference test/base/test_random_variables... )."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as ss
+
+import pyabc_tpu as pt
+from pyabc_tpu.random_variables import (
+    Beta, Cauchy, Expon, Gamma, Laplace, LogNorm, Norm, Poisson, Randint,
+    TruncatedRV, Uniform,
+)
+
+
+@pytest.mark.parametrize("rv,scipy_rv", [
+    (Norm(1.0, 2.0), ss.norm(1.0, 2.0)),
+    (Uniform(-1.0, 3.0), ss.uniform(-1.0, 3.0)),
+    (Expon(0.0, 2.0), ss.expon(0.0, 2.0)),
+    (Laplace(0.5, 1.5), ss.laplace(0.5, 1.5)),
+    (Cauchy(0.0, 1.0), ss.cauchy(0.0, 1.0)),
+    (Gamma(2.0, 1.5), ss.gamma(2.0, scale=1.5)),
+    (Beta(2.0, 3.0), ss.beta(2.0, 3.0)),
+    (LogNorm(0.5, 2.0), ss.lognorm(0.5, scale=2.0)),
+])
+def test_log_pdf_matches_scipy(rv, scipy_rv):
+    x = np.asarray(scipy_rv.rvs(size=50, random_state=1), dtype=np.float32)
+    ours = np.asarray(rv.log_pdf(jnp.asarray(x)))
+    theirs = scipy_rv.logpdf(x)
+    assert np.allclose(ours, theirs, atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("rv,scipy_rv", [
+    (Norm(1.0, 2.0), ss.norm(1.0, 2.0)),
+    (Uniform(-1.0, 3.0), ss.uniform(-1.0, 3.0)),
+    (Gamma(2.0, 1.5), ss.gamma(2.0, scale=1.5)),
+])
+def test_sample_moments(key, rv, scipy_rv):
+    x = np.asarray(rv.sample(key, (20000,)))
+    assert abs(x.mean() - scipy_rv.mean()) < 0.1 * max(scipy_rv.std(), 1)
+    assert abs(x.std() - scipy_rv.std()) < 0.1 * scipy_rv.std()
+
+
+def test_rv_factory():
+    assert isinstance(pt.RV("norm", 0, 1), Norm)
+    with pytest.raises(ValueError):
+        pt.RV("nope")
+
+
+def test_distribution_joint(key):
+    dist = pt.Distribution(a=pt.RV("norm", 0, 1), b=pt.RV("uniform", 0, 2))
+    theta = dist.rvs_array(key, 1000)
+    assert theta.shape == (1000, 2)
+    lp = dist.log_pdf_array(theta)
+    expected = (ss.norm(0, 1).logpdf(np.asarray(theta[:, 0]))
+                + ss.uniform(0, 2).logpdf(np.asarray(theta[:, 1])))
+    assert np.allclose(np.asarray(lp), expected, atol=1e-3)
+
+
+def test_distribution_scalar_api(key):
+    dist = pt.Distribution(a=pt.RV("norm", 0, 1))
+    p = dist.rvs(key)
+    assert "a" in p
+    assert dist.pdf({"a": 0.0}) == pytest.approx(ss.norm.pdf(0.0), rel=1e-3)
+
+
+def test_truncated_rv(key):
+    rv = TruncatedRV(Norm(0.0, 1.0), lower=1.0)
+    x = np.asarray(rv.sample(key, (5000,)))
+    assert x.min() >= 1.0
+    # renormalized density integrates the tail correctly
+    z = 1.0 - ss.norm.cdf(1.0)
+    assert float(rv.log_pdf(jnp.asarray(1.5))) == pytest.approx(
+        ss.norm.logpdf(1.5) - np.log(z), abs=1e-3)
+    assert float(rv.log_pdf(jnp.asarray(0.5))) == -np.inf
+
+
+def test_model_perturbation_kernel(key):
+    kern = pt.ModelPerturbationKernel(3, probability_to_stay=0.7)
+    m = jnp.zeros(20000, dtype=jnp.int32)
+    m_new = np.asarray(kern.rvs(key, m))
+    stay = (m_new == 0).mean()
+    assert abs(stay - 0.7) < 0.02
+    assert set(np.unique(m_new)) <= {0, 1, 2}
+    assert float(kern.pmf(1, 0)) == pytest.approx(0.15, abs=1e-4)
+    assert float(kern.pmf(0, 0)) == pytest.approx(0.7, abs=1e-4)
+
+
+def test_discrete_rvs(key):
+    r = Randint(0, 5)
+    x = np.asarray(r.sample(key, (1000,)))
+    assert set(np.unique(x)) <= set(range(5))
+    assert float(r.pmf(jnp.asarray(2.0))) == pytest.approx(0.2, abs=1e-4)
+    p = Poisson(3.0)
+    assert float(p.log_pdf(jnp.asarray(2.0))) == pytest.approx(
+        ss.poisson.logpmf(2, 3.0), abs=2e-3)

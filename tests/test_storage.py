@@ -1,0 +1,91 @@
+"""History round-trips (parity: reference test/base/test_storage.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyabc_tpu.population import Population
+from pyabc_tpu.storage.history import PRE_TIME, History
+
+
+def _population(n=50, dim=2, models=(0, 1)):
+    rng = np.random.default_rng(0)
+    m = rng.choice(models, size=n).astype(np.int32)
+    return Population(
+        m=jnp.asarray(m),
+        theta=jnp.asarray(rng.normal(size=(n, dim)), dtype=jnp.float32),
+        weight=jnp.asarray(rng.uniform(0.1, 1.0, n), dtype=jnp.float32),
+        distance=jnp.asarray(rng.uniform(size=n), dtype=jnp.float32),
+        sum_stats={"__flat__": jnp.asarray(rng.normal(size=(n, 3)),
+                                           dtype=jnp.float32)})
+
+
+def _history(db_path):
+    h = History(db_path)
+    h.store_initial_data(None, {}, {"y": np.asarray([1.0, 2.0])}, None,
+                         ["m0", "m1"])
+    return h
+
+
+def test_observed_roundtrip(db_path):
+    h = _history(db_path)
+    obs = h.observed_sum_stat()
+    assert np.allclose(obs["y"], [1.0, 2.0])
+
+
+def test_population_roundtrip(db_path):
+    h = _history(db_path)
+    pop = _population()
+    h.append_population(0, 0.5, pop, 123, ["m0", "m1"],
+                        [["a", "b"], ["a", "b"]])
+    assert h.max_t == 0
+    back = h.get_population(0)
+    assert len(back) == len(pop)
+    # particles come back grouped by model; compare per-model sets
+    for m in (0, 1):
+        ours = np.sort(np.asarray(pop.select_model(m).theta)[:, 0])
+        theirs = np.sort(np.asarray(back.select_model(m).theta)[:, 0])
+        assert np.allclose(ours, theirs, atol=1e-6)
+    df, w = h.get_distribution(m=0, t=0)
+    assert list(df.columns) == ["a", "b"]
+    assert w.sum() == pytest.approx(1.0)
+
+
+def test_model_probabilities_and_populations_table(db_path):
+    h = _history(db_path)
+    pop = _population()
+    h.append_population(PRE_TIME, np.inf, pop, 10, ["m0", "m1"])
+    h.append_population(0, 1.0, pop, 100, ["m0", "m1"])
+    h.append_population(1, 0.5, pop, 200, ["m0", "m1"])
+    pops = h.get_all_populations()
+    assert pops.t.tolist() == [-1, 0, 1]
+    assert pops.samples.tolist() == [10, 100, 200]
+    probs = h.get_model_probabilities()
+    assert probs.shape == (2, 2)
+    assert np.allclose(probs.sum(axis=1), 1.0)
+    assert h.alive_models(1) == [0, 1]
+    wd = h.get_weighted_distances(1)
+    assert wd["w"].sum() == pytest.approx(1.0)
+
+
+def test_multiple_runs(db_path):
+    h1 = _history(db_path)
+    h2 = _history(db_path)
+    assert h2.id == h1.id + 1
+    assert len(h2.all_runs()) == 2
+    assert h2.model_names() == ["m0", "m1"]
+
+
+def test_export(db_path, tmp_path):
+    from pyabc_tpu.storage.export import df_to_file, history_to_df
+    h = _history(db_path)
+    h.append_population(0, 1.0, _population(), 100, ["m0", "m1"],
+                        [["a", "b"], ["a", "b"]])
+    df = history_to_df(h)
+    assert {"w", "t", "m"} <= set(df.columns)
+    out = str(tmp_path / "out.csv")
+    df_to_file(df, out)
+    import pandas as pd
+    assert len(pd.read_csv(out)) == len(df)
+    with pytest.raises(ValueError):
+        df_to_file(df, str(tmp_path / "out.unknown"))

@@ -1,0 +1,143 @@
+"""Transition tests (parity: reference test/base/test_transition.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as ss
+
+from pyabc_tpu.transition import (
+    DiscreteRandomWalkTransition,
+    GridSearchCV,
+    LocalTransition,
+    MultivariateNormalTransition,
+    NotFittedError,
+    smart_cov,
+)
+
+
+@pytest.fixture(params=["mvn", "local", "walk"])
+def transition(request):
+    return {
+        "mvn": MultivariateNormalTransition(),
+        "local": LocalTransition(k=20),
+        "walk": DiscreteRandomWalkTransition(),
+    }[request.param]
+
+
+def _fit_data(key, n=200, d=2):
+    theta = jax.random.normal(key, (n, d)) * jnp.asarray([1.0, 2.0]) + 1.0
+    w = jnp.ones(n) / n
+    return theta, w
+
+
+def test_not_fitted_raises(transition, key):
+    with pytest.raises(NotFittedError):
+        transition.rvs(key)
+    with pytest.raises(NotFittedError):
+        transition.pdf(jnp.zeros(2))
+
+
+def test_rvs_shape_and_pdf_positive(transition, key):
+    theta, w = _fit_data(key)
+    if isinstance(transition, DiscreteRandomWalkTransition):
+        theta = jnp.round(theta)
+    transition.fit(theta, w)
+    k1, k2 = jax.random.split(key)
+    draws = transition.rvs(k1, 50)
+    assert draws.shape == (50, 2)
+    pdfs = transition.pdf(draws)
+    assert np.all(np.asarray(pdfs) > 0)
+    single = transition.rvs(k2)
+    assert single.shape == (2,)
+
+
+def test_mvn_pdf_matches_manual_kde(key):
+    theta, w = _fit_data(key, n=50)
+    tr = MultivariateNormalTransition()
+    tr.fit(theta, w)
+    params = tr.get_params()
+    cov = np.asarray(params["chol"]) @ np.asarray(params["chol"]).T
+    x = np.asarray([[0.0, 0.0], [1.0, 1.0]])
+    manual = np.zeros(2)
+    th = np.asarray(theta)
+    wn = np.asarray(tr.w)
+    for i in range(len(th)):
+        manual += wn[i] * ss.multivariate_normal.pdf(x, th[i], cov)
+    # the MXU matmul formulation of the Mahalanobis term trades ~0.5%
+    # f32 accuracy for streaming speed (ops/kde.py) — harmless vs the
+    # Monte Carlo noise ABC operates under
+    ours = np.asarray(tr.pdf(jnp.asarray(x, dtype=jnp.float32)))
+    assert np.allclose(ours, manual, rtol=1e-2)
+
+
+def test_mvn_pdf_chunking_consistent(key):
+    """Chunked logsumexp must equal the direct path."""
+    theta, w = _fit_data(key, n=100)
+    tr = MultivariateNormalTransition()
+    tr.fit(theta, w)
+    x = jax.random.normal(key, (300, 2))
+    direct = tr.log_pdf_from_params(x, tr.get_params(), chunk=1024)
+    chunked = tr.log_pdf_from_params(x, tr.get_params(), chunk=64)
+    assert np.allclose(np.asarray(direct), np.asarray(chunked), atol=1e-4)
+
+
+def test_mvn_rvs_distribution(key):
+    """Samples should be support-resamples + bandwidth noise: mean matches."""
+    theta, w = _fit_data(key, n=500)
+    tr = MultivariateNormalTransition()
+    tr.fit(theta, w)
+    draws = np.asarray(tr.rvs(key, 20000))
+    assert np.allclose(draws.mean(0), np.asarray(theta).mean(0), atol=0.1)
+
+
+def test_weighted_fit_shifts_proposal(key):
+    theta = jnp.asarray([[0.0], [10.0]])
+    w = jnp.asarray([0.95, 0.05])
+    tr = MultivariateNormalTransition()
+    tr.fit(theta, w)
+    draws = np.asarray(tr.rvs(key, 2000))
+    frac_near_zero = (np.abs(draws[:, 0]) < 5.0).mean()
+    assert frac_near_zero > 0.85
+
+
+def test_discrete_random_walk_stays_integer(key):
+    theta = jnp.asarray([[0.0], [1.0], [2.0]])
+    tr = DiscreteRandomWalkTransition(n_steps=1, p_stay=0.5)
+    tr.fit(theta, jnp.ones(3) / 3)
+    draws = np.asarray(tr.rvs(key, 500))
+    assert np.allclose(draws, np.round(draws))
+    # pmf sums to one over the reachable grid
+    grid = jnp.arange(-2.0, 5.0)[:, None]
+    pmf = np.asarray(tr.pdf(grid))
+    assert pmf.sum() == pytest.approx(1.0, abs=1e-4)
+
+
+def test_smart_cov_matches_numpy(key):
+    theta, w = _fit_data(key, n=300)
+    cov = np.asarray(smart_cov(theta, w / jnp.sum(w)))
+    expected = np.cov(np.asarray(theta), rowvar=False, bias=True)
+    assert np.allclose(cov, expected, atol=1e-3)
+
+
+def test_mean_cv_decreases_with_n(key):
+    tr_small = MultivariateNormalTransition()
+    tr_big = MultivariateNormalTransition()
+    k1, k2 = jax.random.split(key)
+    theta_s, w_s = _fit_data(k1, n=30)
+    theta_b, w_b = _fit_data(k2, n=500)
+    tr_small.fit(theta_s, w_s)
+    tr_big.fit(theta_b, w_b)
+    cv_small = tr_small.mean_cv(k1, n_bootstrap=5)
+    cv_big = tr_big.mean_cv(k2, n_bootstrap=5)
+    assert cv_big < cv_small
+
+
+def test_grid_search_cv(key):
+    theta, w = _fit_data(key, n=100)
+    gs = GridSearchCV(param_grid={"scaling": [0.5, 1.0]}, n_bootstrap=2)
+    gs.fit(theta, w)
+    assert gs.best_params_["scaling"] in (0.5, 1.0)
+    assert gs.rvs(key, 10).shape == (10, 2)
+    rvs_fn, pdf_fn = gs.static_fns()
+    assert rvs_fn is MultivariateNormalTransition.rvs_from_params

@@ -1,0 +1,61 @@
+"""Parity: reference test/base/test_weighted_statistics.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyabc_tpu.weighted_statistics import (
+    effective_sample_size,
+    resample_indices_deterministic,
+    weighted_mean,
+    weighted_median,
+    weighted_quantile,
+    weighted_std,
+    weighted_var,
+)
+
+
+def test_weighted_quantile_uniform_weights():
+    pts = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    assert float(weighted_quantile(pts, alpha=0.5)) == 2.0
+    assert float(weighted_quantile(pts, alpha=1.0)) == 4.0
+    assert float(weighted_quantile(pts, alpha=0.25)) == 1.0
+
+
+def test_weighted_quantile_weights_shift_result():
+    pts = jnp.asarray([1.0, 2.0, 3.0])
+    w = jnp.asarray([0.1, 0.1, 0.8])
+    assert float(weighted_median(pts, w)) == 3.0
+
+
+def test_weighted_moments_match_numpy():
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=200)
+    w = rng.uniform(0.5, 2.0, size=200)
+    mean = float(weighted_mean(jnp.asarray(pts), jnp.asarray(w)))
+    var = float(weighted_var(jnp.asarray(pts), jnp.asarray(w)))
+    np_mean = np.average(pts, weights=w)
+    np_var = np.average((pts - np_mean) ** 2, weights=w)
+    assert abs(mean - np_mean) < 1e-5
+    assert abs(var - np_var) < 1e-4
+    assert abs(float(weighted_std(jnp.asarray(pts), jnp.asarray(w)))
+               - np.sqrt(np_var)) < 1e-4
+
+
+def test_ess():
+    assert float(effective_sample_size(jnp.ones(10))) == pytest.approx(10.0)
+    w = jnp.asarray([1.0, 0.0, 0.0])
+    assert float(effective_sample_size(w)) == pytest.approx(1.0)
+
+
+def test_resample_deterministic_counts():
+    w = jnp.asarray([0.5, 0.25, 0.25])
+    idx = np.asarray(resample_indices_deterministic(w, 8))
+    counts = np.bincount(idx, minlength=3)
+    assert counts.tolist() == [4, 2, 2]
+    # non-divisible: largest remainders get the extras
+    w = jnp.asarray([0.6, 0.4])
+    idx = np.asarray(resample_indices_deterministic(w, 5))
+    counts = np.bincount(idx, minlength=2)
+    assert counts.sum() == 5
+    assert counts[0] == 3

@@ -1,0 +1,142 @@
+"""Measure the reference-equivalent CPU baseline for bench.py.
+
+The reference itself cannot run in this image (missing sqlalchemy/jabbar),
+so this script faithfully reproduces the hot loop of pyABC's default
+sampler, ``MulticoreEvalParallelSampler``
+(/root/reference/pyabc/sampler/multicore_evaluation_parallel.py:14-150):
+
+- fork ``n_procs`` workers;
+- shared ``Value`` counters ``n_eval``/``n_acc`` with locks (:34-45);
+- each worker loops: lock-increment n_eval -> ``simulate_one()`` ->
+  if accepted: lock-increment n_acc, push (id, result) on an mp.Queue
+  (:14-54);
+- parent drains queue, joins, sorts by id, truncates to n (:121-136).
+
+``simulate_one`` reproduces the reference's per-particle generation-loop
+work for the Gaussian-mixture problem (smc.py:588-724): KDE transition draw
+(resample + MVN noise, transition/multivariatenormal.py:85-97), prior
+check, model simulation, distance, threshold acceptance, and the O(N)
+transition-pdf evaluation for the importance weight
+(multivariatenormal.py:99-113) — the same per-particle math pyABC performs,
+in numpy, one particle at a time.
+
+Writes accepted-particles/sec to BASELINE_MEASURED.json.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os  # noqa: E402  (env read below)
+import sys
+import time
+from ctypes import c_longlong
+
+import numpy as np
+
+N_POP = int(os.environ.get("BASELINE_N_POP", 2000))
+SIGMA = 0.5
+EPS = float(os.environ.get("BASELINE_EPS", 0.2))
+# KDE support size (= previous population size; pyABC evaluates the O(N)
+# transition pdf per particle, so this must match the bench population)
+SUPPORT_N = int(os.environ.get("BASELINE_SUPPORT_N", 2000))
+
+
+def make_support(rng):
+    """Mock previous-generation particles for the KDE transition."""
+    theta = rng.uniform(0.0, 1.5, size=SUPPORT_N)
+    w = rng.uniform(0.5, 1.5, size=SUPPORT_N)
+    w /= w.sum()
+    var = np.average((theta - np.average(theta, weights=w)) ** 2, weights=w)
+    bw2 = var * (4.0 / (SUPPORT_N * 3.0)) ** (2.0 / 5.0)
+    return theta, w, bw2
+
+
+def simulate_one(rng, theta_sup, w_sup, bw2):
+    """One particle, reference-style (smc.py:588-724, numpy per particle)."""
+    # transition rvs: weighted resample + gaussian noise (mvn.py:85-97)
+    idx = rng.choice(SUPPORT_N, p=w_sup)
+    mu = theta_sup[idx] + rng.normal(0.0, np.sqrt(bw2))
+    # prior density check (uniform [−0.5, 1.5] mixture of the two priors)
+    if not (-0.5 <= mu <= 1.5):
+        return None, False
+    # simulate + summary stats + distance (model.py:163-218)
+    y = mu + SIGMA * rng.normal()
+    d = abs(y - 1.0)
+    accepted = d <= EPS
+    if accepted:
+        # importance weight: O(N) KDE pdf over the support (mvn.py:99-113)
+        pdf = np.sum(
+            w_sup * np.exp(-0.5 * (mu - theta_sup) ** 2 / bw2)
+            / np.sqrt(2 * np.pi * bw2))
+        _ = 1.0 / max(pdf, 1e-300)
+    return d, accepted
+
+
+def work(seed, n_target, n_eval, n_acc, queue, theta_sup, w_sup, bw2):
+    rng = np.random.default_rng(seed)
+    while True:
+        with n_acc.get_lock():
+            if n_acc.value >= n_target:
+                break
+        with n_eval.get_lock():
+            particle_id = n_eval.value
+            n_eval.value += 1
+        d, accepted = simulate_one(rng, theta_sup, w_sup, bw2)
+        if accepted:
+            with n_acc.get_lock():
+                n_acc.value += 1
+            queue.put((particle_id, d))
+    queue.put(None)  # DONE sentinel
+
+
+def main():
+    n_procs = int(os.environ.get("PYABC_NUM_PROCS", mp.cpu_count()))
+    rng = np.random.default_rng(0)
+    theta_sup, w_sup, bw2 = make_support(rng)
+
+    start = time.perf_counter()
+    n_eval = mp.Value(c_longlong)
+    n_acc = mp.Value(c_longlong)
+    queue = mp.Queue()
+    procs = [mp.Process(target=work,
+                        args=(s, N_POP, n_eval, n_acc, queue,
+                              theta_sup, w_sup, bw2), daemon=True)
+             for s in range(n_procs)]
+    for p in procs:
+        p.start()
+    results, done = [], 0
+    while done < n_procs:
+        item = queue.get()
+        if item is None:
+            done += 1
+        else:
+            results.append(item)
+    for p in procs:
+        p.join()
+    elapsed = time.perf_counter() - start
+
+    results.sort(key=lambda r: r[0])
+    results = results[:N_POP]
+    accepted_per_sec = len(results) / elapsed
+    eval_per_sec = n_eval.value / elapsed
+    out = {
+        "method": "reference-equivalent MulticoreEvalParallelSampler "
+                  "(see module docstring)",
+        "problem": "gaussian mixture generation, KDE transition, "
+                   f"support={SUPPORT_N}, eps={EPS}",
+        "n_procs": n_procs,
+        "n_accepted": len(results),
+        "n_eval": int(n_eval.value),
+        "elapsed_s": elapsed,
+        "accepted_particles_per_sec": accepted_per_sec,
+        "evals_per_sec": eval_per_sec,
+    }
+    print(json.dumps(out, indent=2))
+    with open(os.path.join(os.path.dirname(__file__), "..",
+                           "BASELINE_MEASURED.json"), "w") as f:
+        json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
