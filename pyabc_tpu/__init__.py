@@ -78,6 +78,8 @@ from .random_variables import (
     TruncatedRV,
 )
 from .sampler import (
+    ConcurrentFutureSampler,
+    MappingSampler,
     MulticoreEvalParallelSampler,
     MulticoreParticleParallelSampler,
     RoundKernel,
@@ -135,6 +137,7 @@ __all__ = [
     "ConstantPopulationSize", "AdaptivePopulationSize", "ListPopulationSize",
     "Sampler", "Sample", "VectorizedSampler", "ShardedSampler",
     "SingleCoreSampler", "MulticoreEvalParallelSampler",
-    "MulticoreParticleParallelSampler", "RoundKernel",
+    "MulticoreParticleParallelSampler", "MappingSampler",
+    "ConcurrentFutureSampler", "RoundKernel",
     "__version__",
 ]

@@ -1,0 +1,58 @@
+"""Distributed worker CLI — the reference's Redis worker, TPU-style.
+
+Parity target: pyabc/sampler/redis_eps/cli.py:44-282 (``abc-redis-worker``
+/ ``abc-redis-manager``).  The reference farms cloudpickled closures
+through a Redis broker; the TPU-native equivalent is SPMD: every host runs
+the SAME ``ABCSMC`` program under ``jax.distributed`` and the data plane
+synchronizes through XLA collectives over ICI/DCN — no broker process, no
+pickled closures, no work-stealing protocol.
+
+``abc-distributed-worker`` therefore takes a *script* (the user's ABCSMC
+program) plus coordinator coordinates; every host executes it; inside the
+script ``pyabc_tpu.parallel.initialize_distributed()`` joins the cluster
+and ``ShardedSampler`` spans all hosts' devices.
+
+``abc-distributed-manager info`` reports the device topology the
+coordinator sees (the reference's ``abc-redis-manager info`` analog).
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+import click
+
+
+@click.command("abc-distributed-worker")
+@click.option("--coordinator", default=None,
+              help="coordinator address host:port (jax.distributed)")
+@click.option("--num-processes", default=None, type=int)
+@click.option("--process-id", default=None, type=int)
+@click.argument("script")
+def work(coordinator, num_processes, process_id, script):
+    """Join the cluster and run SCRIPT (every host runs the same program)."""
+    from .mesh import initialize_distributed
+
+    initialize_distributed(coordinator, num_processes, process_id)
+    sys.argv = [script]
+    runpy.run_path(script, run_name="__main__")
+
+
+@click.group("abc-distributed-manager")
+def manage():
+    pass
+
+
+@manage.command()
+def info():
+    """Show the global device topology."""
+    import jax
+
+    click.echo(f"process {jax.process_index()}/{jax.process_count()}")
+    click.echo(f"local devices: {jax.local_devices()}")
+    click.echo(f"global devices: {len(jax.devices())}")
+
+
+if __name__ == "__main__":
+    work()

@@ -2,6 +2,7 @@
 rounds; see sampler/vectorized.py module docstring for the mapping)."""
 
 from .base import RoundResult, Sample, Sampler, SamplingError
+from .mapping import ConcurrentFutureSampler, MappingSampler
 from .rounds import RoundKernel
 from .sharded import ShardedSampler
 from .vectorized import (
@@ -15,4 +16,5 @@ __all__ = [
     "Sampler", "Sample", "SamplingError", "RoundResult", "RoundKernel",
     "VectorizedSampler", "ShardedSampler", "SingleCoreSampler",
     "MulticoreEvalParallelSampler", "MulticoreParticleParallelSampler",
+    "MappingSampler", "ConcurrentFutureSampler",
 ]

@@ -1,0 +1,132 @@
+"""Map-based and executor-based samplers: the CPU black-box escape hatch.
+
+Parity: pyabc/sampler/mapping.py:10-117 (``MappingSampler`` — any
+``map``-like callable), pyabc/sampler/concurrent_future.py:5-71
+(``ConcurrentFutureSampler``), pyabc/sampler/eps_mixin.py:6-123 (the
+eval-parallel scheduler the futures samplers share).
+
+These exist for simulators that cannot be expressed in JAX at all (external
+binaries, R scripts, legacy Python): the per-candidate work is a host
+closure farmed out over a map/executor, exactly the reference's model.  The
+round kernel is NOT used; instead the sampler evaluates the same
+proposal -> simulate -> distance -> accept pipeline per particle via a
+host-side ``simulate_one`` closure built by the orchestrator
+(``RoundKernel.host_simulate_one``).
+
+For JAX-able models prefer VectorizedSampler/ShardedSampler — they are
+orders of magnitude faster (see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import Executor, ThreadPoolExecutor, as_completed
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from .base import RoundResult, Sample, Sampler
+
+logger = logging.getLogger("ABC.Sampler")
+
+
+class MappingSampler(Sampler):
+    """STAT scheduling over any map-like callable (reference
+    mapping.py:10-117): each map task evaluates one batch-of-1 candidate;
+    tasks are submitted in waves until n are accepted."""
+
+    def __init__(self, map_=map, mapper_pickles: bool = False,
+                 wave_size: Optional[int] = None):
+        super().__init__()
+        self.map_ = map_
+        self.mapper_pickles = mapper_pickles
+        self.wave_size = wave_size
+
+    def sample_until_n_accepted(self, n, round_fn, key, params,
+                                max_eval=np.inf, all_accepted=False,
+                                **kwargs) -> Sample:
+        sample = Sample(record_rejected=self.record_rejected)
+        wave = self.wave_size or max(n, 16)
+
+        def eval_one(seed: int):
+            k = jax.random.fold_in(key, seed)
+            rr = round_fn(k, params, 1, **(
+                {"all_accepted": True} if all_accepted else {}))
+            return jax.device_get(rr)
+
+        seed = 0
+        while sample.n_accepted < n:
+            seeds = list(range(seed, seed + wave))
+            seed += wave
+            # device_get preserves the RoundResult pytree with numpy leaves
+            for rr in self.map_(eval_one, seeds):
+                sample.append_round(rr)
+            if all_accepted:
+                break
+            if sample.nr_evaluations >= max_eval and sample.n_accepted < n:
+                logger.warning("max_eval reached in MappingSampler")
+                break
+        self.nr_evaluations_ = sample.nr_evaluations
+        return sample
+
+
+class ConcurrentFutureSampler(Sampler):
+    """DYN scheduling over a ``concurrent.futures.Executor`` (reference
+    concurrent_future.py:5-71 + eps_mixin.py:6-123): keep
+    ``client_max_jobs`` batches in flight, harvest as they complete, cancel
+    stragglers once n are accepted — results accounted in submission order
+    (the de-biasing protocol)."""
+
+    def __init__(self, cfuture_executor: Optional[Executor] = None,
+                 client_max_jobs: int = 8, batch_size: int = 1):
+        super().__init__()
+        self.executor = cfuture_executor
+        self.client_max_jobs = int(client_max_jobs)
+        self.batch_size = int(batch_size)
+
+    def sample_until_n_accepted(self, n, round_fn, key, params,
+                                max_eval=np.inf, all_accepted=False,
+                                **kwargs) -> Sample:
+        sample = Sample(record_rejected=self.record_rejected)
+        executor = self.executor or ThreadPoolExecutor(
+            max_workers=self.client_max_jobs)
+        owns = self.executor is None
+        B = self.batch_size
+
+        def eval_batch(seed: int):
+            k = jax.random.fold_in(key, seed)
+            return seed, jax.device_get(round_fn(
+                k, params, B, **({"all_accepted": True}
+                                 if all_accepted else {})))
+
+        try:
+            next_seed = 0
+            in_flight = {}
+            results = {}
+            harvested = 0  # next submission id to account
+            while True:
+                # submission-order accounting (eps_mixin.py:62-81)
+                while harvested in results:
+                    sample.append_round(results.pop(harvested))
+                    harvested += 1
+                if sample.n_accepted >= n or (
+                        sample.nr_evaluations >= max_eval
+                        and sample.n_accepted < n) or (
+                        all_accepted and harvested > 0):
+                    break
+                while len(in_flight) < self.client_max_jobs:
+                    fut = executor.submit(eval_batch, next_seed)
+                    in_flight[fut] = next_seed
+                    next_seed += 1
+                done = next(as_completed(list(in_flight)))
+                seed, rr = done.result()
+                del in_flight[done]
+                results[seed] = rr
+            for fut in in_flight:
+                fut.cancel()
+        finally:
+            if owns:
+                executor.shutdown(wait=False, cancel_futures=True)
+        self.nr_evaluations_ = sample.nr_evaluations
+        return sample

@@ -1,0 +1,8 @@
+"""SGE cluster batch mapper (parity: pyabc/sge/)."""
+
+from .execution_contexts import DefaultContext, NamedPrinter, ProfilingContext
+from .sge import SGE
+from .util import sge_available
+
+__all__ = ["SGE", "sge_available", "DefaultContext", "ProfilingContext",
+           "NamedPrinter"]

@@ -1,0 +1,41 @@
+"""Per-task entry point for SGE array jobs.
+
+Parity: pyabc/sge/execute_load.py — unpickle function + argument, run it
+inside the execution context, pickle the result, update the job DB.
+Invoked as ``python -m pyabc_tpu.sge.execute_load <tmp_dir> <task_id>``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main(tmp_dir: str, task_id: int):
+    from .db import JobDB
+
+    db = JobDB(tmp_dir)
+    db.start(task_id)
+    ok = False
+    try:
+        with open(os.path.join(tmp_dir, "function.pickle"), "rb") as f:
+            bundle = pickle.load(f)
+        function = bundle["function"]
+        context_cls = bundle["context"]
+        with open(os.path.join(tmp_dir, "jobs", f"{task_id}.job"),
+                  "rb") as f:
+            arg = pickle.load(f)
+        with context_cls(tmp_dir, task_id):
+            result = function(arg)
+        ok = True
+    except Exception as e:  # result file carries the exception
+        result = e
+    with open(os.path.join(tmp_dir, "results", f"{task_id}.result"),
+              "wb") as f:
+        pickle.dump(result, f)
+    db.finish(task_id, ok)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]))
