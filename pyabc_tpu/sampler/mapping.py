@@ -62,8 +62,6 @@ class MappingSampler(Sampler):
             # device_get preserves the RoundResult pytree with numpy leaves
             for rr in self.map_(eval_one, seeds):
                 sample.append_round(rr)
-            if all_accepted:
-                break
             if sample.nr_evaluations >= max_eval and sample.n_accepted < n:
                 logger.warning("max_eval reached in MappingSampler")
                 break
@@ -110,10 +108,12 @@ class ConcurrentFutureSampler(Sampler):
                 while harvested in results:
                     sample.append_round(results.pop(harvested))
                     harvested += 1
+                # all_accepted needs no special exit: every candidate is
+                # accepted, so n_accepted reaches n exactly when enough
+                # batches have been harvested (reference eps_mixin.py:62-81).
                 if sample.n_accepted >= n or (
                         sample.nr_evaluations >= max_eval
-                        and sample.n_accepted < n) or (
-                        all_accepted and harvested > 0):
+                        and sample.n_accepted < n):
                     break
                 while len(in_flight) < self.client_max_jobs:
                     fut = executor.submit(eval_batch, next_seed)

@@ -7,9 +7,21 @@ Invoked as ``python -m pyabc_tpu.sge.execute_load <tmp_dir> <task_id>``.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import sys
+
+
+def _restore_sys_path(tmp_dir: str):
+    """Extend sys.path with the submitting process's entries so functions
+    pickled by reference (e.g. from a pytest-inserted test dir) resolve."""
+    path_file = os.path.join(tmp_dir, "sys_path.json")
+    if os.path.exists(path_file):
+        with open(path_file) as f:
+            for p in json.load(f):
+                if p not in sys.path:
+                    sys.path.append(p)
 
 
 def main(tmp_dir: str, task_id: int):
@@ -19,6 +31,7 @@ def main(tmp_dir: str, task_id: int):
     db.start(task_id)
     ok = False
     try:
+        _restore_sys_path(tmp_dir)
         with open(os.path.join(tmp_dir, "function.pickle"), "rb") as f:
             bundle = pickle.load(f)
         function = bundle["function"]

@@ -15,6 +15,7 @@ is exercised end-to-end minus the scheduler binary.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import shutil
@@ -89,6 +90,15 @@ class SGE:
         os.makedirs(os.path.join(tmp_dir, "results"))
         os.makedirs(os.path.join(tmp_dir, "stdout"))
         os.makedirs(os.path.join(tmp_dir, "stderr"))
+        # cloudpickle serializes functions defined in importable modules by
+        # reference; the worker subprocess must see the same sys.path (e.g.
+        # a pytest-inserted test dir) to resolve them on unpickle.  Persist
+        # it to a side file read BEFORE function.pickle is opened.
+        with open(os.path.join(tmp_dir, "sys_path.json"), "w") as f:
+            # '' means the submitter's CWD — resolve it so workers running
+            # elsewhere can still import modules from it
+            json.dump([p or os.path.abspath(os.getcwd()) for p in sys.path],
+                      f)
         with open(os.path.join(tmp_dir, "function.pickle"), "wb") as f:
             cloudpickle.dump(
                 {"function": function,
