@@ -155,9 +155,14 @@ class StochasticAcceptor(Acceptor):
             save_dict_to_json(self.pdf_norms, self.log_file)
 
     def get_epsilon_config(self, t: int) -> dict:
-        """Consumed by Temperature schemes (reference acceptor.py:425-447)."""
+        """Consumed by Temperature schemes (reference acceptor.py:425-447).
+
+        ``pdf_norm`` is always log-scale (that is how it is stored), but the
+        record/distance values the schemes see follow the kernel's
+        ``ret_scale`` — report the real scale so the schemes' SCALE_LIN
+        branch logs them before subtracting the log-scale norm."""
         return {"pdf_norm": self.pdf_norms.get(t, 0.0),
-                "kernel_scale": SCALE_LOG}  # we always hand over log values
+                "kernel_scale": self.kernel_scale}
 
     # ---- device kernel ---------------------------------------------------
 

@@ -39,7 +39,7 @@ class RoundResult:
     """One fixed-shape batch of candidates (a pytree of arrays)."""
 
     def __init__(self, m, theta, distance, accepted, log_weight, stats,
-                 valid=None):
+                 valid=None, log_proposal=None):
         self.m = m                  # i32[B]
         self.theta = theta          # f32[B, D]
         self.distance = distance    # f32[B]
@@ -47,10 +47,16 @@ class RoundResult:
         self.log_weight = log_weight  # f32[B]
         self.stats = stats          # f32[B, S] flattened sum-stats
         self.valid = valid if valid is not None else accepted
+        #: log density of the proposal that generated each candidate
+        #: (reference ``transition_pd_prev``, smc.py:1024-1032) — the prior
+        #: at t=0, the model-mix × KDE density at t>0
+        self.log_proposal = (log_proposal if log_proposal is not None
+                             else jnp.zeros_like(self.log_weight))
 
     def tree_flatten(self):
         return ((self.m, self.theta, self.distance, self.accepted,
-                 self.log_weight, self.stats, self.valid), None)
+                 self.log_weight, self.stats, self.valid,
+                 self.log_proposal), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -86,6 +92,11 @@ class Sample:
         #: ALL acceptances observed, incl. over-provisioned beyond the
         #: requested n (for unbiased acceptance-rate accounting)
         self.raw_accepted = 0
+        #: optional host callback set by the orchestrator before
+        #: ``eps.update``: ``(m[R], theta[R, D]) -> log-density`` of the
+        #: NEWLY fitted proposal (reference ``transition_pd``,
+        #: smc.py:1022-1032); None -> importance ratio 1
+        self.transition_log_pdf = None
 
     def append_round(self, rr: RoundResult):
         acc_mask = np.asarray(rr.accepted)
@@ -107,6 +118,9 @@ class Sample:
                 "stats": np.asarray(rr.stats)[take],
                 "distance": np.asarray(rr.distance)[take],
                 "accepted": acc_mask[take],
+                "m": np.asarray(rr.m)[take],
+                "theta": np.asarray(rr.theta)[take],
+                "log_proposal": np.asarray(rr.log_proposal)[take],
             })
             self._n_recorded += take.size
 
@@ -136,6 +150,10 @@ class Sample:
                     "stats": np.asarray(out["rec_stats"][:rc]),
                     "distance": np.asarray(out["rec_distance"][:rc]),
                     "accepted": np.asarray(out["rec_accepted"][:rc]),
+                    "m": np.asarray(out["rec_m"][:rc]),
+                    "theta": np.asarray(out["rec_theta"][:rc]),
+                    "log_proposal": np.asarray(
+                        out["rec_log_proposal"][:rc]),
                 })
                 self._n_recorded += rc
 
@@ -183,21 +201,53 @@ class Sample:
                 np.zeros((0, 0), np.float32)
         return self._concat(self._rec, "stats")
 
+    def get_records_arrays(self) -> Optional[dict]:
+        """All recorded candidates as column arrays, or None if none."""
+        if not self._rec:
+            return None
+        return {k: self._concat(self._rec, k)
+                for k in ("m", "theta", "stats", "distance", "accepted",
+                          "log_proposal")}
+
+    def get_records_columns(self) -> Optional[Dict[str, np.ndarray]]:
+        """Per-candidate record columns for temperature schemes (reference
+        smc.py:1008-1035): ``distance`` (acceptance-kernel value),
+        ``transition_pd_prev`` (density of the proposal that generated the
+        candidate, recorded at round time), ``transition_pd`` (density under
+        the newly fitted proposal, via the orchestrator-set
+        :attr:`transition_log_pdf` callback) and ``accepted``.  Densities
+        are shifted by a common constant before exponentiation — schemes
+        only use the ratio pd/pd_prev, which is shift-invariant.  Array
+        columns (not dicts): at the 1e6-records scale the control plane
+        must stay vectorized."""
+        recs = self.get_records_arrays()
+        if recs is None:
+            return None
+        log_prev = np.asarray(recs["log_proposal"], dtype=np.float64)
+        if self.transition_log_pdf is None:
+            log_new = log_prev
+        else:
+            log_new = np.asarray(
+                self.transition_log_pdf(recs["m"], recs["theta"]),
+                dtype=np.float64)
+        finite = np.concatenate([log_prev[np.isfinite(log_prev)],
+                                 log_new[np.isfinite(log_new)]])
+        shift = finite.max() if finite.size else 0.0
+        return {
+            "distance": np.asarray(recs["distance"], dtype=np.float64),
+            "transition_pd_prev": np.exp(log_prev - shift),
+            "transition_pd": np.exp(log_new - shift),
+            "accepted": np.asarray(recs["accepted"], dtype=bool),
+        }
+
     def get_all_records(self) -> List[dict]:
-        """Per-candidate records for temperature schemes (reference
-        smc.py:726-737).  transition densities are folded into log_weight at
-        round time, so records expose distance + accepted; the importance
-        ratio pd/pd_prev is approximated as 1 (documented deviation)."""
-        out = []
-        for rec in self._rec:
-            for i in range(rec["distance"].shape[0]):
-                out.append({
-                    "distance": float(rec["distance"][i]),
-                    "transition_pd_prev": 1.0,
-                    "transition_pd": 1.0,
-                    "accepted": bool(rec["accepted"][i]),
-                })
-        return out
+        """Reference-compat list-of-dicts view of
+        :meth:`get_records_columns` (reference smc.py:726-737)."""
+        cols = self.get_records_columns()
+        if cols is None:
+            return []
+        return [{k: v[i].item() for k, v in cols.items()}
+                for i in range(cols["distance"].shape[0])]
 
 
 class Sampler:
@@ -207,6 +257,10 @@ class Sampler:
         self.nr_evaluations_ = 0
         self.record_rejected = False
         self.show_progress = False
+        #: cap on recorded candidates per generation; the orchestrator sets
+        #: this from ABCSMC.max_nr_recorded_particles (reference
+        #: smc.py:1009-1010 first_m_particles)
+        self.max_records = 1 << 21
         self.sample_factory = self  # reference-compat alias
 
     def sample_until_n_accepted(

@@ -74,6 +74,10 @@ def build_looped_round(raw_round: Callable, B: int, n_target: int,
                                                             mode="drop"),
             "rec_accepted": rec["rec_accepted"].at[idx].set(rr.accepted,
                                                             mode="drop"),
+            "rec_m": rec["rec_m"].at[idx].set(rr.m, mode="drop"),
+            "rec_theta": rec["rec_theta"].at[idx].set(rr.theta, mode="drop"),
+            "rec_log_proposal": rec["rec_log_proposal"].at[idx].set(
+                rr.log_proposal, mode="drop"),
         }
         new_count = jnp.minimum(
             rec_count + jnp.sum(val.astype(jnp.int32)), rc)
@@ -96,6 +100,10 @@ def build_looped_round(raw_round: Callable, B: int, n_target: int,
             "rec_stats": jnp.zeros((rc, s), dtype=rr0.stats.dtype),
             "rec_distance": jnp.zeros((rc,), dtype=rr0.distance.dtype),
             "rec_accepted": jnp.zeros((rc,), dtype=bool),
+            "rec_m": jnp.zeros((rc,), dtype=rr0.m.dtype),
+            "rec_theta": jnp.zeros((rc, d), dtype=rr0.theta.dtype),
+            "rec_log_proposal": jnp.zeros(
+                (rc,), dtype=rr0.log_proposal.dtype),
         }
         bufs, count = scatter(bufs, jnp.int32(0), rr0)
         rec, rec_count = scatter_records(rec, jnp.int32(0), rr0)

@@ -46,7 +46,8 @@ class MappingSampler(Sampler):
     def sample_until_n_accepted(self, n, round_fn, key, params,
                                 max_eval=np.inf, all_accepted=False,
                                 **kwargs) -> Sample:
-        sample = Sample(record_rejected=self.record_rejected)
+        sample = Sample(record_rejected=self.record_rejected,
+                        max_records=self.max_records)
         wave = self.wave_size or max(n, 16)
 
         def eval_one(seed: int):
@@ -86,7 +87,8 @@ class ConcurrentFutureSampler(Sampler):
     def sample_until_n_accepted(self, n, round_fn, key, params,
                                 max_eval=np.inf, all_accepted=False,
                                 **kwargs) -> Sample:
-        sample = Sample(record_rejected=self.record_rejected)
+        sample = Sample(record_rejected=self.record_rejected,
+                        max_records=self.max_records)
         executor = self.executor or ThreadPoolExecutor(
             max_workers=self.client_max_jobs)
         owns = self.executor is None

@@ -112,6 +112,19 @@ class RoundKernel:
     def _eps_hint(self, acceptor_params: dict) -> Array:
         return acceptor_params.get("eps", jnp.float32(jnp.inf))
 
+    def _log_prior(self, m: Array, theta: Array) -> Array:
+        """Joint log prior density: model prior pmf × parameter prior pdf
+        (reference _create_prior_pdf, smc.py:753-766)."""
+        B = theta.shape[0]
+        log_prior = jnp.full((B,), -jnp.inf)
+        for j, prior in enumerate(self.priors):
+            lp_j = prior.log_pdf_array(theta[:, :prior.dim])
+            log_prior = jnp.where(m == j, lp_j, log_prior)
+        log_model_prior = (self.model_prior_logits
+                           - jax.scipy.special.logsumexp(
+                               self.model_prior_logits))
+        return log_prior + log_model_prior[m]
+
     # ---- prior (calibration) round: reference smc.py:454-542 -------------
 
     def prior_round(self, key, params: dict, B: int,
@@ -133,10 +146,13 @@ class RoundKernel:
             acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])
             log_acc_w = jnp.log(jnp.maximum(acc_w, 1e-38))
             accepted = acc & ~early & jnp.isfinite(d)
+        # generating-proposal density = the prior itself at t=0
+        # (reference _create_transition_pdf(0) -> prior_pdf, smc.py:726-766)
         return RoundResult(
             m=m, theta=theta, distance=d, accepted=accepted,
             log_weight=log_acc_w, stats=stats,
-            valid=jnp.ones((B,), dtype=bool))
+            valid=jnp.ones((B,), dtype=bool),
+            log_proposal=self._log_prior(m, theta))
 
     # ---- generation round: reference smc.py:588-724 ----------------------
 
@@ -158,13 +174,7 @@ class RoundKernel:
             theta = jnp.where((m == j)[:, None], th_j, theta)
 
         # 3. prior validity (replaces resample-until-positive, smc.py:654)
-        log_prior = jnp.full((B,), -jnp.inf)
-        for j, prior in enumerate(self.priors):
-            lp_j = prior.log_pdf_array(theta[:, :prior.dim])
-            log_prior = jnp.where(m == j, lp_j, log_prior)
-        log_model_prior = self.model_prior_logits - jax.scipy.special.logsumexp(
-            self.model_prior_logits)
-        log_prior = log_prior + log_model_prior[m]
+        log_prior = self._log_prior(m, theta)
         valid = jnp.isfinite(log_prior)
 
         # 4. simulate + distance + accept (smc.py:664-724)
@@ -172,7 +182,9 @@ class RoundKernel:
         stats, early = self._simulate_all(ksim, theta, m, eps)
         d = self.distance.compute(stats, self.obs_flat, params["distance"])
         acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])
-        accepted = acc & valid & ~early & ~jnp.isnan(d)
+        # same predicate as prior_round: +inf distances reject too (for
+        # stochastic kernels a -inf log-density already self-rejects)
+        accepted = acc & valid & ~early & jnp.isfinite(d)
 
         # 5. importance weight (smc.py:739-750, 793-809), log space.
         # proposal density of (m, theta):
@@ -195,4 +207,5 @@ class RoundKernel:
         log_weight = jnp.where(accepted, log_weight, -jnp.inf)
 
         return RoundResult(m=m, theta=theta, distance=d, accepted=accepted,
-                           log_weight=log_weight, stats=stats, valid=valid)
+                           log_weight=log_weight, stats=stats, valid=valid,
+                           log_proposal=log_denom)

@@ -102,7 +102,8 @@ class VectorizedSampler(Sampler):
     def sample_until_n_accepted(self, n, round_fn, key, params,
                                 max_eval=np.inf, all_accepted=False,
                                 **kwargs) -> Sample:
-        sample = Sample(record_rejected=self.record_rejected)
+        sample = Sample(record_rejected=self.record_rejected,
+                        max_records=self.max_records)
         if all_accepted:
             # calibration: one exact-size round (reference all_accepted
             # path, smc.py:534-537)
@@ -145,7 +146,7 @@ class VectorizedSampler(Sampler):
         return sample
 
     def max_records_cap(self) -> int:
-        return 1 << 21
+        return self.max_records
 
 
 # Reference-compat aliases: on TPU every local sampler flavor collapses onto

@@ -175,39 +175,12 @@ class ABCSMC:
     # transition fitting with fixed-shape padding
     # ------------------------------------------------------------------
 
-    def _pad_trans_params(self, params: dict, n_pad: int) -> dict:
-        # host-side numpy: padding is control plane, runs every generation
-        out = {}
-        for k, v in params.items():
-            if not hasattr(v, "shape") or np.ndim(v) == 0 or k in (
-                    "chol", "log_norm", "step_log_probs", "n_steps"):
-                out[k] = v
-                continue
-            v = np.asarray(v)
-            n = v.shape[0]
-            if n >= n_pad:
-                out[k] = v[:n_pad]
-                continue
-            pad_n = n_pad - n
-            if k == "log_w":
-                out[k] = np.concatenate(
-                    [v, np.full((pad_n,), -1e30, dtype=v.dtype)])
-            elif k == "chols":
-                eye = np.broadcast_to(
-                    np.eye(v.shape[-1], dtype=v.dtype),
-                    (pad_n,) + v.shape[1:])
-                out[k] = np.concatenate([v, eye])
-            else:
-                pad = [(0, pad_n)] + [(0, 0)] * (v.ndim - 1)
-                out[k] = np.pad(v, pad)
-        return out
-
     def _dummy_trans_params(self, m: int, n_pad: int) -> dict:
         dim_m = self.parameter_priors[m].dim
         tr = self.transitions[m]
         tr.fit(np.zeros((1, dim_m), dtype=np.float32),
                np.ones((1,), dtype=np.float32))
-        return self._pad_trans_params(tr.get_params(), n_pad)
+        return tr.pad_params(tr.get_params(), n_pad)
 
     def _fit_transitions(self, t: int, population=None):
         """KDE refit from the last generation (reference smc.py:1065-1079),
@@ -229,9 +202,9 @@ class ABCSMC:
             theta_m = np.asarray(pop.theta)[idx, :dim_m]
             w_m = np.asarray(pop.weight)[idx]
             self.transitions[m].fit(theta_m, w_m)
-            params.append(
-                self._pad_trans_params(self.transitions[m].get_params(),
-                                       n_pad))
+            # padding policy lives in the Transition contract (pad_params)
+            params.append(self.transitions[m].pad_params(
+                self.transitions[m].get_params(), n_pad))
         self._trans_params = tuple(params)
 
     def _adapt_population_size(self, t: int):
@@ -253,6 +226,33 @@ class ABCSMC:
         for m, p in series.items():
             probs[int(m)] = float(p)
         return probs
+
+    def _proposal_log_pdf(self, probs: np.ndarray, m: np.ndarray,
+                          theta: np.ndarray) -> np.ndarray:
+        """log[Σ_s p_s·jump_pmf(s→m)] + log q_m(θ) under the CURRENT
+        (freshly fitted) transitions — the reference's transition_pdf
+        (smc.py:726-750), evaluated host-side once per generation for the
+        temperature-scheme records."""
+        from scipy.special import logsumexp
+        m = np.asarray(m)
+        theta = np.asarray(theta)
+        all_m = np.arange(self.M)
+        # log_pmf(target, source), broadcast to [M_source, R]
+        log_jump = np.asarray(self.model_perturbation_kernel.log_pmf(
+            m[None, :], all_m[:, None]), dtype=np.float64)
+        with np.errstate(divide="ignore"):
+            log_probs = np.log(np.maximum(probs, 1e-300))[:, None]
+        log_mix = logsumexp(log_probs + log_jump, axis=0)
+        log_q = np.full(m.shape, -np.inf)
+        for j in range(self.M):
+            sel = m == j
+            if not sel.any():
+                continue
+            dim_j = self.parameter_priors[j].dim
+            log_q[sel] = np.asarray(
+                self.transitions[j].log_pdf(theta[sel, :dim_j]),
+                dtype=np.float64)
+        return log_mix + log_q
 
     # ------------------------------------------------------------------
     # calibration (reference smc.py:391-542)
@@ -293,12 +293,14 @@ class ABCSMC:
 
         # temperature schemes need per-candidate records; the calibration
         # round records nothing (all_accepted), so build them from the
-        # calibration population (reference smc.py:434-449)
-        d0_np = np.asarray(d0)
+        # calibration population (reference smc.py:434-449, density ratio 1)
+        d0_np = np.asarray(d0, dtype=np.float64)
 
         def get_records():
-            return [{"distance": float(v), "transition_pd_prev": 1.0,
-                     "transition_pd": 1.0, "accepted": True} for v in d0_np]
+            ones = np.ones(d0_np.shape[0])
+            return {"distance": d0_np, "transition_pd_prev": ones,
+                    "transition_pd": ones,
+                    "accepted": np.ones(d0_np.shape[0], dtype=bool)}
 
         self.eps.initialize(
             t0, get_weighted_distances,
@@ -330,6 +332,14 @@ class ABCSMC:
             t0, get_stats, self.x_0, self.spec)
         self.acceptor.initialize(
             t0, get_weighted_distances, self.distance_function, self.x_0)
+        # the per-generation epsilon/temperature is stored in the DB
+        # (populations.epsilon); seed the schedule so a resumed Temperature
+        # continues annealing from where the previous process stopped
+        # instead of restarting at T=inf
+        pops = self.history.get_all_populations()
+        row = pops[pops.t == t0 - 1]
+        if len(row) and hasattr(self.eps, "temperatures"):
+            self.eps.temperatures[t0 - 1] = float(row.epsilon.iloc[0])
         self.eps.initialize(
             t0, get_weighted_distances, lambda: [],
             self.max_nr_populations,
@@ -362,6 +372,7 @@ class ABCSMC:
             self._initialize_from_history(t0)
         self.distance_function.configure_sampler(self.sampler)
         self.eps.configure_sampler(self.sampler)
+        self.sampler.max_records = self.max_nr_recorded_particles
 
         t = t0
         t_max = (t0 + max_nr_populations
@@ -471,5 +482,13 @@ class ABCSMC:
                 prev_temp = None
         self.acceptor.update(t, get_weighted_distances, prev_temp,
                              acceptance_rate)
-        self.eps.update(t, get_weighted_distances, sample.get_all_records,
+        # records carry the generating-proposal density (log_proposal,
+        # round time); give the sample the NEW proposal's density so
+        # AcceptanceRateScheme's importance weights pd/pd_prev are real
+        # (reference smc.py:1008-1035), not hardcoded to 1
+        probs_new = self._model_probabilities(t - 1)
+        sample.transition_log_pdf = (
+            lambda m, theta: self._proposal_log_pdf(probs_new, m, theta))
+        self.eps.update(t, get_weighted_distances,
+                        sample.get_records_columns,
                         acceptance_rate, self.acceptor.get_epsilon_config(t))

@@ -65,6 +65,49 @@ class Transition:
         """Fitted state as a pytree for the compiled sampling round."""
         raise NotImplementedError
 
+    # ---- fixed-shape padding contract -----------------------------------
+    # The orchestrator pads per-model params pytrees to the full population
+    # size so compiled-round shapes stay identical across generations and
+    # alive/dead model sets.  Padding policy belongs to the transition (it
+    # knows its own params semantics), not the orchestrator: keys in
+    # NO_PAD_KEYS are shared state passed through unchanged; PAD_FILL maps
+    # a key to the fill value for padded support rows ("eye" fills
+    # [*, D, D] stacks with identity matrices — keeps cholesky-solves
+    # well-posed); every other array key zero-pads along axis 0.
+
+    NO_PAD_KEYS: tuple = ()
+    PAD_FILL: dict = {"log_w": -1e30}  # padded rows carry ~zero weight
+
+    def pad_params(self, params: dict, n_pad: int) -> dict:
+        """Pad ``params`` leading axes to ``n_pad`` (host-side numpy: this
+        is control-plane work running once per generation per model)."""
+        out = {}
+        for k, v in params.items():
+            if (k in self.NO_PAD_KEYS or not hasattr(v, "shape")
+                    or np.ndim(v) == 0):
+                out[k] = v
+                continue
+            v = np.asarray(v)
+            n = v.shape[0]
+            if n >= n_pad:
+                out[k] = v[:n_pad]
+                continue
+            pad_n = n_pad - n
+            fill = self.PAD_FILL.get(k)
+            if fill == "eye":
+                eye = np.broadcast_to(
+                    np.eye(v.shape[-1], dtype=v.dtype),
+                    (pad_n,) + v.shape[1:])
+                out[k] = np.concatenate([v, eye])
+            elif fill is not None:
+                out[k] = np.concatenate(
+                    [v, np.full((pad_n,) + v.shape[1:], fill,
+                                dtype=v.dtype)])
+            else:
+                pad = [(0, pad_n)] + [(0, 0)] * (v.ndim - 1)
+                out[k] = np.pad(v, pad)
+        return out
+
     # ---- pure device kernels --------------------------------------------
 
     @staticmethod
@@ -175,6 +218,11 @@ class AggregatedTransition(Transition):
 
     def get_params(self):
         return {f"{a}:{b}": sub.get_params()
+                for (a, b), sub in self.mapping.items()}
+
+    def pad_params(self, params: dict, n_pad: int) -> dict:
+        # recurse: each sub-transition pads its own nested params
+        return {f"{a}:{b}": sub.pad_params(params[f"{a}:{b}"], n_pad)
                 for (a, b), sub in self.mapping.items()}
 
     def rvs(self, key, size: Optional[int] = None):

@@ -30,6 +30,10 @@ class LocalTransition(Transition):
     """KDE with per-particle local covariances (reference default k ≈ N/4,
     ``scaling=1.0`` — local_transition.py:36-58)."""
 
+    # per-particle cholesky stacks pad with identity so solves stay
+    # well-posed; the paired log_w = -1e30 rows carry no density mass
+    PAD_FILL = {"log_w": -1e30, "chols": "eye"}
+
     def __init__(self, k: Optional[int] = None, k_fraction: float = 0.25,
                  scaling: float = 1.0):
         super().__init__()

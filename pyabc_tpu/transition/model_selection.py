@@ -56,6 +56,9 @@ class GridSearchCV(Transition):
     def get_params(self):
         return self.best_estimator_.get_params()
 
+    def pad_params(self, params, n_pad):
+        return (self.best_estimator_ or self.base).pad_params(params, n_pad)
+
     def rvs(self, key, size=None):
         self._check_fitted()
         return self.best_estimator_.rvs(key, size)

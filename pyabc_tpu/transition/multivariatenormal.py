@@ -67,6 +67,8 @@ def scott_rule_of_thumb(n_eff, dim) -> Array:
 class MultivariateNormalTransition(Transition):
     """Weighted Gaussian KDE proposal (the reference default)."""
 
+    NO_PAD_KEYS = ("chol", "log_norm")  # shared KDE state, not per-particle
+
     def __init__(self, scaling: float = 1.0,
                  bandwidth_selector: Callable = silverman_rule_of_thumb):
         super().__init__()

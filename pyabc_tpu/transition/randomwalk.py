@@ -17,6 +17,8 @@ Array = jnp.ndarray
 
 
 class DiscreteRandomWalkTransition(Transition):
+    NO_PAD_KEYS = ("step_log_probs", "n_steps")  # shared walk config
+
     def __init__(self, n_steps: int = 1, p_stay: float = 0.5):
         """Steps are drawn uniformly from {-n_steps..n_steps}\\{0} with total
         probability 1 - p_stay, else stay."""

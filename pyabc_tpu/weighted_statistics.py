@@ -25,10 +25,11 @@ def _xp(*arrays):
 
 
 def weighted_quantile(points: Array, weights: Array = None, alpha: float = 0.5) -> Array:
-    """Weighted ``alpha``-quantile (reference: weighted_statistics.py:27-56).
+    """Weighted ``alpha``-quantile (reference: weighted_statistics.py:27-43).
 
-    Uses the same convention as the reference: the smallest point whose
-    cumulative normalized weight reaches ``alpha``.
+    Same convention as the reference: linear interpolation of the sorted
+    points at midpoint cumulative weights, ``interp(alpha, cs - w/2, pts)``
+    — works identically under numpy and jnp.
     """
     xp = _xp(points, weights)
     points = xp.asarray(points)
@@ -37,10 +38,9 @@ def weighted_quantile(points: Array, weights: Array = None, alpha: float = 0.5) 
     weights = weights / xp.sum(weights)
     order = xp.argsort(points)
     pts = points[order]
-    cum = xp.cumsum(weights[order])
-    idx = xp.searchsorted(cum, alpha, side="left")
-    idx = xp.clip(idx, 0, pts.shape[0] - 1)
-    return pts[idx]
+    w = weights[order]
+    cum = xp.cumsum(w)
+    return xp.interp(alpha, cum - 0.5 * w, pts)
 
 
 def weighted_median(points: Array, weights: Array = None) -> Array:

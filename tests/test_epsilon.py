@@ -24,18 +24,22 @@ def test_quantile_epsilon_updates():
     dists = np.asarray([1.0, 2.0, 3.0, 4.0])
     w = np.ones(4) / 4
 
+    # reference convention: interp(alpha, cumw - w/2, points)
+    # cumw - w/2 = [.125, .375, .625, .875] -> interp(.5) = 2.5
     eps.initialize(0, lambda: (dists, w), None, 5, {})
-    assert eps(0) == pytest.approx(2.0)
+    assert eps(0) == pytest.approx(2.5)
     eps.update(1, lambda: (dists / 2, w))
-    assert eps(1) == pytest.approx(1.0)
+    assert eps(1) == pytest.approx(1.25)
 
 
 def test_median_epsilon_weighting():
     eps = pt.MedianEpsilon()
     dists = np.asarray([1.0, 10.0])
     w = np.asarray([0.9, 0.1])
+    # cumw - w/2 = [.45, .95] -> interp(.5) = 1 + (.05/.5)*9 = 1.9
+    # (matches reference np.interp midpoint convention)
     eps.initialize(0, lambda: (dists, w), None, 5, {})
-    assert eps(0) == pytest.approx(1.0)
+    assert eps(0) == pytest.approx(1.9)
 
 
 def test_temperature_decay_to_one():

@@ -16,16 +16,33 @@ from pyabc_tpu.weighted_statistics import (
 
 
 def test_weighted_quantile_uniform_weights():
+    """Reference midpoint-interpolation convention:
+    interp(alpha, cumw - w/2, points)."""
     pts = jnp.asarray([1.0, 2.0, 3.0, 4.0])
-    assert float(weighted_quantile(pts, alpha=0.5)) == 2.0
-    assert float(weighted_quantile(pts, alpha=1.0)) == 4.0
-    assert float(weighted_quantile(pts, alpha=0.25)) == 1.0
+    assert float(weighted_quantile(pts, alpha=0.5)) == pytest.approx(2.5)
+    assert float(weighted_quantile(pts, alpha=1.0)) == pytest.approx(4.0)
+    assert float(weighted_quantile(pts, alpha=0.25)) == pytest.approx(1.5)
+
+
+def test_weighted_quantile_matches_reference_formula():
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=50)
+    w = rng.uniform(0.1, 2.0, size=50)
+    w = w / w.sum()
+    order = np.argsort(pts)
+    cs = np.cumsum(w[order])
+    for alpha in (0.1, 0.5, 0.9):
+        expected = np.interp(alpha, cs - 0.5 * w[order], pts[order])
+        got = float(weighted_quantile(jnp.asarray(pts), jnp.asarray(w),
+                                      alpha=alpha))
+        assert got == pytest.approx(expected, rel=1e-5)
 
 
 def test_weighted_quantile_weights_shift_result():
     pts = jnp.asarray([1.0, 2.0, 3.0])
     w = jnp.asarray([0.1, 0.1, 0.8])
-    assert float(weighted_median(pts, w)) == 3.0
+    # cumw - w/2 = [.05, .15, .6] -> interp(.5) = 2 + (.35/.45)
+    assert float(weighted_median(pts, w)) == pytest.approx(2.0 + 0.35 / 0.45)
 
 
 def test_weighted_moments_match_numpy():
