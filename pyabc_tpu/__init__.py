@@ -79,6 +79,7 @@ from .random_variables import (
 )
 from .sampler import (
     ConcurrentFutureSampler,
+    DaskDistributedSampler,
     MappingSampler,
     MulticoreEvalParallelSampler,
     MulticoreParticleParallelSampler,
@@ -138,6 +139,6 @@ __all__ = [
     "Sampler", "Sample", "VectorizedSampler", "ShardedSampler",
     "SingleCoreSampler", "MulticoreEvalParallelSampler",
     "MulticoreParticleParallelSampler", "MappingSampler",
-    "ConcurrentFutureSampler", "RoundKernel",
+    "ConcurrentFutureSampler", "DaskDistributedSampler", "RoundKernel",
     "__version__",
 ]

@@ -1,12 +1,14 @@
 """External / black-box simulator bridges (parity: pyabc/external/)."""
 
 from .base import (
+    ExternalDistance,
     ExternalHandler,
     ExternalModel,
+    ExternalSumStat,
     HostFunctionModel,
     R,
     create_sum_stat,
 )
 
-__all__ = ["ExternalHandler", "ExternalModel", "HostFunctionModel", "R",
-           "create_sum_stat"]
+__all__ = ["ExternalHandler", "ExternalModel", "ExternalSumStat",
+           "ExternalDistance", "HostFunctionModel", "R", "create_sum_stat"]

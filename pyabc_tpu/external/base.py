@@ -149,33 +149,175 @@ class ExternalModel(HostFunctionModel):
         super().__init__(fn, stat_shapes, name=name)
 
 
-def create_sum_stat(executable: str = "", file: str = ""):
-    """Reference-compat factory (external/base.py:192-230): identity when
-    summary statistics are computed by the model itself."""
-    if not executable:
-        return lambda x: x
-    handler = ExternalHandler(executable, file)
+class ExternalSumStat:
+    """External summary-statistics calculator (reference
+    external/base.py:200-236): ``{exe} {file} model_output={loc}
+    target={loc2}`` — consumes the model's output file, writes the
+    summary-statistics file."""
 
-    def sum_stat(x):
-        handler.run()
-        return x
+    def __init__(self, executable: str, file: str, **handler_kwargs):
+        handler_kwargs.setdefault("prefix", "sumstat_")
+        self.eh = ExternalHandler(executable, file, **handler_kwargs)
 
-    return sum_stat
+    def __call__(self, model_output: dict) -> dict:
+        return self.eh.run(args=[f"model_output={model_output['loc']}"])
+
+
+class ExternalDistance:
+    """External distance calculator (reference external/base.py:239-285):
+    ``{exe} {file} sumstat_0={loc0} sumstat_1={loc1} target={loc}``; the
+    target file must contain a single float, which is read back.  A failed
+    sum-stat computation (nonzero returncode) yields nan — which the
+    acceptance predicate rejects (rounds.py uses ``isfinite``)."""
+
+    def __init__(self, executable: str, file: str, **handler_kwargs):
+        handler_kwargs.setdefault("prefix", "dist_")
+        self.eh = ExternalHandler(executable, file, **handler_kwargs)
+
+    def __call__(self, sumstat_0: dict, sumstat_1: dict) -> float:
+        if sumstat_0.get("returncode") or sumstat_1.get("returncode"):
+            return float("nan")
+        ret = self.eh.run(args=[f"sumstat_0={sumstat_0['loc']}",
+                                f"sumstat_1={sumstat_1['loc']}"])
+        try:
+            if ret["returncode"]:
+                return float("nan")
+            with open(ret["loc"]) as f:
+                return float(f.read())
+        except ValueError:  # empty/garbage output file
+            return float("nan")
+        finally:
+            if os.path.exists(ret["loc"]):
+                os.remove(ret["loc"])
+
+
+def create_sum_stat(loc: str = "", returncode: int = 0) -> dict:
+    """Sum-stat dict as produced by ExternalModel/ExternalSumStat
+    (reference external/base.py:288-302): encodes the observed data's file
+    location (or a dummy)."""
+    return {"loc": loc, "returncode": returncode}
+
+
+def _r_call_expr(source_file: str, function_name: str,
+                 args_r: Sequence[str], target: str) -> str:
+    """R expression: source the script, call ``function_name`` with the
+    given R-literal args, write the result as 'name value' lines."""
+    call = f"{function_name}({', '.join(args_r)})" if args_r else \
+        function_name
+    return (
+        f'source("{source_file}"); '
+        f'.res <- {call}; '
+        f'.res <- as.list(.res); '
+        # bare numerics (e.g. a distance returning abs(x$s - y$s)) have no
+        # names — synthesize v1, v2, ... so the transport format holds
+        f'if (is.null(names(.res))) '
+        f'names(.res) <- paste0("v", seq_along(.res)); '
+        f'cat(paste(names(.res), unlist(.res)), sep="\\n", '
+        f'file="{target}")'
+    )
+
+
+def _dict_to_r_list(d: Dict) -> str:
+    """Python dict of floats -> R ``list(a=1.0, b=2.0)`` literal
+    (transport analog of r_rpy2's dict_to_named_list)."""
+    inner = ", ".join(f"{k}={float(v)!r}" for k, v in d.items())
+    return f"list({inner})"
 
 
 class R:
-    """R-script bridge (reference external/r_rpy2.py:63-218), gated on rpy2.
+    """R-script bridge (reference external/r_rpy2.py:63-218).
 
-    rpy2 is not available in this image; constructing raises with a clear
-    message, and ``ExternalModel('Rscript', 'script.R', ...)`` is the
-    supported subprocess path.
+    Same accessor surface as the reference: ``.model(name)``,
+    ``.summary_statistics(name)``, ``.distance(name)``,
+    ``.observation(name)``, each resolving a function/object defined in
+    ``source_file``; pickles as the source path (re-sourced on unpickle,
+    r_rpy2.py:80-86).
+
+    Transport: rpy2 when installed (the reference's path); otherwise an
+    ``Rscript`` subprocess per call — the script is sourced fresh each
+    call and results cross via 'name value' files.  Raises at construction
+    when neither is available.
     """
 
     def __init__(self, source_file: str):
-        try:
-            import rpy2  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "rpy2 is not installed; use ExternalModel('Rscript', ...) "
-                "for R models via subprocess instead") from e
         self.source_file = source_file
+        self._backend = None
+        try:
+            import rpy2.robjects  # noqa: F401
+            self._backend = "rpy2"
+        except ImportError:
+            import shutil as _shutil
+            if _shutil.which("Rscript"):
+                self._backend = "subprocess"
+        if self._backend is None:
+            raise ImportError(
+                "R bridge needs rpy2 or an Rscript binary on PATH; "
+                "neither is available")
+        if self._backend == "rpy2":
+            from rpy2.robjects import r
+            r.source(self.source_file)
+
+    def __getstate__(self):
+        return self.source_file
+
+    def __setstate__(self, state):
+        self.__init__(state)
+
+    # ---- transport -------------------------------------------------------
+
+    def _call(self, function_name: str, *arg_dicts: Dict) -> Dict[str, float]:
+        if self._backend == "rpy2":
+            from rpy2.robjects import ListVector, r
+            args = [ListVector({k: float(v) for k, v in d.items()})
+                    for d in arg_dicts]
+            res = r[function_name](*args)
+            names = list(res.names) if res.names is not None else []
+            if not names:  # bare numeric return (reference float() path)
+                vals = list(np.asarray(res, dtype=float).ravel())
+                return {f"v{i + 1}": v for i, v in enumerate(vals)}
+            return {str(k): float(v[0]) if hasattr(v, "__len__") else float(v)
+                    for k, v in zip(names, res)}
+        fd, target = tempfile.mkstemp(prefix="abc_r_")
+        os.close(fd)
+        expr = _r_call_expr(self.source_file, function_name,
+                            [_dict_to_r_list(d) for d in arg_dicts], target)
+        proc = subprocess.run(["Rscript", "-e", expr],
+                              capture_output=True, text=True)
+        if proc.returncode:
+            os.remove(target)
+            raise RuntimeError(f"Rscript failed: {proc.stderr}")
+        out: Dict[str, float] = {}
+        with open(target) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[0]] = float(parts[1])
+        os.remove(target)
+        return out
+
+    # ---- reference accessor surface (r_rpy2.py:109-218) ------------------
+
+    def model(self, function_name: str) -> Callable:
+        def model_py(par: Dict) -> Dict[str, float]:
+            return self._call(function_name, dict(par))
+        model_py.__name__ = function_name
+        model_py._R = self
+        return model_py
+
+    def summary_statistics(self, function_name: str) -> Callable:
+        def sumstat_py(model_output: Dict) -> Dict[str, float]:
+            return self._call(function_name, dict(model_output))
+        sumstat_py.__name__ = function_name
+        sumstat_py._R = self
+        return sumstat_py
+
+    def distance(self, function_name: str) -> Callable:
+        def distance_py(x: Dict, x_0: Dict) -> float:
+            res = self._call(function_name, dict(x), dict(x_0))
+            return float(next(iter(res.values())))
+        distance_py.__name__ = function_name
+        distance_py._R = self
+        return distance_py
+
+    def observation(self, name: str) -> Dict[str, float]:
+        return self._call(name)

@@ -1,5 +1,6 @@
 """PEtab bridge (parity: pyabc/petab/)."""
 
 from .base import PetabImporter
+from .ode import LikelihoodODEModel, ODEPetabImporter
 
-__all__ = ["PetabImporter"]
+__all__ = ["PetabImporter", "ODEPetabImporter", "LikelihoodODEModel"]

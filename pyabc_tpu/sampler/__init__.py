@@ -2,6 +2,8 @@
 rounds; see sampler/vectorized.py module docstring for the mapping)."""
 
 from .base import RoundResult, Sample, Sampler, SamplingError
+from .dask_sampler import DaskDistributedSampler
+from .eps_mixin import EPSMixin
 from .mapping import ConcurrentFutureSampler, MappingSampler
 from .rounds import RoundKernel
 from .sharded import ShardedSampler
@@ -16,5 +18,6 @@ __all__ = [
     "Sampler", "Sample", "SamplingError", "RoundResult", "RoundKernel",
     "VectorizedSampler", "ShardedSampler", "SingleCoreSampler",
     "MulticoreEvalParallelSampler", "MulticoreParticleParallelSampler",
-    "MappingSampler", "ConcurrentFutureSampler",
+    "MappingSampler", "ConcurrentFutureSampler", "DaskDistributedSampler",
+    "EPSMixin",
 ]

@@ -1,0 +1,110 @@
+"""EPSMixin: the DYN eval-parallel scheduler shared by futures samplers.
+
+Parity: pyabc/sampler/eps_mixin.py:6-123 — submit batches while
+``running < min(client_max_jobs, client_cores())``, harvest completed
+futures, account results in SUBMISSION order (the de-biasing protocol:
+results are consumed by submission id, so a fast straggler cannot jump the
+queue and bias the population toward short-running simulations), cancel
+stragglers once n are accepted.
+
+The per-batch work is a compiled round function (a fixed-shape batch of B
+candidates), not a single-particle closure — each future returns a whole
+``RoundResult``.  Shared by :class:`ConcurrentFutureSampler`
+(pyabc_tpu/sampler/mapping.py) and :class:`DaskDistributedSampler`
+(pyabc_tpu/sampler/dask_sampler.py), exactly the reference's class
+topology (concurrent_future.py:5-71, dask_sampler.py:7-71).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import numpy as np
+
+from .base import Sample
+
+logger = logging.getLogger("ABC.Sampler")
+
+
+class EPSMixin:
+    """Scheduling core over an abstract futures client.
+
+    Concrete samplers provide:
+
+    - ``_submit(fn, seed) -> future`` — future must expose ``result()``,
+      ``done()`` and ``cancel()``
+    - ``client_cores() -> int`` — parallelism of the backing cluster
+    - optionally ``_wait_any(futures) -> future`` — blocking wait for any
+      completed future (default: poll ``done()``)
+
+    plus attributes ``client_max_jobs`` and ``batch_size``.
+    """
+
+    client_max_jobs: int = 8
+    batch_size: int = 1
+
+    def _submit(self, fn, seed):
+        raise NotImplementedError
+
+    def client_cores(self) -> int:
+        return self.client_max_jobs
+
+    def _wait_any(self, futures):
+        """Return any completed future (default: poll; backends with a
+        native blocking wait override this)."""
+        while True:
+            for fut in futures:
+                if fut.done():
+                    return fut
+            time.sleep(0.001)
+
+    def _cancel(self, fut):
+        try:
+            fut.cancel()
+        except Exception:  # cancellation is best-effort on every backend
+            pass
+
+    def sample_until_n_accepted(self, n, round_fn, key, params,
+                                max_eval=np.inf, all_accepted=False,
+                                **kwargs) -> Sample:
+        sample = Sample(record_rejected=self.record_rejected,
+                        max_records=self.max_records)
+        B = self.batch_size
+
+        def eval_batch(seed: int):
+            k = jax.random.fold_in(key, seed)
+            return seed, jax.device_get(round_fn(
+                k, params, B, **({"all_accepted": True}
+                                 if all_accepted else {})))
+
+        max_jobs = max(int(min(self.client_max_jobs, self.client_cores())),
+                       1)
+        next_seed = 0
+        in_flight = {}
+        results = {}
+        harvested = 0  # next submission id to account
+        try:
+            while True:
+                # submission-order accounting (reference eps_mixin.py:62-81)
+                while harvested in results:
+                    sample.append_round(results.pop(harvested))
+                    harvested += 1
+                if sample.n_accepted >= n or (
+                        sample.nr_evaluations >= max_eval
+                        and sample.n_accepted < n):
+                    break
+                while len(in_flight) < max_jobs:
+                    fut = self._submit(eval_batch, next_seed)
+                    in_flight[fut] = next_seed
+                    next_seed += 1
+                done = self._wait_any(list(in_flight))
+                seed, rr = done.result()
+                del in_flight[done]
+                results[seed] = rr
+        finally:
+            for fut in in_flight:
+                self._cancel(fut)
+        self.nr_evaluations_ = sample.nr_evaluations
+        return sample

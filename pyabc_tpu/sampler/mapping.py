@@ -21,12 +21,13 @@ from __future__ import annotations
 
 import logging
 from concurrent.futures import Executor, ThreadPoolExecutor, as_completed
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import numpy as np
 
-from .base import RoundResult, Sample, Sampler
+from .base import Sample, Sampler
+from .eps_mixin import EPSMixin
 
 logger = logging.getLogger("ABC.Sampler")
 
@@ -70,65 +71,36 @@ class MappingSampler(Sampler):
         return sample
 
 
-class ConcurrentFutureSampler(Sampler):
+class ConcurrentFutureSampler(EPSMixin, Sampler):
     """DYN scheduling over a ``concurrent.futures.Executor`` (reference
-    concurrent_future.py:5-71 + eps_mixin.py:6-123): keep
-    ``client_max_jobs`` batches in flight, harvest as they complete, cancel
-    stragglers once n are accepted — results accounted in submission order
-    (the de-biasing protocol)."""
+    concurrent_future.py:5-71): the EPSMixin loop keeps ``client_max_jobs``
+    batches in flight, harvests as they complete, cancels stragglers once n
+    are accepted — results accounted in submission order (the de-biasing
+    protocol).  ``all_accepted`` needs no special exit: every candidate is
+    accepted, so n_accepted reaches n exactly when enough batches have been
+    harvested."""
 
     def __init__(self, cfuture_executor: Optional[Executor] = None,
                  client_max_jobs: int = 8, batch_size: int = 1):
-        super().__init__()
+        Sampler.__init__(self)
         self.executor = cfuture_executor
+        self._owns_executor = cfuture_executor is None
         self.client_max_jobs = int(client_max_jobs)
         self.batch_size = int(batch_size)
 
-    def sample_until_n_accepted(self, n, round_fn, key, params,
-                                max_eval=np.inf, all_accepted=False,
-                                **kwargs) -> Sample:
-        sample = Sample(record_rejected=self.record_rejected,
-                        max_records=self.max_records)
-        executor = self.executor or ThreadPoolExecutor(
-            max_workers=self.client_max_jobs)
-        owns = self.executor is None
-        B = self.batch_size
+    def _submit(self, fn, seed):
+        if self.executor is None:
+            self.executor = ThreadPoolExecutor(
+                max_workers=self.client_max_jobs)
+            self._owns_executor = True
+        return self.executor.submit(fn, seed)
 
-        def eval_batch(seed: int):
-            k = jax.random.fold_in(key, seed)
-            return seed, jax.device_get(round_fn(
-                k, params, B, **({"all_accepted": True}
-                                 if all_accepted else {})))
+    def _wait_any(self, futures):
+        return next(as_completed(futures))
 
-        try:
-            next_seed = 0
-            in_flight = {}
-            results = {}
-            harvested = 0  # next submission id to account
-            while True:
-                # submission-order accounting (eps_mixin.py:62-81)
-                while harvested in results:
-                    sample.append_round(results.pop(harvested))
-                    harvested += 1
-                # all_accepted needs no special exit: every candidate is
-                # accepted, so n_accepted reaches n exactly when enough
-                # batches have been harvested (reference eps_mixin.py:62-81).
-                if sample.n_accepted >= n or (
-                        sample.nr_evaluations >= max_eval
-                        and sample.n_accepted < n):
-                    break
-                while len(in_flight) < self.client_max_jobs:
-                    fut = executor.submit(eval_batch, next_seed)
-                    in_flight[fut] = next_seed
-                    next_seed += 1
-                done = next(as_completed(list(in_flight)))
-                seed, rr = done.result()
-                del in_flight[done]
-                results[seed] = rr
-            for fut in in_flight:
-                fut.cancel()
-        finally:
-            if owns:
-                executor.shutdown(wait=False, cancel_futures=True)
-        self.nr_evaluations_ = sample.nr_evaluations
-        return sample
+    def stop(self):
+        # only tear down executors this sampler created — a caller-provided
+        # executor may carry the caller's unrelated work
+        if self.executor is not None and self._owns_executor:
+            self.executor.shutdown(wait=False, cancel_futures=True)
+            self.executor = None

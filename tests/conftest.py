@@ -6,7 +6,11 @@ on a virtual CPU mesh; the real chip is only used by bench.py).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the environment may pin JAX_PLATFORMS to a TPU plugin whose
+# sitecustomize also overrides jax.config at interpreter start, so both the
+# env var and the config must be set (setdefault is not enough — through a
+# remote TPU relay every dispatch costs ~200ms and the suite crawls)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,6 +18,7 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

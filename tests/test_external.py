@@ -1,0 +1,189 @@
+"""External black-box bridges (parity: reference pyabc/external tests).
+
+Covers: shell-script model end-to-end through ABCSMC (via the
+pure_callback HostFunctionModel path), the ExternalSumStat/ExternalDistance
+file protocol, and the R bridge's transport pieces (live Rscript test
+skipped when no R is installed, as in the reference's rpy2 gating).
+"""
+
+import os
+import shutil
+import stat
+import textwrap
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.external import (
+    ExternalDistance,
+    ExternalHandler,
+    ExternalModel,
+    ExternalSumStat,
+    HostFunctionModel,
+    R,
+    create_sum_stat,
+)
+from pyabc_tpu.external.base import _dict_to_r_list, _r_call_expr
+
+
+def _write_script(path, body):
+    path.write_text(textwrap.dedent(body))
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+@pytest.fixture
+def model_script(tmp_path):
+    # reference protocol: {exe} {file} par=value ... target={loc};
+    # writes 'name value' lines to the target file
+    return _write_script(tmp_path / "model.sh", r"""
+        #!/bin/bash
+        for a in "$@"; do
+          case "$a" in
+            mu=*) mu="${a#mu=}";;
+            target=*) target="${a#target=}";;
+          esac
+        done
+        echo "y $mu" > "$target"
+        """)
+
+
+def test_external_handler_runs(model_script):
+    handler = ExternalHandler("bash", model_script)
+    res = handler.run(["mu=0.25"])
+    assert res["returncode"] == 0
+    with open(res["loc"]) as f:
+        assert f.read().split() == ["y", "0.25"]
+    os.remove(res["loc"])
+
+
+def test_external_model_e2e_through_abcsmc(db_path, model_script):
+    """A shell-script simulator drives a full ABC run (VERDICT r1 #7):
+    the compiled round calls back to the host per batch, the script runs
+    once per particle, posterior concentrates near the observed value."""
+    model = ExternalModel("bash", model_script, parameter_names=["mu"],
+                          stat_shapes={"y": ()})
+    assert isinstance(model, HostFunctionModel)
+    abc = pt.ABCSMC(
+        models=model,
+        parameter_priors=pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+        distance_function=pt.PNormDistance(p=2),
+        population_size=32,
+        sampler=pt.VectorizedSampler(min_batch_size=32, max_batch_size=64),
+        seed=2)
+    abc.new(db_path, {"y": 0.4})
+    h = abc.run(max_nr_populations=3)
+    df, w = h.get_distribution(m=0)
+    mu_est = float(np.sum(df["mu"].to_numpy() * w))
+    assert mu_est == pytest.approx(0.4, abs=0.15)
+
+
+def test_external_sumstat_and_distance_protocol(tmp_path):
+    """Model output file -> sum-stat file -> distance file, all via
+    subprocess scripts (reference external/base.py:200-285)."""
+    sumstat_script = _write_script(tmp_path / "sumstat.sh", r"""
+        #!/bin/bash
+        for a in "$@"; do
+          case "$a" in
+            model_output=*) mo="${a#model_output=}";;
+            target=*) target="${a#target=}";;
+          esac
+        done
+        # stat = double the model's y value
+        y=$(awk '{print $2}' "$mo")
+        echo "s $(echo "$y 2" | awk '{print $1*$2}')" > "$target"
+        """)
+    distance_script = _write_script(tmp_path / "distance.sh", r"""
+        #!/bin/bash
+        for a in "$@"; do
+          case "$a" in
+            sumstat_0=*) s0="${a#sumstat_0=}";;
+            sumstat_1=*) s1="${a#sumstat_1=}";;
+            target=*) target="${a#target=}";;
+          esac
+        done
+        a=$(awk '{print $2}' "$s0")
+        b=$(awk '{print $2}' "$s1")
+        echo "$a $b" | awk '{d=$1-$2; if (d<0) d=-d; print d}' > "$target"
+        """)
+
+    # model output files
+    mo0 = tmp_path / "out0.txt"
+    mo0.write_text("y 1.5\n")
+    mo1 = tmp_path / "out1.txt"
+    mo1.write_text("y 1.0\n")
+
+    sumstat = ExternalSumStat("bash", sumstat_script)
+    s0 = sumstat(create_sum_stat(str(mo0)))
+    s1 = sumstat(create_sum_stat(str(mo1)))
+    assert s0["returncode"] == 0
+
+    distance = ExternalDistance("bash", distance_script)
+    d = distance(s0, s1)
+    assert d == pytest.approx(abs(1.5 * 2 - 1.0 * 2))
+
+    # failed upstream sum-stat -> nan (rejected by the isfinite predicate)
+    bad = dict(s1, returncode=1)
+    assert np.isnan(distance(s0, bad))
+    for s in (s0, s1):
+        os.remove(s["loc"])
+
+
+def test_external_distance_failure_yields_nan(tmp_path):
+    """A failing/empty distance executable must yield nan, not crash
+    (code-review regression test)."""
+    bad_script = _write_script(tmp_path / "bad.sh", """
+        #!/bin/bash
+        exit 3
+        """)
+    empty_script = _write_script(tmp_path / "empty.sh", """
+        #!/bin/bash
+        true
+        """)
+    s = create_sum_stat(str(tmp_path / "whatever"))
+    assert np.isnan(ExternalDistance("bash", bad_script)(s, s))
+    assert np.isnan(ExternalDistance("bash", empty_script)(s, s))
+
+
+def test_r_call_expression_builder():
+    expr = _r_call_expr("/x/model.R", "myModel",
+                        [_dict_to_r_list({"a": 1.0, "b": 2.5})], "/tmp/t")
+    assert 'source("/x/model.R")' in expr
+    assert "myModel(list(a=1.0, b=2.5))" in expr
+    assert 'file="/tmp/t"' in expr
+    # bare numeric returns get synthesized names (v1, v2, ...)
+    assert 'names(.res) <- paste0("v", seq_along(.res))' in expr
+    # zero-arg form resolves a named object (observation accessor)
+    expr0 = _r_call_expr("/x/model.R", "obs", [], "/tmp/t")
+    assert ".res <- obs;" in expr0
+
+
+def test_r_requires_backend():
+    has_r = shutil.which("Rscript") is not None
+    try:
+        import rpy2  # noqa: F401
+        has_r = True
+    except ImportError:
+        pass
+    if has_r:
+        pytest.skip("an R backend is available")
+    with pytest.raises(ImportError, match="Rscript"):
+        R("/nonexistent/model.R")
+
+
+@pytest.mark.skipif(shutil.which("Rscript") is None,
+                    reason="no Rscript binary")
+def test_r_bridge_live(tmp_path):
+    source = tmp_path / "model.R"
+    source.write_text(textwrap.dedent("""
+        myModel <- function(pars) list(y = pars$mu * 2)
+        mySummary <- function(x) list(s = x$y + 1)
+        myDistance <- function(x, y) list(d = abs(x$s - y$s))
+        myObservation <- list(s = 3.0)
+        """))
+    r = R(str(source))
+    assert r.model("myModel")({"mu": 1.5}) == {"y": 3.0}
+    assert r.summary_statistics("mySummary")({"y": 3.0}) == {"s": 4.0}
+    assert r.distance("myDistance")({"s": 4.0}, {"s": 3.0}) == 1.0
+    assert r.observation("myObservation") == {"s": 3.0}

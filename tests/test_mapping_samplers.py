@@ -8,20 +8,85 @@ import pyabc_tpu as pt
 from pyabc_tpu.models import make_two_gaussians_problem
 
 
+class FakeDaskClient:
+    """Thread-pool stand-in for ``distributed.Client``: same submit/ncores/
+    close surface, so DaskDistributedSampler's scheduling runs without the
+    optional dask dependency (the reference skips its dask tests the same
+    way when dask is absent)."""
+
+    def __init__(self, n_workers: int = 4):
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=n_workers)
+        self._n = n_workers
+
+    def submit(self, fn, *args, pure=None):
+        return self._pool.submit(fn, *args)
+
+    def ncores(self):
+        return {f"w{i}": 1 for i in range(self._n)}
+
+    def close(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _dask_sampler():
+    try:
+        import distributed  # noqa: F401
+        return pt.DaskDistributedSampler(batch_size=8, client_max_jobs=4)
+    except ImportError:
+        return pt.DaskDistributedSampler(
+            dask_client=FakeDaskClient(), batch_size=8, client_max_jobs=4)
+
+
 @pytest.mark.parametrize("make_sampler", [
     lambda: pt.MappingSampler(map_=map),
     lambda: pt.ConcurrentFutureSampler(client_max_jobs=4, batch_size=8),
-], ids=["mapping", "cfuture"])
+    _dask_sampler,
+], ids=["mapping", "cfuture", "dask"])
 def test_blessed_problem_small(db_path, make_sampler):
     models, priors, distance, observed, posterior_fn = \
         make_two_gaussians_problem()
+    sampler = make_sampler()
     abc = pt.ABCSMC(models, priors, distance, population_size=60,
-                    sampler=make_sampler(), seed=11)
+                    sampler=sampler, seed=11)
     abc.new(db_path, observed)
     h = abc.run(max_nr_populations=2)
     assert h.max_t >= 1
     probs = h.get_model_probabilities(h.max_t)
     assert float(sum(probs)) == pytest.approx(1.0, abs=1e-5)
+    sampler.stop()
+
+
+def test_dask_sampler_requires_client_or_dask():
+    """Without dask installed and without a client, construction raises a
+    clear ImportError (lazy optional dependency, as in the reference)."""
+    try:
+        import distributed  # noqa: F401
+        pytest.skip("dask installed: local-cluster default applies")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="distributed"):
+        pt.DaskDistributedSampler()
+
+
+def test_dask_sampler_pickles_without_client():
+    s = pt.DaskDistributedSampler(dask_client=FakeDaskClient())
+    state = s.__getstate__()
+    assert "my_client" not in state  # reference dask_sampler.py:64-67
+    s2 = pt.DaskDistributedSampler.__new__(pt.DaskDistributedSampler)
+    s2.__setstate__(state)
+    assert s2.my_client is None  # lazily re-resolved by _client()
+
+
+def test_cfuture_stop_keeps_user_executor():
+    """stop() must not shut down a caller-provided executor
+    (code-review regression test)."""
+    from concurrent.futures import ThreadPoolExecutor
+    pool = ThreadPoolExecutor(max_workers=2)
+    s = pt.ConcurrentFutureSampler(cfuture_executor=pool)
+    s.stop()
+    assert pool.submit(lambda: 1).result() == 1  # still alive
+    pool.shutdown()
 
 
 def test_sge_local_fallback(tmp_path):
