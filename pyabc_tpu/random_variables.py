@@ -397,6 +397,8 @@ class Distribution:
     def rvs_array(self, key, n: Optional[int] = None) -> Array:
         """Draw ``[n, dim]`` (or ``[dim]`` if n is None) prior samples."""
         shape = () if n is None else (n,)
+        if not self._rvs:  # zero-parameter model (e.g. pure model choice)
+            return jnp.zeros(shape + (0,), dtype=jnp.float32)
         keys = jax.random.split(key, len(self._rvs))
         cols = [
             rv.sample(k, shape) for k, rv in zip(keys, self._rvs.values())

@@ -12,7 +12,6 @@ Run: ``python -m pyabc_tpu.visserver.server --db abc.db --port 8765``.
 from __future__ import annotations
 
 import io
-import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse
 
@@ -82,12 +81,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _population(self, abc_id: int, m: int, t: int):
         h = History(self.db_path, abc_id=abc_id)
         df, w = h.get_distribution(m=m, t=t)
-        imgs = "".join(
-            f'<h3>{name}</h3><img src="/plot/{abc_id}/{m}/{t}?{i}">'
-            for i, name in enumerate(df.columns))
         self._send(_PAGE.format(body=(
             f"<h1>run {abc_id} / model {m} / t={t}</h1>"
-            f"<p>{len(df)} particles</p>"
+            f"<p>{len(df)} particles, parameters: "
+            f"{', '.join(df.columns)}</p>"
             f'<img src="/plot/{abc_id}/{m}/{t}">')))
 
     def _kde_png(self, abc_id: int, m: int, t: int):

@@ -1,0 +1,78 @@
+"""IntegratedModel early-reject path (VERDICT r1 weak #10: untested).
+
+Parity: reference pyabc/model.py:273-328 — a model that fuses simulation
+with an early rejection decision; on TPU the decision is a mask the round
+kernel ORs into rejection (sampler/rounds.py _simulate_all).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.model import IntegratedModel, ModelResult
+
+
+class ThresholdModel(IntegratedModel):
+    """y = theta + noise; candidates with theta > cut early-reject."""
+
+    def __init__(self, cut: float = 0.5):
+        super().__init__(name="threshold")
+        self.cut = cut
+
+    def integrated_simulate(self, key, theta, eps):
+        mu = theta[:, 0]
+        y = mu + 0.1 * jax.random.normal(key, mu.shape)
+        return ModelResult(sum_stats={"y": y},
+                           early_reject=mu > self.cut)
+
+
+def test_integrated_simulate_masks():
+    m = ThresholdModel(cut=0.5)
+    theta = jnp.asarray([[0.2], [0.8]])
+    res = m.integrated_simulate(jax.random.PRNGKey(0), theta,
+                                jnp.float32(jnp.inf))
+    assert np.asarray(res.early_reject).tolist() == [False, True]
+    # plain simulate() drops the mask (Model API parity)
+    stats = m.simulate(jax.random.PRNGKey(0), theta)
+    assert stats["y"].shape == (2,)
+
+
+def test_integrated_model_early_reject_e2e(db_path):
+    """The accepted population contains NO early-rejected region even
+    though the acceptance threshold alone would admit it."""
+    abc = pt.ABCSMC(
+        models=ThresholdModel(cut=0.5),
+        parameter_priors=pt.Distribution(mu=pt.RV("uniform", 0.0, 1.0)),
+        distance_function=pt.PNormDistance(p=2),
+        population_size=200,
+        sampler=pt.VectorizedSampler(),
+        seed=6)
+    abc.new(db_path, {"y": 0.5})
+    h = abc.run(max_nr_populations=2)
+    df, w = h.get_distribution(m=0)
+    mu = df["mu"].to_numpy()
+    # observed y=0.5 sits at the cut: without the early-reject mask about
+    # half the mass would land above it
+    assert float(mu.max()) <= 0.5 + 1e-6
+    assert len(mu) == 200
+
+
+def test_max_nr_recorded_particles_wired(db_path):
+    """ABCSMC.max_nr_recorded_particles caps the sampler's record buffers
+    (VERDICT r1 weak #7: stored but never wired)."""
+    models, priors, distance, observed, _ = \
+        __import__("pyabc_tpu.models", fromlist=["x"]) \
+        .make_two_gaussians_problem()
+    sampler = pt.VectorizedSampler()
+    abc = pt.ABCSMC(models, priors,
+                    pt.AdaptivePNormDistance(),  # requests record_rejected
+                    population_size=50,
+                    sampler=sampler,
+                    max_nr_recorded_particles=64,
+                    seed=3)
+    abc.new(db_path, observed)
+    abc.run(max_nr_populations=2)
+    assert sampler.max_records == 64
+    assert sampler.record_rejected
