@@ -14,6 +14,7 @@ black-box simulator (Python, shell, R) can be used — at host speed, batched.
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import tempfile
@@ -54,7 +55,22 @@ class HostFunctionModel(Model):
         seed = jax.random.randint(key, (), 0, 2**31 - 1)
 
         def host_fn(theta_np, seed_np):
-            out = self.fn(np.asarray(theta_np), int(seed_np))
+            # a raising user model must not kill the run: return NaN stats
+            # so the round's isfinite mask self-rejects the candidate batch
+            # (parity: reference redis_eps/cli.py:141-145 warns + discards)
+            try:
+                out = self.fn(np.asarray(theta_np), int(seed_np))
+            except Exception as err:
+                logging.getLogger("ABC.External").warning(
+                    "host model %s failed (%s: %s) — batch rejected",
+                    self.name, type(err).__name__, err)
+                return tuple(
+                    np.full((n,) + self.stat_shapes[k], np.nan,
+                            dtype=np.float32)
+                    for k in keys)
+            # deliberately OUTSIDE the try: a missing stat key or a wrong
+            # output shape is deterministic API misuse and must raise, not
+            # be silently rejected forever
             return tuple(
                 np.asarray(out[k], dtype=np.float32).reshape(
                     (n,) + self.stat_shapes[k])

@@ -1,5 +1,7 @@
 """Device-mesh / distributed helpers."""
 
+from . import health
+from .health import Heartbeat, healthy, stop_requested, worker_status
 from .mesh import (
     PARTICLE_AXIS,
     initialize_distributed,
@@ -9,4 +11,5 @@ from .mesh import (
 )
 
 __all__ = ["PARTICLE_AXIS", "make_mesh", "particle_sharding", "replicated",
-           "initialize_distributed"]
+           "initialize_distributed", "health", "Heartbeat", "healthy",
+           "worker_status", "stop_requested"]

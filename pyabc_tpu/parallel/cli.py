@@ -29,14 +29,44 @@ import click
               help="coordinator address host:port (jax.distributed)")
 @click.option("--num-processes", default=None, type=int)
 @click.option("--process-id", default=None, type=int)
+@click.option("--run-dir", default=None,
+              help="shared dir for heartbeats + clean-stop (any FS all "
+                   "hosts mount)")
 @click.argument("script")
-def work(coordinator, num_processes, process_id, script):
+def work(coordinator, num_processes, process_id, run_dir, script):
     """Join the cluster and run SCRIPT (every host runs the same program)."""
+    import os
+
+    import jax
+
+    from . import health
     from .mesh import initialize_distributed
 
     initialize_distributed(coordinator, num_processes, process_id)
-    sys.argv = [script]
-    runpy.run_path(script, run_name="__main__")
+    hb = None
+    if run_dir:
+        os.environ[health.RUN_DIR_ENV] = run_dir
+        # a fresh worker launch means the operator wants to run: consume
+        # any STOP left over from a previous `manager stop` (process 0
+        # clears; clearing is idempotent)
+        if jax.process_index() == 0:
+            health.clear_stop(run_dir)
+        hb = health.Heartbeat(
+            run_dir, process_index=jax.process_index()).start()
+    try:
+        sys.argv = [script]
+        runpy.run_path(script, run_name="__main__")
+    except BaseException as err:
+        # sys.exit(0)/sys.exit(None) is a clean exit; anything else leaves
+        # the heartbeat file so `info` reports this worker STALE instead
+        # of silently absent
+        clean = isinstance(err, SystemExit) and err.code in (0, None)
+        if hb is not None:
+            hb.stop(remove=clean)
+        raise
+    else:
+        if hb is not None:
+            hb.stop()
 
 
 @click.group("abc-distributed-manager")
@@ -45,13 +75,49 @@ def manage():
 
 
 @manage.command()
-def info():
-    """Show the global device topology."""
+@click.option("--run-dir", default=None,
+              help="shared run dir — report worker heartbeats")
+def info(run_dir):
+    """Show worker health (with --run-dir) or this host's device topology
+    — the reference ``abc-redis-manager info`` analog
+    (redis_eps/cli.py:265-276)."""
+    if run_dir:
+        from . import health
+        status = health.worker_status(run_dir)
+        alive = sum(e["alive"] for e in status)
+        click.echo(f"Workers={len(status)} Alive={alive}")
+        for e in status:
+            state = "alive" if e["alive"] else "STALE"
+            click.echo(f"  {e['host']}:{e['pid']} "
+                       f"proc={e['process_index']} {state}")
+        return
     import jax
 
     click.echo(f"process {jax.process_index()}/{jax.process_count()}")
     click.echo(f"local devices: {jax.local_devices()}")
     click.echo(f"global devices: {len(jax.devices())}")
+
+
+@manage.command()
+@click.option("--run-dir", required=True)
+def stop(run_dir):
+    """Clean-stop: every host's ABCSMC exits after the current generation
+    (reference ``abc-redis-manager stop``, redis_eps/cli.py:276-277)."""
+    from . import health
+
+    health.request_stop(run_dir)
+    click.echo("stop requested")
+
+
+@manage.command("reset-workers")
+@click.option("--run-dir", required=True)
+def reset_workers(run_dir):
+    """Clear stale heartbeats after a crash (reference ``reset-workers``,
+    redis_eps/cli.py:279-280)."""
+    from . import health
+
+    removed = health.reset_workers(run_dir)
+    click.echo(f"removed {removed} stale worker record(s)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,202 @@
+"""Worker health, heartbeats and clean-stop for the distributed backend.
+
+Parity targets:
+
+- worker-death detection — reference ``multicorebase.py:78-105``
+  (``healthy`` / ``get_if_worker_healthy``): the reference polls process
+  exit codes; the TPU-native cluster has no broker process, so each host
+  heartbeats into a shared run directory (any filesystem all hosts mount —
+  NFS/GCS-fuse) and the manager CLI reads the files.
+- ``abc-redis-manager info|stop|reset-workers`` — reference
+  ``redis_eps/cli.py:244-282``: ``info`` reports live/stale workers,
+  ``stop`` asks every host's ABCSMC to exit cleanly after the current
+  generation (a sentinel file, polled by the orchestrator between
+  generations), ``reset-workers`` clears stale heartbeat files after a
+  crash.
+
+The run directory is advertised to workers via the environment variable
+``PYABC_TPU_RUN_DIR`` (set by ``abc-distributed-worker --run-dir``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+RUN_DIR_ENV = "PYABC_TPU_RUN_DIR"
+STOP_SENTINEL = "STOP"
+#: a heartbeat older than this is considered dead
+STALE_AFTER_S = 30.0
+_HB_PREFIX = "hb_"
+
+
+def run_dir() -> Optional[str]:
+    """The shared run directory advertised to this process, if any."""
+    return os.environ.get(RUN_DIR_ENV)
+
+
+class Heartbeat:
+    """Background thread writing ``hb_<host>_<pid>.json`` every interval.
+
+    Start on worker bring-up (``abc-distributed-worker`` does this when
+    ``--run-dir`` is given); the manager's ``info`` reads the files.
+    """
+
+    def __init__(self, directory: str, interval_s: float = 5.0,
+                 process_index: Optional[int] = None):
+        self.directory = directory
+        self.interval_s = interval_s
+        self.process_index = process_index
+        self.path = os.path.join(
+            directory, f"{_HB_PREFIX}{socket.gethostname()}_{os.getpid()}"
+                       ".json")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self):
+        os.makedirs(self.directory, exist_ok=True)
+        payload = {
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "process_index": self.process_index,
+            "ts": time.time(),
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.path)  # atomic on POSIX
+
+    def start(self) -> "Heartbeat":
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.beat()
+                except OSError:  # shared FS hiccup — retry next interval
+                    pass
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="abc-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, remove: bool = True):
+        """Stop beating. ``remove=True`` (clean exit) deregisters the
+        worker; ``remove=False`` (crash path) leaves the last heartbeat in
+        place so ``info`` reports the worker as STALE instead of silently
+        absent — the worker-death-detection contract
+        (multicorebase.py:78-105)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s)
+        if remove:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop(remove=exc_type is None)
+
+
+def worker_status(directory: str,
+                  stale_after_s: float = STALE_AFTER_S) -> List[Dict]:
+    """All workers that ever heartbeat into ``directory``, newest first.
+
+    Each entry carries ``alive`` (heartbeat within ``stale_after_s``) —
+    the reference's ``healthy()`` analog.
+    """
+    now = time.time()
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(_HB_PREFIX) and name.endswith(".json")):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+            # liveness from the file's mtime — one clock (the fileserver's)
+            # on both sides, immune to worker↔manager wall-clock skew;
+            # the embedded ts is informational only
+            mtime = os.stat(path).st_mtime
+        except (OSError, ValueError):
+            continue
+        entry["alive"] = (now - mtime) <= stale_after_s
+        entry["last_seen"] = mtime
+        out.append(entry)
+    out.sort(key=lambda e: -e["last_seen"])
+    return out
+
+
+def healthy(directory: str,
+            stale_after_s: float = STALE_AFTER_S) -> bool:
+    """True iff every registered worker heartbeat recently."""
+    status = worker_status(directory, stale_after_s)
+    return bool(status) and all(e["alive"] for e in status)
+
+
+def reset_workers(directory: str,
+                  stale_after_s: float = STALE_AFTER_S) -> int:
+    """Remove stale heartbeat files (reference ``reset-workers``,
+    redis_eps/cli.py:279-280). Returns the number removed."""
+    removed = 0
+    for entry in worker_status(directory, stale_after_s):
+        if not entry["alive"]:
+            path = os.path.join(
+                directory,
+                f"{_HB_PREFIX}{entry['host']}_{entry['pid']}.json")
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def request_stop(directory: str):
+    """Ask every host's ABCSMC to exit after the current generation
+    (reference ``stop``, redis_eps/cli.py:276-277)."""
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, STOP_SENTINEL), "w") as f:
+        f.write(str(time.time()))
+
+
+def clear_stop(directory: str):
+    try:
+        os.remove(os.path.join(directory, STOP_SENTINEL))
+    except OSError:
+        pass
+
+
+def stop_requested(directory: Optional[str] = None) -> bool:
+    """Polled by the orchestrator between generations.
+
+    Multi-host safe: with >1 ``jax.distributed`` processes the decision is
+    taken on process 0 and broadcast through a collective, so every host
+    leaves the generation loop at the SAME boundary — a per-host filesystem
+    poll could desynchronize (NFS attribute-cache lag) and strand one host
+    inside the next generation's collectives.
+    """
+    directory = directory if directory is not None else run_dir()
+    if not directory:
+        return False
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        import numpy as np
+        local = (jax.process_index() == 0 and
+                 os.path.exists(os.path.join(directory, STOP_SENTINEL)))
+        return bool(multihost_utils.broadcast_one_to_all(
+            np.asarray(local)))
+    return os.path.exists(os.path.join(directory, STOP_SENTINEL))

@@ -66,6 +66,23 @@ class EPSMixin:
         except Exception:  # cancellation is best-effort on every backend
             pass
 
+    def _recover(self):
+        """Recover from a broken backend (all in-flight work lost).
+
+        Return True if the backend was rebuilt and sampling may continue
+        (the scheduler resubmits lost work), False to re-raise.  Default:
+        not recoverable.  Parity: the reference detects worker death
+        (multicorebase.py:78-105 ``get_if_worker_healthy``) and raises;
+        samplers that own their executor can do better and rebuild it.
+        """
+        return False
+
+    #: abort after this many consecutive failed batches with no progress —
+    #: distinguishes a persistently-crashing model from sporadic failures
+    #: (the reference loops forever on an always-raising model; see
+    #: redis_eps/cli.py:141-145 which only warns per failure)
+    max_consecutive_failures: int = 64
+
     def sample_until_n_accepted(self, n, round_fn, key, params,
                                 max_eval=np.inf, all_accepted=False,
                                 **kwargs) -> Sample:
@@ -85,14 +102,18 @@ class EPSMixin:
         in_flight = {}
         results = {}
         harvested = 0  # next submission id to account
+        failed_evals = 0
+        consecutive_failures = 0
         try:
             while True:
                 # submission-order accounting (reference eps_mixin.py:62-81)
                 while harvested in results:
-                    sample.append_round(results.pop(harvested))
+                    rr = results.pop(harvested)
+                    if rr is not None:  # None = failed batch, nothing to add
+                        sample.append_round(rr)
                     harvested += 1
                 if sample.n_accepted >= n or (
-                        sample.nr_evaluations >= max_eval
+                        sample.nr_evaluations + failed_evals >= max_eval
                         and sample.n_accepted < n):
                     break
                 while len(in_flight) < max_jobs:
@@ -100,11 +121,44 @@ class EPSMixin:
                     in_flight[fut] = next_seed
                     next_seed += 1
                 done = self._wait_any(list(in_flight))
-                seed, rr = done.result()
+                try:
+                    seed, rr = done.result()
+                    consecutive_failures = 0
+                except Exception as err:  # model error or dead worker
+                    seed = in_flight[done]
+                    rr = None
+                    failed_evals += B
+                    consecutive_failures += 1
+                    logger.warning(
+                        "batch %d failed (%s: %s) — discarded, continuing "
+                        "with fresh work", seed, type(err).__name__, err)
+                    if consecutive_failures > self.max_consecutive_failures:
+                        raise RuntimeError(
+                            f"{consecutive_failures} consecutive batch "
+                            "failures — model or cluster is persistently "
+                            "broken") from err
+                    if self._is_broken_backend(err):
+                        # in-flight futures all died with the backend —
+                        # drop them and resubmit their seeds after recovery
+                        if not self._recover():
+                            raise
+                        lost = sorted(s for s in in_flight.values()
+                                      if s != seed)
+                        in_flight = {}
+                        for s in lost:
+                            in_flight[self._submit(eval_batch, s)] = s
+                        results[seed] = None
+                        continue
                 del in_flight[done]
                 results[seed] = rr
         finally:
             for fut in in_flight:
                 self._cancel(fut)
-        self.nr_evaluations_ = sample.nr_evaluations
+        self.nr_evaluations_ = sample.nr_evaluations + failed_evals
         return sample
+
+    @staticmethod
+    def _is_broken_backend(err: Exception) -> bool:
+        """Whether the error means the whole backend died (vs one batch)."""
+        from concurrent.futures import BrokenExecutor
+        return isinstance(err, BrokenExecutor)

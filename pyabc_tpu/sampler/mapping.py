@@ -98,6 +98,20 @@ class ConcurrentFutureSampler(EPSMixin, Sampler):
     def _wait_any(self, futures):
         return next(as_completed(futures))
 
+    def _recover(self):
+        """Rebuild a broken owned executor (worker-death recovery; parity
+        with reference worker-death detection, multicorebase.py:78-105 —
+        but elastic: lost batches are resubmitted instead of aborting)."""
+        if not self._owns_executor:
+            return False
+        logger.warning("executor broke — rebuilding and resubmitting")
+        try:
+            self.executor.shutdown(wait=False, cancel_futures=True)
+        except Exception:
+            pass
+        self.executor = None  # _submit lazily re-creates
+        return True
+
     def stop(self):
         # only tear down executors this sampler created — a caller-provided
         # executor may carry the caller's unrelated work

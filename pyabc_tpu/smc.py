@@ -26,6 +26,7 @@ from .acceptor import Acceptor, StochasticAcceptor, UniformAcceptor
 from .distance import Distance, PNormDistance, StochasticKernel, to_distance
 from .epsilon import Epsilon, MedianEpsilon, TemperatureBase
 from .model import Model, SimpleModel
+from .parallel.health import stop_requested
 from .population import Population
 from .populationstrategy import ConstantPopulationSize, PopulationStrategy
 from .random_variables import Distribution, ModelPerturbationKernel
@@ -379,6 +380,13 @@ class ABCSMC:
                  if np.isfinite(max_nr_populations) else np.inf)
         total_sims = 0
         while t < t_max:
+            # operator clean-stop (abc-distributed-manager stop): exit
+            # between generations, like the reference's Redis STOP message
+            # (redis_eps/cli.py:276-277) — state is already durable in the
+            # History, so a later run() resumes exactly here
+            if stop_requested():
+                logger.info("Stopping: operator stop requested")
+                break
             current_eps = float(self.eps(t))
 
             n = self.population_strategy(t)

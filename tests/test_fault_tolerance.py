@@ -1,0 +1,197 @@
+"""Failure detection + elastic recovery (parity: reference
+test/base/test_samplers.py:259-281 ``test_redis_catch_error``,
+multicorebase.py:78-105 worker-death detection, redis_eps/cli.py:244-282
+manager info/stop/reset-workers)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.external import HostFunctionModel
+from pyabc_tpu.parallel import health
+
+
+# ---------------------------------------------------------------------------
+# randomly-raising model completes a run (reference test_redis_catch_error)
+# ---------------------------------------------------------------------------
+
+def _flaky_fn(theta, seed):
+    """10%-flaky host simulator — raises like the reference's error model."""
+    rng = np.random.default_rng(seed)
+    if rng.uniform() < 0.1:
+        raise ValueError("error")
+    mu = np.asarray(theta)[:, 0]
+    return {"s0": mu + 0.2 * rng.uniform(size=mu.shape)}
+
+
+def test_vectorized_catches_model_error(db_path):
+    """HostFunctionModel catches a raising user model and returns NaN stats;
+    the round's isfinite mask rejects the batch and the run completes."""
+    model = HostFunctionModel(_flaky_fn, stat_shapes={"s0": ()})
+    abc = pt.ABCSMC(
+        model,
+        pt.Distribution(p0=pt.RV("uniform", 0.0, 10.0)),
+        pt.PNormDistance(p=2),
+        population_size=10,
+        sampler=pt.VectorizedSampler(min_batch_size=8, max_batch_size=32),
+        seed=7)
+    abc.new(db_path, {"s0": 2.8})
+    h = abc.run(max_nr_populations=3)
+    assert h.max_t >= 1
+
+
+def test_cfuture_resubmits_failed_batches(db_path):
+    """EPSMixin accounts failed futures and keeps submitting fresh work."""
+    model = HostFunctionModel(_flaky_fn, stat_shapes={"s0": ()})
+    sampler = pt.ConcurrentFutureSampler(client_max_jobs=4, batch_size=4)
+    abc = pt.ABCSMC(
+        model,
+        pt.Distribution(p0=pt.RV("uniform", 0.0, 10.0)),
+        pt.PNormDistance(p=2),
+        population_size=10,
+        sampler=sampler,
+        seed=8)
+    abc.new(db_path, {"s0": 2.8})
+    h = abc.run(max_nr_populations=2)
+    assert h.max_t >= 1
+    sampler.stop()
+
+
+def test_eps_mixin_aborts_on_persistent_failure():
+    """A model that ALWAYS fails must abort with a clear error, not hang."""
+
+    class Boom(Exception):
+        pass
+
+    sampler = pt.ConcurrentFutureSampler(client_max_jobs=2, batch_size=1)
+    sampler.max_consecutive_failures = 5
+
+    def round_fn(key, params, B, **kw):
+        raise Boom("model always fails")
+
+    with pytest.raises(RuntimeError, match="consecutive batch"):
+        import jax
+        sampler.sample_until_n_accepted(
+            4, round_fn, jax.random.PRNGKey(0), {})
+    sampler.stop()
+
+
+def test_cfuture_recovers_from_broken_executor():
+    """BrokenExecutor → owned executor is rebuilt, lost seeds resubmitted
+    (elastic worker-death recovery; reference aborts, we recover)."""
+    from concurrent.futures import BrokenExecutor
+
+    import jax
+
+    from pyabc_tpu.sampler.base import RoundResult
+
+    sampler = pt.ConcurrentFutureSampler(client_max_jobs=2, batch_size=2)
+
+    calls = {"n": 0}
+
+    def round_fn(key, params, B, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise BrokenExecutor("worker died")
+        n = B
+        return RoundResult(
+            m=np.zeros(n, np.int32),
+            theta=np.zeros((n, 1), np.float32),
+            distance=np.full(n, 0.1, np.float32),
+            accepted=np.ones(n, bool),
+            log_weight=np.zeros(n, np.float32),
+            stats=np.zeros((n, 1), np.float32))
+
+    sample = sampler.sample_until_n_accepted(
+        6, round_fn, jax.random.PRNGKey(0), {})
+    assert sample.n_accepted >= 6
+    # the broken batch counted as failed evaluations
+    assert sampler.nr_evaluations_ >= 6 + 2
+    sampler.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + manager info / stop / reset-workers
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_and_worker_status(tmp_path):
+    d = str(tmp_path / "run")
+    hb = health.Heartbeat(d, interval_s=0.05, process_index=0)
+    with hb:
+        time.sleep(0.1)
+        status = health.worker_status(d)
+        assert len(status) == 1 and status[0]["alive"]
+        assert status[0]["pid"] == os.getpid()
+        assert health.healthy(d)
+    # clean stop removes the heartbeat file
+    assert health.worker_status(d) == []
+
+
+def test_heartbeat_kept_on_crash(tmp_path):
+    """A worker dying with an exception must stay visible (as STALE) to
+    `info` — the worker-death-detection contract."""
+    d = str(tmp_path / "run")
+    with pytest.raises(RuntimeError):
+        with health.Heartbeat(d, interval_s=0.05):
+            time.sleep(0.1)
+            raise RuntimeError("worker crashed")
+    status = health.worker_status(d, stale_after_s=1e9)
+    assert len(status) == 1  # record survives the crash
+
+
+def test_stale_worker_detected_and_reset(tmp_path):
+    d = str(tmp_path / "run")
+    hb = health.Heartbeat(d, interval_s=100.0, process_index=3)
+    hb.beat()  # single beat, no thread — then simulate death by going stale
+    time.sleep(0.01)
+    status = health.worker_status(d, stale_after_s=0.0)
+    assert len(status) == 1 and not status[0]["alive"]
+    assert not health.healthy(d, stale_after_s=0.0)
+    # reference reset-workers analog: clear the stale record
+    removed = health.reset_workers(d, stale_after_s=0.0)
+    assert removed == 1
+    assert health.worker_status(d) == []
+
+
+def test_stop_sentinel_ends_run_between_generations(db_path, tmp_path,
+                                                    monkeypatch):
+    """abc-distributed-manager stop → ABCSMC exits cleanly after the
+    current generation; resume picks up from the History."""
+    d = str(tmp_path / "run")
+    monkeypatch.setenv(health.RUN_DIR_ENV, d)
+    from pyabc_tpu.models import make_two_gaussians_problem
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=40,
+                    sampler=pt.VectorizedSampler(max_batch_size=1024),
+                    seed=3)
+    abc.new(db_path, observed)
+    health.request_stop(d)
+    h = abc.run(max_nr_populations=5)
+    # stop observed before the first generation → nothing run
+    assert h.n_populations == 0
+    health.clear_stop(d)
+    h = abc.run(max_nr_populations=2)
+    assert h.n_populations >= 1
+
+
+def test_manager_cli_info_and_reset(tmp_path):
+    """Click-level smoke of the manager commands."""
+    from click.testing import CliRunner
+
+    from pyabc_tpu.parallel.cli import manage
+
+    d = str(tmp_path / "run")
+    health.Heartbeat(d, process_index=1).beat()
+    runner = CliRunner()
+    res = runner.invoke(manage, ["info", "--run-dir", d])
+    assert res.exit_code == 0 and "Workers=1" in res.output
+    res = runner.invoke(manage, ["stop", "--run-dir", d])
+    assert res.exit_code == 0
+    assert health.stop_requested(d)
+    res = runner.invoke(manage, ["reset-workers", "--run-dir", d])
+    assert res.exit_code == 0
