@@ -1,0 +1,93 @@
+"""Tagged bytes (de)serialization for arbitrary summary statistics.
+
+Parity: pyabc/storage/bytes_storage.py + dataframe_bytes_storage.py
+(reference stores ANY sum-stat type — numpy arrays, DataFrames, Series,
+scalars, strings, raw bytes — as tagged blobs; dataframe_bytes_storage.py:
+102-104 round-trips DataFrames via parquet/msgpack).
+
+Design: each object serializes to ``(tag, bytes)``; the tag picks the
+decoder on read.  Fast paths are non-executable formats (``.npy`` with
+``allow_pickle=False``, parquet, JSON); stdlib pickle is the LAST-resort
+fallback for exotic user types, mirroring the reference's use of
+cloudpickle for unknown objects — only load databases you trust.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+from typing import Any, Tuple
+
+import numpy as np
+import pandas as pd
+
+TAG_NPY = "npy"
+TAG_DF = "df"
+TAG_SERIES = "series"
+TAG_JSON = "json"
+TAG_BYTES = "bytes"
+TAG_PICKLE = "pickle"
+
+
+def to_bytes(obj: Any) -> Tuple[str, bytes]:
+    """Serialize ``obj`` to a ``(tag, blob)`` pair."""
+    if isinstance(obj, pd.DataFrame):
+        buf = io.BytesIO()
+        obj.to_parquet(buf)
+        return TAG_DF, buf.getvalue()
+    if isinstance(obj, pd.Series):
+        buf = io.BytesIO()
+        obj.to_frame(name=obj.name if obj.name is not None else "__series__"
+                     ).to_parquet(buf)
+        return TAG_SERIES, buf.getvalue()
+    if isinstance(obj, np.ndarray) and obj.dtype != object:
+        buf = io.BytesIO()
+        np.save(buf, obj, allow_pickle=False)
+        return TAG_NPY, buf.getvalue()
+    if isinstance(obj, (bytes, bytearray)):
+        return TAG_BYTES, bytes(obj)
+    if isinstance(obj, (bool, int, float, str)) or obj is None:
+        return TAG_JSON, json.dumps(obj).encode()
+    # jax / generic array-likes with a numeric dtype
+    arr = None
+    try:
+        arr = np.asarray(obj)
+    except Exception:
+        pass
+    if arr is not None and arr.dtype != object:
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        return TAG_NPY, buf.getvalue()
+    if isinstance(obj, (list, tuple, dict)):
+        try:
+            return TAG_JSON, json.dumps(obj).encode()
+        except (TypeError, ValueError):
+            pass
+    try:  # cloudpickle handles locally-defined classes (reference uses it
+        # for exactly this in the sampler layer)
+        import cloudpickle
+        return TAG_PICKLE, cloudpickle.dumps(obj)
+    except ImportError:
+        return TAG_PICKLE, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def from_bytes(tag: str, blob: bytes) -> Any:
+    """Inverse of :func:`to_bytes`."""
+    if tag == TAG_NPY:
+        return np.load(io.BytesIO(blob), allow_pickle=False)
+    if tag == TAG_DF:
+        return pd.read_parquet(io.BytesIO(blob))
+    if tag == TAG_SERIES:
+        df = pd.read_parquet(io.BytesIO(blob))
+        s = df.iloc[:, 0]
+        if s.name == "__series__":
+            s.name = None
+        return s
+    if tag == TAG_JSON:
+        return json.loads(blob.decode())
+    if tag == TAG_BYTES:
+        return blob
+    if tag == TAG_PICKLE:
+        return pickle.loads(blob)
+    raise ValueError(f"unknown storage tag {tag!r}")

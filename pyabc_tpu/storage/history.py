@@ -34,6 +34,7 @@ import numpy as np
 import pandas as pd
 
 from ..population import Population
+from .bytes_storage import from_bytes, to_bytes
 
 PRE_TIME = -1  # calibration-sample time index (reference history.py:135)
 
@@ -73,6 +74,7 @@ CREATE TABLE IF NOT EXISTS observed_data (
     abc_smc_id INTEGER,
     key TEXT,
     value BLOB,
+    tag TEXT DEFAULT 'npy',
     PRIMARY KEY (abc_smc_id, key)
 );
 """
@@ -102,8 +104,21 @@ class History:
         self.db_path = ":memory:" if self.in_memory else db
         self._conn = sqlite3.connect(self.db_path)
         self._conn.executescript(_SCHEMA)
+        self._migrate()
         self._conn.commit()
         self.id = abc_id
+
+    def _migrate(self):
+        """In-place schema upgrades for databases written by older
+        versions (CREATE TABLE IF NOT EXISTS does not add new columns).
+        The ``DEFAULT 'npy'`` matches the old fixed-format blobs, so
+        pre-upgrade rows stay readable."""
+        cols = [r[1] for r in self._conn.execute(
+            "PRAGMA table_info(observed_data)").fetchall()]
+        if "tag" not in cols:
+            self._conn.execute(
+                "ALTER TABLE observed_data ADD COLUMN tag TEXT "
+                "DEFAULT 'npy'")
 
     # ---- run registration ------------------------------------------------
 
@@ -126,25 +141,35 @@ class History:
              population_strategy_json))
         self.id = cur.lastrowid
         for key, val in observed_sum_stat.items():
+            # arbitrary types survive storage (reference
+            # dataframe_bytes_storage.py:102-104: DataFrames & any object,
+            # not just float arrays)
+            tag, blob = to_bytes(val)
             self._conn.execute(
-                "INSERT OR REPLACE INTO observed_data VALUES (?,?,?)",
-                (self.id, key, _pack(np.asarray(val, dtype=np.float32))))
+                "INSERT OR REPLACE INTO observed_data VALUES (?,?,?,?)",
+                (self.id, key, blob, tag))
         self._conn.commit()
         return self.id
 
-    def observed_sum_stat(self) -> Dict[str, np.ndarray]:
+    def observed_sum_stat(self) -> Dict:
         rows = self._conn.execute(
-            "SELECT key, value FROM observed_data WHERE abc_smc_id=?",
+            "SELECT key, value, tag FROM observed_data WHERE abc_smc_id=?",
             (self.id,)).fetchall()
-        return {k: _unpack(v) for k, v in rows}
+        return {k: from_bytes(tag, v) for k, v, tag in rows}
 
     # ---- append (the per-generation durable write) -----------------------
 
     def append_population(self, t: int, current_epsilon: float,
                           population: Population, nr_simulations: int,
                           model_names: List[str],
-                          param_names: Optional[List[str]] = None):
-        """Bulk array-blob write (replaces reference history.py:617-693)."""
+                          param_names: Optional[List[str]] = None,
+                          stat_spec: Optional[dict] = None):
+        """Bulk array-blob write (replaces reference history.py:617-693).
+
+        ``stat_spec`` maps sum-stat key -> shape; stored alongside the flat
+        stats block so reads reconstruct keyed per-particle sum-stats
+        (:meth:`get_sum_stats`) without a row-per-statistic table.
+        """
         probs = np.asarray(population.get_model_probabilities(
             nr_models=len(model_names)))
         self._conn.execute(
@@ -171,7 +196,9 @@ class History:
                  int(idx.size),
                  _pack(theta[idx]), _pack(w[idx]), _pack(d[idx]),
                  _pack(stats[idx]) if stats is not None else None,
-                 json.dumps(list(names_m or [])), None))
+                 json.dumps(list(names_m or [])),
+                 json.dumps({k: list(v) for k, v in stat_spec.items()})
+                 if stat_spec else None))
         self._conn.commit()
 
     # ---- queries (reference history.py:269-330, 732-780, 1004-1078) ------
@@ -272,6 +299,50 @@ class History:
             weight=np.concatenate(ws),
             distance=np.concatenate(ds),
             sum_stats=sum_stats)
+
+    def get_sum_stats(self, t: Optional[int] = None, m: int = 0
+                      ) -> Dict[str, np.ndarray]:
+        """Keyed per-particle sum-stats ``{key: [N, *shape]}`` for model
+        ``m`` (reference history.py:732-780 ``get_sum_stats``; the flat
+        block + stored spec replace the row-per-statistic ORM)."""
+        t = self.max_t if t is None else t
+        row = self._conn.execute(
+            "SELECT stats, stat_spec FROM model_populations "
+            "WHERE abc_smc_id=? AND t=? AND m=?", (self.id, t, m)).fetchone()
+        if row is None or row[0] is None:
+            return {}
+        flat = _unpack(row[0])
+        if not row[1]:
+            return {"__flat__": flat}
+        spec = json.loads(row[1])
+        out, off = {}, 0
+        for k in sorted(spec):
+            shape = tuple(spec[k])
+            size = int(np.prod(shape, dtype=int))
+            out[k] = flat[:, off:off + size].reshape((flat.shape[0],) + shape)
+            off += size
+        return out
+
+    def get_weighted_sum_stats(self, t: Optional[int] = None
+                               ) -> Tuple[np.ndarray, List[Dict]]:
+        """(weights, one sum-stat dict per particle) across all models —
+        reference history.py:1004-1040 signature."""
+        t = self.max_t if t is None else t
+        rows = self._conn.execute(
+            "SELECT m, weight FROM model_populations WHERE abc_smc_id=? "
+            "AND t=? ORDER BY m", (self.id, t)).fetchall()
+        weights, dicts = [], []
+        for m, wb in rows:
+            w = _unpack(wb)
+            keyed = self.get_sum_stats(t, m)
+            n = w.shape[0]
+            weights.append(w)
+            for i in range(n):
+                dicts.append({k: v[i] for k, v in keyed.items()})
+        if not weights:
+            return np.zeros(0), []
+        w = np.concatenate(weights)
+        return w / max(w.sum(), 1e-300), dicts
 
     def get_population_strategy(self) -> dict:
         row = self._conn.execute(
