@@ -87,6 +87,25 @@ def plot_kde_2d(df, w, x: str, y: str, ax=None, colorbar: bool = True,
     return ax
 
 
+def plot_kde_1d_highlevel(history, x: str, m: int = 0, t=None, **kwargs):
+    """History-level 1D KDE (reference kde.py:144-192 highlevel form)."""
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_kde_1d(df, w, x, **kwargs)
+
+
+def plot_kde_2d_highlevel(history, x: str, y: str, m: int = 0, t=None,
+                          **kwargs):
+    """History-level 2D KDE (reference kde.py:266-330 highlevel form)."""
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_kde_2d(df, w, x, y, **kwargs)
+
+
+def plot_kde_matrix_highlevel(history, m: int = 0, t=None, **kwargs):
+    """History-level KDE matrix (reference kde.py:443-515)."""
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_kde_matrix(df, w, **kwargs)
+
+
 def plot_kde_matrix(df, w, limits: Optional[dict] = None, refval=None,
                     kde=None, names: Optional[list] = None):
     """Pairwise KDE matrix (reference kde.py:266-515)."""

@@ -73,14 +73,21 @@ def test_credible_intervals(history):
 
 
 def test_data_callback(history):
-    calls = []
+    calls, agg_calls = [], []
 
-    def f_plot(stats_row, ax):
-        calls.append(stats_row)
-        ax.plot(np.atleast_1d(stats_row))
+    def f_plot(sum_stat, weight, ax):
+        calls.append((sum_stat, weight))
+        for v in sum_stat.values():
+            ax.plot(np.atleast_1d(v))
 
-    _render(plot_data_callback(history, f_plot, n=5))
+    def f_plot_aggregated(sum_stats, weights, ax):
+        agg_calls.append(len(sum_stats))
+
+    _render(plot_data_callback(history, f_plot, f_plot_aggregated, n=5))
     assert 0 < len(calls) <= 5
+    assert agg_calls == [len(calls)]
+    # per-particle sum-stat dicts carry the model's keyed statistics
+    assert isinstance(calls[0][0], dict) and len(calls[0][0]) > 0
 
 
 def _synth_df():
@@ -108,6 +115,93 @@ def test_histograms():
     df, w = _synth_df()
     _render(plot_histogram_1d(df, w, "a", bins=20))
     _render(plot_histogram_2d(df, w, "a", "b", bins=20))
+
+
+def test_histogram_highlevel_and_matrix(history):
+    from pyabc_tpu.visualization import (
+        plot_histogram_matrix,
+        plot_histogram_matrix_lowlevel,
+    )
+
+    # reference highlevel form: (history, x, m=, t=)
+    _render(plot_histogram_1d(history, "mu", m=0, bins=15))
+    arr = plot_histogram_matrix(history, m=0, bins=10)
+    _render(arr[0][0])
+    df, w = _synth_df()
+    arr = plot_histogram_matrix_lowlevel(df, w, bins=10)
+    _render(arr[0][0])
+
+
+def test_kde_highlevel(history):
+    from pyabc_tpu.visualization import (
+        plot_kde_1d_highlevel,
+        plot_kde_matrix_highlevel,
+    )
+
+    _render(plot_kde_1d_highlevel(history, "mu", m=0, numx=24))
+    arr = plot_kde_matrix_highlevel(history, m=0)
+    _render(arr[0][0])
+
+
+def test_sample_numbers_trajectory(history):
+    from pyabc_tpu.visualization import plot_sample_numbers_trajectory
+
+    _render(plot_sample_numbers_trajectory(history))
+
+
+def test_credible_intervals_for_time(history):
+    from pyabc_tpu.visualization import (
+        compute_credible_interval,
+        compute_kde_max,
+        compute_quantile,
+        plot_credible_intervals_for_time,
+    )
+
+    axes = plot_credible_intervals_for_time(
+        [history, history], labels=["a", "b"], levels=(0.5, 0.95),
+        show_mean=True)
+    _render(axes[0])
+    df, w = history.get_distribution(m=0)
+    vals = df["mu"].to_numpy()
+    lb, ub = compute_credible_interval(vals, w, 0.95)
+    assert lb <= compute_quantile(vals, w, 0.5) <= ub
+    from pyabc_tpu.transition import MultivariateNormalTransition
+    mode = compute_kde_max(MultivariateNormalTransition(), df, w)
+    assert mode.shape == (df.shape[1],)
+
+
+def test_plot_data_default():
+    from pyabc_tpu.visualization import plot_data_default
+
+    rng = np.random.default_rng(3)
+    obs = {
+        "traj": np.linspace(0, 1, 20),
+        "frame": pd.DataFrame({"v": rng.normal(size=5)}),
+        "pair": rng.normal(size=(2, 4)),
+    }
+    sim = {
+        "traj": np.linspace(0, 1, 20) + 0.1,
+        "frame": pd.DataFrame({"v": rng.normal(size=5)}),
+        "pair": rng.normal(size=(2, 4)),
+    }
+    arr = plot_data_default(obs, sim)
+    _render(arr[0][0])
+    arr = plot_data_default(obs, sim, keys="traj")
+    _render(arr[0][0])
+
+
+def test_plot_matrix_format_helpers():
+    from pyabc_tpu.visualization import (
+        format_plot_matrix,
+        to_lists_or_default,
+    )
+
+    df, w = _synth_df()
+    arr = plot_kde_matrix(df, w)
+    format_plot_matrix(arr, list(df.columns))
+    _render(arr[0][0])
+    hs, labels = to_lists_or_default("h1", None)
+    assert len(hs) == 1 and len(labels) == 1
 
 
 def test_visserver_routes(history):
