@@ -104,6 +104,10 @@ class EPSMixin:
         harvested = 0  # next submission id to account
         failed_evals = 0
         consecutive_failures = 0
+        bar = None
+        if getattr(self, "show_progress", False):
+            from ..utils.progress import ProgressBar
+            bar = ProgressBar(n, desc="sampling")
         try:
             while True:
                 # submission-order accounting (reference eps_mixin.py:62-81)
@@ -112,6 +116,8 @@ class EPSMixin:
                     if rr is not None:  # None = failed batch, nothing to add
                         sample.append_round(rr)
                     harvested += 1
+                if bar is not None:
+                    bar.update(min(sample.n_accepted, n))
                 if sample.n_accepted >= n or (
                         sample.nr_evaluations + failed_evals >= max_eval
                         and sample.n_accepted < n):
@@ -152,6 +158,8 @@ class EPSMixin:
                 del in_flight[done]
                 results[seed] = rr
         finally:
+            if bar is not None:
+                bar.finish()
             for fut in in_flight:
                 self._cancel(fut)
         self.nr_evaluations_ = sample.nr_evaluations + failed_evals

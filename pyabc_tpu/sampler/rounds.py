@@ -140,7 +140,12 @@ class RoundKernel:
         stats, early = self._simulate_all(ksim, theta, m, eps)
         d = self.distance.compute(stats, self.obs_flat, params["distance"])
         if all_accepted:
-            accepted = jnp.ones((B,), dtype=bool)
+            # calibration accepts everything EXCEPT non-finite distances —
+            # a failed host simulation (NaN stats) must not poison
+            # eps.initialize's median with NaN (reference drops errored
+            # simulations before the calibration sample too,
+            # redis_eps/cli.py:141-145)
+            accepted = jnp.isfinite(d)
             log_acc_w = jnp.zeros((B,))
         else:
             acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])

@@ -26,7 +26,7 @@ from typing import Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from .base import Sample, Sampler
+from .base import Sample, Sampler, SamplingError
 from .device_loop import build_looped_round
 
 logger = logging.getLogger("ABC.Sampler")
@@ -105,16 +105,37 @@ class VectorizedSampler(Sampler):
         sample = Sample(record_rejected=self.record_rejected,
                         max_records=self.max_records)
         if all_accepted:
-            # calibration: one exact-size round (reference all_accepted
-            # path, smc.py:534-537)
+            # calibration: exact-size rounds (reference all_accepted path,
+            # smc.py:534-537); normally ONE round suffices, but failed host
+            # simulations (NaN distance) are dropped, so top up until n
             B = self._round_to_valid_batch(n)
             fn = self._get("round", round_fn, B, all_accepted=True)
-            key, sub = jax.random.split(key)
-            sample.append_round(fn(sub, params))
+            zero_rounds = 0
+            while sample.n_accepted < n:
+                key, sub = jax.random.split(key)
+                before = sample.n_accepted
+                sample.append_round(fn(sub, params))
+                zero_rounds = (zero_rounds + 1
+                               if sample.n_accepted == before else 0)
+                if zero_rounds >= 3:  # model fails on EVERY draw: abort
+                    raise SamplingError(
+                        "calibration produced no valid simulations in 3 "
+                        "consecutive full rounds — model is persistently "
+                        "failing")
+                if sample.nr_evaluations >= max_eval \
+                        and sample.n_accepted < n:
+                    logger.warning(
+                        "max_eval reached during calibration (%d/%d)",
+                        sample.n_accepted, n)
+                    break
             self.nr_evaluations_ = sample.nr_evaluations
             return sample
 
         call_idx = 0
+        bar = None
+        if self.show_progress:
+            from ..utils.progress import ProgressBar
+            bar = ProgressBar(n, desc="sampling")
         while sample.n_accepted < n:
             remaining = n - sample.n_accepted
             B = self._round_to_valid_batch(
@@ -134,7 +155,8 @@ class VectorizedSampler(Sampler):
             # next batch over-provisions even more
             rate_obs = int(out["count"]) / max(n_evals, 1)
             self._rate_est = max(rate_obs, 1e-6)
-            if self.show_progress:
+            if bar is not None:
+                bar.update(sample.n_accepted)
                 logger.info(
                     "call %d: %d/%d accepted (B=%d, %d rounds, rate=%.3g)",
                     call_idx, sample.n_accepted, n, B, rounds, rate_obs)
@@ -142,6 +164,8 @@ class VectorizedSampler(Sampler):
                 logger.warning("max_eval=%s reached with %d/%d accepted",
                                max_eval, sample.n_accepted, n)
                 break
+        if bar is not None:
+            bar.finish()
         self.nr_evaluations_ = sample.nr_evaluations
         return sample
 

@@ -62,6 +62,7 @@ class ABCSMC:
                  sampler: Optional[Sampler] = None,
                  stop_if_only_single_model_alive: bool = False,
                  max_nr_recorded_particles: int = 1 << 21,
+                 show_progress: bool = False,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -98,6 +99,7 @@ class ABCSMC:
         self.sampler = sampler if sampler is not None else _default_sampler()
         self.stop_if_only_single_model_alive = stop_if_only_single_model_alive
         self.max_nr_recorded_particles = max_nr_recorded_particles
+        self.show_progress = show_progress
         self.key = jax.random.PRNGKey(seed)
 
         self._sanity_check()
@@ -130,14 +132,26 @@ class ABCSMC:
     # run registration / resume (reference smc.py:255-389)
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _coerce_stats(observed: Dict) -> Dict:
+        """Observed values may be any array-like the reference accepts —
+        numpy/jax arrays, scalars, pandas DataFrame/Series
+        (history stores the raw object; compute uses the f32 view)."""
+        import pandas as pd
+        out = {}
+        for k, v in observed.items():
+            if isinstance(v, (pd.DataFrame, pd.Series)):
+                v = v.to_numpy()
+            out[k] = jnp.asarray(v, dtype=jnp.float32)
+        return out
+
     def new(self, db: str, observed_sum_stat: Dict,
             gt_model: Optional[int] = None,
             gt_par: Optional[dict] = None,
             meta_info: Optional[dict] = None) -> History:
         if self.summary_statistics is not None:
             observed_sum_stat = self.summary_statistics(observed_sum_stat)
-        self.x_0 = {k: jnp.asarray(v, dtype=jnp.float32)
-                    for k, v in observed_sum_stat.items()}
+        self.x_0 = self._coerce_stats(observed_sum_stat)
         self.history = History(db)
         self.history.store_initial_data(
             gt_model, meta_info or {}, observed_sum_stat, gt_par,
@@ -151,8 +165,7 @@ class ABCSMC:
         """Resume a stored run (reference smc.py:355-389): observed stats
         come back from the DB and the loop continues at max_t + 1."""
         self.history = History(db, abc_id=abc_id)
-        self.x_0 = {k: jnp.asarray(v, dtype=jnp.float32)
-                    for k, v in self.history.observed_sum_stat().items()}
+        self.x_0 = self._coerce_stats(self.history.observed_sum_stat())
         self._bind()
         return self.history
 
@@ -312,7 +325,8 @@ class ABCSMC:
         # persist calibration sample under PRE_TIME (reference smc.py:474-476)
         self.history.append_population(
             PRE_TIME, np.inf, pop, sample.nr_evaluations,
-            [m.name for m in self.models], self._param_names())
+            [m.name for m in self.models], self._param_names(),
+            stat_spec=self.spec.shapes)
         logger.info("Calibration sample t=-1 done (n=%d)", n)
 
     def _initialize_from_history(self, t0: int):
@@ -374,6 +388,9 @@ class ABCSMC:
         self.distance_function.configure_sampler(self.sampler)
         self.eps.configure_sampler(self.sampler)
         self.sampler.max_records = self.max_nr_recorded_particles
+        # reference smc.py:537/907: the per-generation progress bar is the
+        # sampler's to render (it knows n_accepted as batches harvest)
+        self.sampler.show_progress = self.show_progress
 
         t = t0
         t_max = (t0 + max_nr_populations
@@ -422,7 +439,8 @@ class ABCSMC:
             ess = float(effective_sample_size(population.weight))
             self.history.append_population(
                 t, current_eps, population, sample.nr_evaluations,
-                [m.name for m in self.models], self._param_names())
+                [m.name for m in self.models], self._param_names(),
+                stat_spec=self.spec.shapes)
             logger.info(
                 "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
                 t, acceptance_rate, ess, sample.nr_evaluations)

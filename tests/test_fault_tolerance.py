@@ -195,3 +195,43 @@ def test_manager_cli_info_and_reset(tmp_path):
     assert health.stop_requested(d)
     res = runner.invoke(manage, ["reset-workers", "--run-dir", d])
     assert res.exit_code == 0
+
+
+def test_calibration_survives_flaky_model(db_path):
+    """NaN stats from a failed host simulation must not poison the
+    calibration median (the all_accepted round drops non-finite
+    distances and tops up)."""
+    model = HostFunctionModel(_flaky_fn, stat_shapes={"s0": ()})
+    abc = pt.ABCSMC(
+        model,
+        pt.Distribution(p0=pt.RV("uniform", 0.0, 10.0)),
+        pt.PNormDistance(p=2),
+        population_size=16,
+        sampler=pt.VectorizedSampler(min_batch_size=8, max_batch_size=32),
+        seed=13)
+    abc.new(db_path, {"s0": 2.8})
+    h = abc.run(max_nr_populations=2)
+    # a finite epsilon proves the calibration median was NaN-free
+    pops = h.get_all_populations()
+    assert np.isfinite(pops[pops.t >= 1].epsilon).all()
+
+
+def test_calibration_aborts_when_model_always_fails(db_path):
+    """A model failing on EVERY draw aborts with SamplingError instead of
+    hanging in an infinite top-up loop."""
+    from pyabc_tpu.sampler import SamplingError
+
+    def always_fails(theta, seed):
+        raise ValueError("dead")
+
+    model = HostFunctionModel(always_fails, stat_shapes={"s0": ()})
+    abc = pt.ABCSMC(
+        model,
+        pt.Distribution(p0=pt.RV("uniform", 0.0, 10.0)),
+        pt.PNormDistance(p=2),
+        population_size=8,
+        sampler=pt.VectorizedSampler(min_batch_size=8, max_batch_size=16),
+        seed=14)
+    abc.new(db_path, {"s0": 2.8})
+    with pytest.raises(SamplingError, match="calibration"):
+        abc.run(max_nr_populations=2)
