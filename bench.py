@@ -1,27 +1,42 @@
 """Benchmark: accepted-particles/sec on the Gaussian-mixture ABC-SMC config.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-Problem: BASELINE.json config #2 (two-Gaussian model selection) at
-population 16384 with a FIXED epsilon = 0.2 — the same threshold the
-baseline generation was measured at, so both sides do identical per-
-candidate work (KDE transition draw, simulate, distance, threshold accept,
-O(N)-support KDE pdf for the importance weight) in the same acceptance
-regime.
+Primary metric (unchanged since round 1 for comparability): BASELINE.json
+config #2 (two-Gaussian model selection) at population 16384 with a FIXED
+epsilon = 0.2 — the same threshold the baseline generation was measured
+at, so both sides do identical per-candidate work (KDE transition draw,
+simulate, distance, threshold accept, O(N)-support KDE pdf for the
+importance weight) in the same acceptance regime.
 
 Baseline: BASELINE_MEASURED.json — a faithful reproduction of pyABC's
 default ``MulticoreEvalParallelSampler`` hot loop measured on this host's
 CPUs with the KDE support matched to the same population size
 (tools/baseline_reference.py; the reference package itself cannot run in
-this image).  Metric for both sides: accepted particles per second of
-steady-state generation sampling (excluding XLA compile, which is one-off).
+this image).  NOTE the baseline is n_procs=1 (this image exposes one CPU
+core), so vs_baseline is a per-core — not per-socket — comparison; see
+BASELINE.md "Measured".  Metric for both sides: accepted particles per
+second of steady-state generation sampling (excluding XLA compile, which
+is one-off).
+
+``extra`` carries the BASELINE.md north-star and per-config rows
+(each guarded — a failed sub-bench reports null, never kills the line):
+
+- ``northstar_pop1e6_*``   — config #2 at 1e6 particles/generation
+  (BASELINE.md north-star target), incl. the 1e6-query × 1e6-support
+  streamed-KDE log-pdf (SURVEY.md §7 hard part) measured standalone
+- ``lv_pop100k_*``         — config #3, Lotka-Volterra SDE, pop 1e5
+- ``sir_pop100k_*``        — config #4, SIR tau-leap (pop 1e5 on the
+  single chip this bench runs on; the 1e6 pod-sharded variant is the
+  multi-host deployment of the same program)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -30,33 +45,153 @@ POP = 16384
 WARMUP_GENERATIONS = 3
 TIMED_GENERATIONS = 3
 FALLBACK_BASELINE = 675.19  # accepted/s, see BASELINE_MEASURED.json
+NORTHSTAR_POP = 1_000_000
+LV_POP = 100_000
+SIR_POP = 100_000
 
 
-def main():
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _timed_generations(abc, pop, warmup, timed):
+    """(rate, wallclock_per_gen) over `timed` steady-state generations."""
+    abc.run(max_nr_populations=warmup)
+    t0 = time.perf_counter()
+    h = abc.run(max_nr_populations=timed)
+    elapsed = time.perf_counter() - t0
+    pops = h.get_all_populations()
+    n_timed = len(pops[pops.t >= warmup])
+    return pop * n_timed / elapsed, elapsed / max(n_timed, 1)
+
+
+def bench_primary():
     import pyabc_tpu as pt
     from pyabc_tpu.models import make_two_gaussians_problem
 
     models, priors, distance, observed, _ = make_two_gaussians_problem()
-    sampler = pt.VectorizedSampler(max_batch_size=1 << 20)
     abc = pt.ABCSMC(
         models, priors, distance,
         population_size=POP,
         eps=pt.ConstantEpsilon(0.2),
-        sampler=sampler,
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 20),
         seed=0)
     abc.new("sqlite://", observed)
+    rate, _ = _timed_generations(
+        abc, POP, WARMUP_GENERATIONS, TIMED_GENERATIONS)
+    return rate
 
-    # warm-up: calibration + first generations trigger all XLA compiles
-    abc.run(max_nr_populations=WARMUP_GENERATIONS)
 
+def bench_northstar():
+    """Config #2 at 1e6 particles/generation (BASELINE.md north star)."""
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=NORTHSTAR_POP,
+        eps=pt.ConstantEpsilon(0.2),
+        # short fused dispatches: a 64-round fuse at this scale is one
+        # multi-minute XLA program, which the remote-TPU relay kills
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                     max_rounds_per_call=2),
+        seed=0)
+    abc.new("sqlite://", observed)
+    # warmup = calibration + prior gen + one full KDE generation (compiles)
+    rate, s_per_gen = _timed_generations(abc, NORTHSTAR_POP, 2, 1)
+    return {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
+            "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2)}
+
+
+def bench_kde_1e6():
+    """Standalone 1e6-query × 1e6-support streamed weighted-KDE log-pdf
+    (the SURVEY.md §7 '1e6 × 1e6 KDE' hard part)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pyabc_tpu.ops.kde import weighted_kde_logpdf
+
+    d, n = 2, 1_000_000
+    key = jax.random.PRNGKey(0)
+    support = jax.random.normal(key, (n, d), dtype=jnp.float32)
+    log_w = jnp.full((n,), -float(np.log(n)), dtype=jnp.float32)
+    chol = jnp.eye(d, dtype=jnp.float32) * 0.1
+    log_norm = jnp.asarray(-d / 2 * np.log(2 * np.pi) - d * np.log(0.1),
+                           dtype=jnp.float32)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (n, d),
+                          dtype=jnp.float32)
+    # compile
+    float(jnp.sum(weighted_kde_logpdf(x, support, log_w, chol, log_norm)))
     t0 = time.perf_counter()
-    h = abc.run(max_nr_populations=TIMED_GENERATIONS)
-    elapsed = time.perf_counter() - t0
-    pops = h.get_all_populations()
-    timed = pops[pops.t >= WARMUP_GENERATIONS]
-    accepted = POP * len(timed)
+    s = float(jnp.sum(weighted_kde_logpdf(x, support, log_w, chol,
+                                          log_norm)))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(s)
+    return {"kde_1e6x1e6_logpdf_s": round(dt, 2),
+            "kde_1e6x1e6_pairs_per_sec": round(n * n / dt / 1e9, 1)}
 
-    rate = accepted / elapsed
+
+def _bench_problem(make_problem, pop, prefix):
+    """One adaptive-distance generation-rate row (configs #3/#4)."""
+    import pyabc_tpu as pt
+
+    models, priors, distance, observed = make_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=pop,
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 19),
+        seed=0)
+    abc.new("sqlite://", observed)
+    rate, s_per_gen = _timed_generations(abc, pop, 2, 1)
+    return {f"{prefix}_accepted_per_sec": round(rate, 1),
+            f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 2)}
+
+
+SUB_BENCHES = ("kde_1e6", "northstar", "lotka_volterra", "sir")
+
+
+def _run_sub(name: str) -> dict:
+    if name == "kde_1e6":
+        return bench_kde_1e6()
+    if name == "northstar":
+        return bench_northstar()
+    if name == "lotka_volterra":
+        return _bench_problem(_lv_problem, LV_POP, f"lv_pop{LV_POP // 1000}k")
+    if name == "sir":
+        return _bench_problem(_sir_problem, SIR_POP,
+                              f"sir_pop{SIR_POP // 1000}k")
+    raise ValueError(name)
+
+
+def main():
+    extra = {}
+
+    _log("bench: primary (pop16384 gaussian mixture)")
+    rate = bench_primary()
+
+    # each sub-bench runs in its OWN process: a TPU-runtime crash in one
+    # (e.g. a watchdog kill) must not poison the others or the primary line
+    import subprocess
+    here = os.path.abspath(__file__)
+    for name in SUB_BENCHES:
+        _log(f"bench: {name}")
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, here, "--sub", name],
+                capture_output=True, text=True, timeout=1800)
+            if proc.returncode == 0:
+                extra.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+                _log(f"bench: {name} done in "
+                     f"{time.perf_counter() - t0:.0f}s")
+            else:
+                tail = proc.stderr.strip().splitlines()[-1:]
+                _log(f"bench: {name} FAILED: {tail}")
+                extra[f"{name}_error"] = " ".join(tail)[:300]
+        except Exception as err:  # never lose the primary line
+            _log(f"bench: {name} FAILED: {type(err).__name__}: {err}")
+            extra[f"{name}_error"] = f"{type(err).__name__}: {err}"[:300]
 
     baseline = FALLBACK_BASELINE
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -70,8 +205,22 @@ def main():
         "value": round(rate, 1),
         "unit": "particles/s",
         "vs_baseline": round(rate / baseline, 2),
+        "extra": extra,
     }))
 
 
+def _lv_problem():
+    from pyabc_tpu.models import make_lotka_volterra_problem
+    return make_lotka_volterra_problem()
+
+
+def _sir_problem():
+    from pyabc_tpu.models import make_sir_problem
+    return make_sir_problem()
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--sub":
+        print(json.dumps(_run_sub(sys.argv[2])))
+    else:
+        main()

@@ -1,5 +1,6 @@
 """Hot-op kernels (MXU-native formulations; pallas variants live here)."""
 
+from .choice import fast_weighted_choice
 from .kde import weighted_kde_logpdf
 
-__all__ = ["weighted_kde_logpdf"]
+__all__ = ["weighted_kde_logpdf", "fast_weighted_choice"]

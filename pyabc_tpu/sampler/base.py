@@ -157,6 +157,26 @@ class Sample:
                 })
                 self._n_recorded += rc
 
+    def append_record_batch(self, rec: dict):
+        """Ingest one per-call record harvest (``rec_*`` buffers + count)
+        from the stateful device loop; capped at ``max_records`` across
+        calls with earliest-first retention, like the reference's
+        first-m-particles accounting (smc.py:1009-1010)."""
+        if not self.record_rejected:
+            return
+        rc = min(int(rec["rec_count"]), self.max_records - self._n_recorded)
+        if rc <= 0:
+            return
+        self._rec.append({
+            "stats": np.asarray(rec["rec_stats"][:rc]),
+            "distance": np.asarray(rec["rec_distance"][:rc]),
+            "accepted": np.asarray(rec["rec_accepted"][:rc]),
+            "m": np.asarray(rec["rec_m"][:rc]),
+            "theta": np.asarray(rec["rec_theta"][:rc]),
+            "log_proposal": np.asarray(rec["rec_log_proposal"][:rc]),
+        })
+        self._n_recorded += rc
+
     @property
     def n_accepted(self) -> int:
         return sum(a["m"].shape[0] for a in self._acc)

@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 from .base import Sample, Sampler, SamplingError
-from .device_loop import build_looped_round
+from .device_loop import build_stateful_loop
 
 logger = logging.getLogger("ABC.Sampler")
 
@@ -52,6 +52,7 @@ class VectorizedSampler(Sampler):
         self.max_rounds_per_call = int(max_rounds_per_call)
         self._jit = jit
         self._compiled: Dict[Tuple, Callable] = {}
+        self._shape_cache: Dict[Tuple, Tuple[int, int]] = {}
         #: acceptance-rate estimate carried across generations
         self._rate_est = 1.0
 
@@ -66,30 +67,47 @@ class VectorizedSampler(Sampler):
         raw = self._raw_round(round_fn, B, **static_kwargs)
         return jax.jit(raw) if self._jit else raw
 
-    def _build_loop(self, round_fn: Callable, B: int, n_target: int,
-                    record_cap: int) -> Callable:
+    def _build_stateful(self, round_fn: Callable, B: int, n_target: int,
+                        record_cap: int, d: int, s: int):
         raw = self._raw_round(round_fn, B)
-        looped = build_looped_round(
-            raw, B, n_target, self.max_rounds_per_call, record_cap)
-        return jax.jit(looped) if self._jit else looped
+        start, step, finalize, harvest = build_stateful_loop(
+            raw, B, n_target, self.max_rounds_per_call, record_cap, d, s)
+        if self._jit:
+            # donate the carry so the cap-sized buffers update in place
+            return (jax.jit(start), jax.jit(step, donate_argnums=(2,)),
+                    jax.jit(finalize), jax.jit(harvest))
+        return start, step, finalize, harvest
+
+    @staticmethod
+    def _fn_id(round_fn: Callable):
+        """Stable identity for a (possibly bound) round function: bound
+        methods get a fresh id() on every attribute access, so key on
+        (owner uid, function name); owners expose _uid because a freed
+        owner's id() can be reused and would serve stale compiled state."""
+        owner = getattr(round_fn, "__self__", round_fn)
+        return (getattr(owner, "_uid", None) or id(owner),
+                getattr(round_fn, "__name__", ""))
+
+    def _round_shape(self, round_fn: Callable, B: int, params):
+        """(theta width, stats width) of one round, via shape-only trace."""
+        fn_id = self._fn_id(round_fn)
+        if fn_id not in self._shape_cache:
+            shapes = jax.eval_shape(self._raw_round(round_fn, B),
+                                    jax.random.PRNGKey(0), params)
+            self._shape_cache[fn_id] = (int(shapes.theta.shape[1]),
+                                        int(shapes.stats.shape[1]))
+        return self._shape_cache[fn_id]
 
     def _get(self, kind: str, round_fn: Callable, B: int, *extra,
              **static_kwargs) -> Callable:
-        # bound methods get a fresh id() on every attribute access — key on
-        # (owner uid, function name) so per-generation lookups hit the
-        # cache; owners expose _uid because a freed owner's id() can be
-        # reused and would serve a stale compiled program
-        owner = getattr(round_fn, "__self__", round_fn)
-        fn_id = (getattr(owner, "_uid", None) or id(owner),
-                 getattr(round_fn, "__name__", ""))
-        cache_key = (kind, fn_id, B, extra,
+        cache_key = (kind, self._fn_id(round_fn), B, extra,
                      tuple(sorted(static_kwargs.items())))
         if cache_key not in self._compiled:
             if kind == "round":
                 self._compiled[cache_key] = self._build(
                     round_fn, B, **static_kwargs)
             else:
-                self._compiled[cache_key] = self._build_loop(
+                self._compiled[cache_key] = self._build_stateful(
                     round_fn, B, *extra)
         return self._compiled[cache_key]
 
@@ -131,39 +149,71 @@ class VectorizedSampler(Sampler):
             self.nr_evaluations_ = sample.nr_evaluations
             return sample
 
-        call_idx = 0
         bar = None
         if self.show_progress:
             from ..utils.progress import ProgressBar
             bar = ProgressBar(n, desc="sampling")
-        while sample.n_accepted < n:
-            remaining = n - sample.n_accepted
-            B = self._round_to_valid_batch(
-                remaining / max(self._rate_est, 1e-6) * self.safety_factor)
-            record_cap = (min(self.max_records_cap(),
-                              B * self.max_rounds_per_call)
-                          if self.record_rejected else 0)
-            fn = self._get("loop", round_fn, B, n, record_cap)
+        # B is fixed for the whole generation: the carry buffers' shape
+        # depends on it, and accumulating on device across calls (ONE full
+        # fetch per generation instead of one per call) is worth more than
+        # the stateless ladder's per-call batch adaptation
+        B = self._round_to_valid_batch(
+            n / max(self._rate_est, 1e-6) * self.safety_factor)
+        # per-CALL device record cap; across calls records accumulate
+        # host-side up to max_records (Sample.append_record_batch)
+        record_cap = (min(self.max_records_cap(),
+                          B * self.max_rounds_per_call)
+                      if self.record_rejected else 0)
+        d, s = self._round_shape(round_fn, B, params)
+        start, step, finalize, harvest = self._get(
+            "sloop", round_fn, B, n, record_cap, d, s)
+        state = start()
+        call_idx = 0
+        count = rounds = 0
+        out_dev = None
+        while True:
             key, sub = jax.random.split(key)
-            out = fn(sub, params)
-            rounds = int(out["rounds"])
-            n_evals = rounds * B
-            sample.append_device_batch(out, n_evals)
+            state = step(sub, params, state)
+            if record_cap:
+                # records are fetched + reset every call: the device
+                # buffer bounds one call, max_records bounds the whole
+                # generation (reference first-m-particles accounting)
+                rec, state = harvest(state)
+                sample.append_record_batch(jax.device_get(rec))
+            # optimistic prefetch: when this call is expected to finish the
+            # generation, start the result transfer concurrently with the
+            # scalar sync below — hides most of the relay's per-transfer
+            # latency on the (common) single-call generation
+            expected = count + B * self.max_rounds_per_call * self._rate_est
+            out_dev = None
+            if expected >= n:
+                out_dev = finalize(state)
+                for leaf in jax.tree_util.tree_leaves(out_dev):
+                    try:
+                        leaf.copy_to_host_async()
+                    except Exception:
+                        break
+            # one scalar sync per call — the buffers stay device-resident
+            count = int(state["count"])
+            rounds = int(state["rounds"])
             call_idx += 1
-            # estimate from the RAW on-device count (before truncation to
-            # n), else over-provisioned batches bias the rate low and the
-            # next batch over-provisions even more
-            rate_obs = int(out["count"]) / max(n_evals, 1)
+            rate_obs = count / max(rounds * B, 1)
             self._rate_est = max(rate_obs, 1e-6)
             if bar is not None:
-                bar.update(sample.n_accepted)
+                bar.update(min(count, n))
                 logger.info(
                     "call %d: %d/%d accepted (B=%d, %d rounds, rate=%.3g)",
-                    call_idx, sample.n_accepted, n, B, rounds, rate_obs)
-            if sample.nr_evaluations >= max_eval and sample.n_accepted < n:
-                logger.warning("max_eval=%s reached with %d/%d accepted",
-                               max_eval, sample.n_accepted, n)
+                    call_idx, count, n, B, rounds, rate_obs)
+            if count >= n:
                 break
+            if rounds * B >= max_eval:
+                logger.warning("max_eval=%s reached with %d/%d accepted",
+                               max_eval, count, n)
+                break
+        if out_dev is None:
+            out_dev = finalize(state)
+        out = jax.device_get(out_dev)
+        sample.append_device_batch(out, rounds * B)
         if bar is not None:
             bar.finish()
         self.nr_evaluations_ = sample.nr_evaluations

@@ -171,7 +171,9 @@ class Transition:
         densities = []
         for i in range(n_bootstrap):
             key, k1, k2 = jax.random.split(key, 3)
-            idx = jax.random.choice(k1, self.theta.shape[0], (n,), p=self.w)
+            from ..ops import fast_weighted_choice
+            idx = fast_weighted_choice(
+                k1, jnp.log(jnp.maximum(self.w, 1e-38)), n)
             boot = type(self)()
             # carry over hyperparameters
             boot.__dict__.update({k: v for k, v in self.__dict__.items()

@@ -88,9 +88,10 @@ class LocalTransition(Transition):
 
     @staticmethod
     def rvs_from_params(key, params: dict, n: int) -> Array:
+        from ..ops import fast_weighted_choice
         k1, k2 = jax.random.split(key)
         support, log_w = params["support"], params["log_w"]
-        idx = jax.random.categorical(k1, log_w, shape=(n,))
+        idx = fast_weighted_choice(k1, log_w, n)
         noise = jax.random.normal(k2, (n, support.shape[-1]),
                                   dtype=support.dtype)
         chols = params["chols"][idx]           # [n, D, D]

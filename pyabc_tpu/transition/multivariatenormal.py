@@ -105,9 +105,10 @@ class MultivariateNormalTransition(Transition):
     @staticmethod
     def rvs_from_params(key, params: dict, n: int) -> Array:
         """Weighted resample + correlated noise (reference :85-97)."""
+        from ..ops import fast_weighted_choice
         k1, k2 = jax.random.split(key)
         support, log_w, chol = params["support"], params["log_w"], params["chol"]
-        idx = jax.random.categorical(k1, log_w, shape=(n,))
+        idx = fast_weighted_choice(k1, log_w, n)
         noise = jax.random.normal(k2, (n, support.shape[-1]),
                                   dtype=support.dtype)
         return support[idx] + noise @ chol.T

@@ -47,10 +47,11 @@ class DiscreteRandomWalkTransition(Transition):
 
     @staticmethod
     def rvs_from_params(key, params: dict, n: int) -> Array:
+        from ..ops import fast_weighted_choice
         k1, k2 = jax.random.split(key)
         support, log_w = params["support"], params["log_w"]
         n_steps = params["n_steps"]
-        idx = jax.random.categorical(k1, log_w, shape=(n,))
+        idx = fast_weighted_choice(k1, log_w, n)
         steps = jax.random.categorical(
             k2, params["step_log_probs"],
             shape=(n, support.shape[-1])) - n_steps

@@ -1,0 +1,34 @@
+"""fast_weighted_choice: distributional correctness vs exact weights
+(parity: reference fast_random_choice vs np.random.choice,
+pyabc_rand_choice.py:4-17)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pyabc_tpu.ops import fast_weighted_choice
+
+
+def test_matches_weights():
+    w = np.asarray([0.05, 0.15, 0.3, 0.5], np.float32)
+    log_w = jnp.log(jnp.asarray(w))
+    idx = np.asarray(fast_weighted_choice(jax.random.PRNGKey(0), log_w,
+                                          200_000))
+    freq = np.bincount(idx, minlength=4) / idx.size
+    np.testing.assert_allclose(freq, w, atol=0.01)
+
+
+def test_unnormalized_and_padded_weights():
+    # -1e30 padding entries (the transition param pad value) get zero mass
+    log_w = jnp.asarray([0.0, 0.0, -1e30, -1e30], jnp.float32)
+    idx = np.asarray(fast_weighted_choice(jax.random.PRNGKey(1), log_w,
+                                          50_000))
+    assert idx.max() <= 1
+    freq = np.bincount(idx, minlength=2) / idx.size
+    np.testing.assert_allclose(freq[:2], [0.5, 0.5], atol=0.02)
+
+
+def test_single_point_support():
+    idx = np.asarray(fast_weighted_choice(
+        jax.random.PRNGKey(2), jnp.zeros(1), 16))
+    assert (idx == 0).all()
