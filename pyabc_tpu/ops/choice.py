@@ -27,5 +27,9 @@ def fast_weighted_choice(key, log_w: Array, n: int) -> Array:
     w = jax.nn.softmax(log_w)
     cdf = jnp.cumsum(w)
     u = jax.random.uniform(key, (n,), dtype=cdf.dtype) * cdf[-1]
-    idx = jnp.searchsorted(cdf, u)
+    # side='right': smallest i with cdf[i] > u — a flat (zero-weight) CDF
+    # segment is skipped even when u lands EXACTLY on its value (incl. the
+    # u = 0.0 draw against a zero-weight first entry, which side='left'
+    # would select)
+    idx = jnp.searchsorted(cdf, u, side="right")
     return jnp.minimum(idx, log_w.shape[0] - 1).astype(jnp.int32)

@@ -161,19 +161,32 @@ class Sample:
         """Ingest one per-call record harvest (``rec_*`` buffers + count)
         from the stateful device loop; capped at ``max_records`` across
         calls with earliest-first retention, like the reference's
-        first-m-particles accounting (smc.py:1009-1010)."""
+        first-m-particles accounting (smc.py:1009-1010).
+
+        The arrays stay DEVICE-resident (no transfer here): the heaviest
+        consumer — the adaptive distance's scale refit over ``stats``
+        ``[R, S]`` — is itself a device reduction, so fetching the block
+        to host only to push it back cost ~50 % of an adaptive-distance
+        generation through the relay.  Host consumers (temperature
+        schemes) materialize just the columns they need.
+        """
         if not self.record_rejected:
             return
-        rc = min(int(rec["rec_count"]), self.max_records - self._n_recorded)
+        # callers that already synced rec_count pass it in, avoiding a
+        # second blocking scalar transfer through the relay
+        rec_count = rec.get("rec_count_host")
+        if rec_count is None:
+            rec_count = int(rec["rec_count"])
+        rc = min(int(rec_count), self.max_records - self._n_recorded)
         if rc <= 0:
             return
         self._rec.append({
-            "stats": np.asarray(rec["rec_stats"][:rc]),
-            "distance": np.asarray(rec["rec_distance"][:rc]),
-            "accepted": np.asarray(rec["rec_accepted"][:rc]),
-            "m": np.asarray(rec["rec_m"][:rc]),
-            "theta": np.asarray(rec["rec_theta"][:rc]),
-            "log_proposal": np.asarray(rec["rec_log_proposal"][:rc]),
+            "stats": rec["rec_stats"][:rc],
+            "distance": rec["rec_distance"][:rc],
+            "accepted": rec["rec_accepted"][:rc],
+            "m": rec["rec_m"][:rc],
+            "theta": rec["rec_theta"][:rc],
+            "log_proposal": rec["rec_log_proposal"][:rc],
         })
         self._n_recorded += rc
 
@@ -186,8 +199,15 @@ class Sample:
         """Unbiased: raw acceptances (incl. beyond-n) / evaluations."""
         return self.raw_accepted / max(self.nr_evaluations, 1)
 
-    def _concat(self, dicts: List[dict], key: str) -> np.ndarray:
-        return np.concatenate([d[key] for d in dicts], axis=0)
+    def _concat(self, dicts: List[dict], key: str):
+        """Concatenate batches of one column; device batches (record
+        stats) concatenate ON device — np.concatenate would silently pull
+        every batch through the relay."""
+        arrs = [d[key] for d in dicts]
+        if any(not isinstance(a, np.ndarray) for a in arrs):
+            import jax.numpy as jnp
+            return jnp.concatenate(arrs, axis=0)
+        return np.concatenate(arrs, axis=0)
 
     def get_accepted_population(self, n: int) -> Population:
         """First n accepted particles in deterministic round order."""

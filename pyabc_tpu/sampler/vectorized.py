@@ -174,12 +174,14 @@ class VectorizedSampler(Sampler):
         while True:
             key, sub = jax.random.split(key)
             state = step(sub, params, state)
+            rec = None
             if record_cap:
-                # records are fetched + reset every call: the device
+                # records are harvested + reset every call: the device
                 # buffer bounds one call, max_records bounds the whole
-                # generation (reference first-m-particles accounting)
+                # generation (reference first-m-particles accounting);
+                # the arrays stay device-resident (Sample materializes
+                # only what consumers actually read)
                 rec, state = harvest(state)
-                sample.append_record_batch(jax.device_get(rec))
             # optimistic prefetch: when this call is expected to finish the
             # generation, start the result transfer concurrently with the
             # scalar sync below — hides most of the relay's per-transfer
@@ -193,9 +195,16 @@ class VectorizedSampler(Sampler):
                         leaf.copy_to_host_async()
                     except Exception:
                         break
-            # one scalar sync per call — the buffers stay device-resident
-            count = int(state["count"])
-            rounds = int(state["rounds"])
+            # ONE bundled scalar sync per call — the buffers stay
+            # device-resident (count/rounds/rec_count in one transfer)
+            scalars = [state["count"], state["rounds"]]
+            if rec is not None:
+                scalars.append(rec["rec_count"])
+            scalars = jax.device_get(scalars)
+            count, rounds = int(scalars[0]), int(scalars[1])
+            if rec is not None:
+                rec["rec_count_host"] = int(scalars[2])
+                sample.append_record_batch(rec)
             call_idx += 1
             rate_obs = count / max(rounds * B, 1)
             self._rate_est = max(rate_obs, 1e-6)
