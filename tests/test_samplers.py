@@ -17,7 +17,19 @@ from pyabc_tpu.parallel.mesh import make_mesh
 
 
 def _samplers():
+    # the reference's 13-config matrix (test_samplers.py:87-108), TPU
+    # edition: every local flavor collapses onto the vectorized round
+    # design (aliases included so the collapse itself stays tested), the
+    # mesh flavor replaces the cluster backends, and batch-size variants
+    # mirror the reference's ±batching axis
     yield "vectorized", lambda: pt.VectorizedSampler()
+    yield "vectorized_small_batch", lambda: pt.VectorizedSampler(
+        min_batch_size=64, max_batch_size=256)
+    yield "single_core", lambda: pt.SingleCoreSampler()
+    yield "multicore_eval_parallel", \
+        lambda: pt.MulticoreEvalParallelSampler()
+    yield "multicore_particle_parallel", \
+        lambda: pt.MulticoreParticleParallelSampler()
     yield "sharded8", lambda: pt.ShardedSampler(mesh=make_mesh())
     yield "default", lambda: None  # platform factory
 

@@ -1,0 +1,45 @@
+"""Early-stopping criteria (parity: reference
+test/base/test_stop_sampling.py + smc.py:940-949 stopping conditions)."""
+
+import numpy as np
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+
+
+def _abc(db_path, **kwargs):
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=100,
+                    sampler=pt.VectorizedSampler(max_batch_size=2048),
+                    seed=21, **kwargs)
+    abc.new(db_path, observed)
+    return abc
+
+
+def test_stop_on_max_total_nr_simulations(db_path):
+    """Simulation budget exhausts the run early (reference
+    test_stop_sampling.py ``max_total_nr_simulations``)."""
+    abc = _abc(db_path)
+    h = abc.run(max_nr_populations=10, max_total_nr_simulations=500)
+    # budget of 500 evals cannot carry 10 generations of 100 particles
+    assert h.n_populations < 10
+    pops = h.get_all_populations()
+    assert pops[pops.t >= 0].samples.sum() >= 500  # stopped AFTER crossing
+
+
+def test_stop_on_min_acceptance_rate(db_path):
+    """A tiny epsilon drives the acceptance rate below the floor and the
+    run stops instead of grinding (reference min_acceptance_rate)."""
+    abc = _abc(db_path, eps=pt.ListEpsilon([1.0, 1e-8, 1e-9]))
+    h = abc.run(max_nr_populations=3, min_acceptance_rate=0.1)
+    assert h.n_populations < 3
+
+
+def test_stop_on_minimum_epsilon(db_path):
+    """eps <= minimum_epsilon ends the run (reference smc.py:940-944)."""
+    abc = _abc(db_path, eps=pt.ListEpsilon([0.5, 0.3, 0.2, 0.1]))
+    h = abc.run(max_nr_populations=10, minimum_epsilon=0.3)
+    pops = h.get_all_populations()
+    # generation at eps=0.3 runs, then the criterion fires
+    assert float(pops[pops.t >= 0].epsilon.min()) == np.float32(0.3)
+    assert h.n_populations == 2
