@@ -32,6 +32,7 @@ STOP_SENTINEL = "STOP"
 #: a heartbeat older than this is considered dead
 STALE_AFTER_S = 30.0
 _HB_PREFIX = "hb_"
+_PROBE_NAME = ".now_probe"
 
 
 def run_dir() -> Optional[str]:
@@ -113,12 +114,26 @@ def worker_status(directory: str,
     Each entry carries ``alive`` (heartbeat within ``stale_after_s``) —
     the reference's ``healthy()`` analog.
     """
-    now = time.time()
     out = []
     try:
         names = os.listdir(directory)
     except OSError:
         return out
+    # reference "now" from the SAME filesystem the heartbeats land on
+    # (touch a probe and stat it) so worker-vs-manager clock skew cannot
+    # misclassify liveness; the probe file is reused (utime, no re-create
+    # churn) and removed by reset_workers; fall back to local time on a
+    # read-only mount
+    probe = os.path.join(directory, _PROBE_NAME)
+    try:
+        if os.path.exists(probe):
+            os.utime(probe, None)
+        else:
+            with open(probe, "w"):
+                pass
+        now = os.stat(probe).st_mtime
+    except OSError:
+        now = time.time()
     for name in names:
         if not (name.startswith(_HB_PREFIX) and name.endswith(".json")):
             continue
@@ -161,6 +176,13 @@ def reset_workers(directory: str,
                 removed += 1
             except OSError:
                 pass
+    if not worker_status(directory, stale_after_s):
+        # nothing registered anymore: remove the clock probe too so a
+        # fully-reset run dir is empty again
+        try:
+            os.remove(os.path.join(directory, _PROBE_NAME))
+        except OSError:
+            pass
     return removed
 
 

@@ -18,20 +18,24 @@ from pyabc_tpu.parallel.mesh import make_mesh
 
 def _samplers():
     # the reference's 13-config matrix (test_samplers.py:87-108), TPU
-    # edition: every local flavor collapses onto the vectorized round
-    # design (aliases included so the collapse itself stays tested), the
-    # mesh flavor replaces the cluster backends, and batch-size variants
-    # mirror the reference's ±batching axis
+    # edition: the mesh flavor replaces the cluster backends and the
+    # batch-size variant mirrors the reference's ±batching axis (the
+    # local-flavor aliases are empty collapses onto VectorizedSampler —
+    # asserted in test_local_sampler_aliases, not re-run end to end)
     yield "vectorized", lambda: pt.VectorizedSampler()
     yield "vectorized_small_batch", lambda: pt.VectorizedSampler(
         min_batch_size=64, max_batch_size=256)
-    yield "single_core", lambda: pt.SingleCoreSampler()
-    yield "multicore_eval_parallel", \
-        lambda: pt.MulticoreEvalParallelSampler()
-    yield "multicore_particle_parallel", \
-        lambda: pt.MulticoreParticleParallelSampler()
     yield "sharded8", lambda: pt.ShardedSampler(mesh=make_mesh())
     yield "default", lambda: None  # platform factory
+
+
+def test_local_sampler_aliases():
+    """Every reference local-sampler flavor collapses onto the vectorized
+    round design (sampler/vectorized.py aliases)."""
+    for alias in (pt.SingleCoreSampler, pt.MulticoreEvalParallelSampler,
+                  pt.MulticoreParticleParallelSampler):
+        assert issubclass(alias, pt.VectorizedSampler)
+        assert isinstance(alias(), pt.VectorizedSampler)
 
 
 @pytest.mark.parametrize("name,make_sampler", list(_samplers()),

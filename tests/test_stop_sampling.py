@@ -39,7 +39,9 @@ def test_stop_on_minimum_epsilon(db_path):
     """eps <= minimum_epsilon ends the run (reference smc.py:940-944)."""
     abc = _abc(db_path, eps=pt.ListEpsilon([0.5, 0.3, 0.2, 0.1]))
     h = abc.run(max_nr_populations=10, minimum_epsilon=0.3)
+    import pytest
+
     pops = h.get_all_populations()
     # generation at eps=0.3 runs, then the criterion fires
-    assert float(pops[pops.t >= 0].epsilon.min()) == np.float32(0.3)
+    assert float(pops[pops.t >= 0].epsilon.min()) == pytest.approx(0.3)
     assert h.n_populations == 2
