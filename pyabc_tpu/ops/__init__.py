@@ -1,6 +1,7 @@
 """Hot-op kernels (MXU-native formulations; pallas variants live here)."""
 
 from .choice import fast_weighted_choice
-from .kde import weighted_kde_logpdf
+from .kde import weighted_kde_logpdf, weighted_kde_logpdf_auto
 
-__all__ = ["weighted_kde_logpdf", "fast_weighted_choice"]
+__all__ = ["weighted_kde_logpdf", "weighted_kde_logpdf_auto",
+           "fast_weighted_choice"]

@@ -1,0 +1,155 @@
+"""Pallas TPU kernel for the streamed weighted-KDE log-density.
+
+Same math as :func:`pyabc_tpu.ops.kde.weighted_kde_logpdf` (whitened
+cross-product Mahalanobis + flash-style running logsumexp over support
+blocks), with the whole block pipeline — MXU cross product, rescale,
+``exp``, row reduction — fused into one VMEM-resident kernel instead of
+an XLA ``lax.scan``.
+
+Formulation: the per-pair logit
+
+    logit_ij = log w_j − ½‖z_i‖² + z_i·z_j − ½‖z_j‖²
+
+is computed as ONE augmented matmul by extending the whitened coordinates
+with two columns, ``[z_i, −½‖z_i‖², 1] · [z_j, 1, log w_j − ½‖z_j‖²]`` —
+so the kernel touches only 2-D operands (Mosaic-friendly layouts) and the
+MXU does all the per-pair math except the exp.  The grid is (query
+blocks, support blocks) with the support axis minor; the running
+(max, sum) logsumexp carry lives in VMEM scratch that persists across the
+support sweep, and the output row block is written on the last step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.linalg import solve_triangular
+
+Array = jnp.ndarray
+
+QUERY_BLOCK = 1024
+SUPPORT_BLOCK = 1536  # best (accuracy-safe) VMEM-fitting sweep point
+_NEG_BIG = -1e30
+
+
+def _kernel(zxh_ref, zxl_ref, zsh_ref, zsl_ref, out_ref, mx_ref, sm_ref):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        mx_ref[:] = jnp.full_like(mx_ref, _NEG_BIG)
+        sm_ref[:] = jnp.zeros_like(sm_ref)
+
+    # bf16x3 split-precision product: a single native bf16 MXU pass loses
+    # ~0.5 absolute on the large ½‖z‖² logit terms (exp-fatal), and
+    # precision=HIGHEST crashes the Mosaic compiler on this stack — so the
+    # HOST splits each f32 operand into bf16 high + low parts and the
+    # kernel accumulates three native bf16 MXU passes into f32
+    # (~2^-16 relative, plenty under the exp)
+    zxh, zxl = zxh_ref[:], zxl_ref[:]
+    zsh, zsl = zsh_ref[:].T, zsl_ref[:].T
+    logits = (jnp.dot(zxh, zsh, preferred_element_type=jnp.float32)
+              + jnp.dot(zxh, zsl, preferred_element_type=jnp.float32)
+              + jnp.dot(zxl, zsh, preferred_element_type=jnp.float32))
+
+    # carries live lane-broadcast at [QB, 128] (TPU-friendly tiles); the
+    # [QB, 1] row reductions broadcast against them
+    m_old = mx_ref[:]
+    m_new = jnp.maximum(m_old, jnp.max(logits, axis=1, keepdims=True))
+    m_row = m_new[:, :1]
+    sm_ref[:] = (sm_ref[:] * jnp.exp(m_old - m_new)
+                 + jnp.sum(jnp.exp(logits - m_row), axis=1, keepdims=True))
+    mx_ref[:] = m_new
+
+    @pl.when(j == n_j - 1)
+    def _():
+        out_ref[:] = jnp.log(sm_ref[:, 0]) + mx_ref[:, 0]
+
+
+@partial(jax.jit,
+         static_argnames=("query_block", "support_block", "interpret"))
+def weighted_kde_logpdf_pallas(x: Array, support: Array, log_w: Array,
+                               chol: Array, log_norm: Array,
+                               query_block: int = QUERY_BLOCK,
+                               support_block: int = SUPPORT_BLOCK,
+                               interpret: bool = False) -> Array:
+    """Pallas version of ``weighted_kde_logpdf`` (same contract)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, d = x.shape
+    n = support.shape[0]
+
+    # WEIGHTED center: zero-mass (padded) support rows then cannot
+    # shift the whitening origin, so padding is exactly neutral
+    center = jax.nn.softmax(log_w) @ support
+    z_x = solve_triangular(chol, (x - center).T, lower=True).T
+    z_s = solve_triangular(chol, (support - center).T, lower=True).T
+    a_x = 0.5 * jnp.sum(z_x * z_x, axis=-1)                # [M]
+    b_s = log_w - 0.5 * jnp.sum(z_s * z_s, axis=-1)        # [N]
+
+    # augmented coordinates: logits in one MXU contraction (module docs)
+    ones_m = jnp.ones((m, 1), jnp.float32)
+    ones_n = jnp.ones((n, 1), jnp.float32)
+    zxa = jnp.concatenate([z_x, -a_x[:, None], ones_m], axis=1)
+    zsa = jnp.concatenate([z_s, ones_n, b_s[:, None]], axis=1)
+    da = zxa.shape[1]
+    # lane-tile the contraction dim: Mosaic blocks need a 128-divisible
+    # minor dimension (zero columns are free — the MXU contraction over
+    # them adds exact zeros)
+    dp = 128 * -(-da // 128)
+    zxa = jnp.pad(zxa, ((0, 0), (0, dp - da)))
+    zsa = jnp.pad(zsa, ((0, 0), (0, dp - da)))
+
+    # pad rows to block multiples; padded support rows carry
+    # b_s = -BIG in the augmented column ⇒ exp underflows to 0 (no-op)
+    mq = -(-m // query_block) * query_block
+    ns = -(-n // support_block) * support_block
+    zxa = jnp.pad(zxa, ((0, mq - m), (0, 0)))
+    pad_s = jnp.zeros((ns - n, dp), jnp.float32)
+    pad_s = pad_s.at[:, d + 1].set(_NEG_BIG)               # the b_s column
+    zsa = jnp.concatenate([zsa, pad_s], axis=0)
+
+    # host-side bf16 high/low split (see kernel docstring); the rounding
+    # must be jax.lax.reduce_precision, NOT a bf16 cast round-trip — under
+    # --xla_allow_excess_precision (set on this TPU stack) XLA folds
+    # convert(convert(x, bf16), f32) to x, which silently zeroes the low
+    # parts and degrades the product to single-pass bf16
+    def split(a):
+        hi = jax.lax.reduce_precision(a, exponent_bits=8, mantissa_bits=7)
+        return hi.astype(jnp.bfloat16), (a - hi).astype(jnp.bfloat16)
+
+    zxh, zxl = split(zxa)
+    zsh, zsl = split(zsa)
+
+    grid = (mq // query_block, ns // support_block)
+    x_spec = pl.BlockSpec((query_block, dp), lambda i, j: (i, 0))
+    s_spec = pl.BlockSpec((support_block, dp), lambda i, j: (j, 0))
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[x_spec, x_spec, s_spec, s_spec],
+        out_specs=pl.BlockSpec((query_block,), lambda i, j: (i,)),
+        out_shape=jax.ShapeDtypeStruct((mq,), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((query_block, 128), jnp.float32),
+            pltpu.VMEM((query_block, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(zxh, zxl, zsh, zsl)
+    return out[:m] + log_norm
+
+
+def pallas_available() -> bool:
+    """Whether the Pallas TPU path can run on the active default backend."""
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

@@ -120,8 +120,8 @@ class MultivariateNormalTransition(Transition):
         kernel (ops/kde.py): whitened cross products as matmuls + flash-style
         running logsumexp — O(M+N) memory, so 1e6 queries × 1e6 support is
         feasible on one chip (SURVEY.md §7 hard part)."""
-        from ..ops.kde import weighted_kde_logpdf
+        from ..ops.kde import weighted_kde_logpdf_auto
 
-        return weighted_kde_logpdf(
+        return weighted_kde_logpdf_auto(
             x, params["support"], params["log_w"], params["chol"],
             params["log_norm"], query_block=chunk)
