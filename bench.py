@@ -30,6 +30,8 @@ is one-off).
 - ``sir_pop100k_*``        — config #4, SIR tau-leap (pop 1e5 on the
   single chip this bench runs on; the 1e6 pod-sharded variant is the
   multi-host deployment of the same program)
+- ``petab_ode_pop100k_*``  — config #5, PEtab ODE + StochasticAcceptor
+  (exact-likelihood triple), pop 1e5
 """
 
 from __future__ import annotations
@@ -164,7 +166,8 @@ def _bench_problem(make_problem, pop, prefix):
             f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 2)}
 
 
-SUB_BENCHES = ("kde_1e6", "northstar", "lotka_volterra", "sir")
+SUB_BENCHES = ("kde_1e6", "northstar", "lotka_volterra", "sir",
+               "petab_ode")
 
 
 def _run_sub(name: str) -> dict:
@@ -177,6 +180,8 @@ def _run_sub(name: str) -> dict:
     if name == "sir":
         return _bench_problem(_sir_problem, SIR_POP,
                               f"sir_pop{SIR_POP // 1000}k")
+    if name == "petab_ode":
+        return bench_petab_ode()
     raise ValueError(name)
 
 
@@ -224,6 +229,57 @@ def main():
         "vs_baseline": round(rate / baseline, 2),
         "extra": extra,
     }))
+
+
+PETAB_POP = 100_000
+
+
+def bench_petab_ode():
+    """Config #5: PEtab-imported ODE model with exact-likelihood
+    acceptance (StochasticAcceptor + Temperature), pop 1e5 — the
+    reference's AMICI/PEtab pipeline (petab/amici.py:26-170), backed here
+    by the on-device ODE integrator and likelihood kernel."""
+    import numpy as np
+    import pandas as pd
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.petab import ODEPetabImporter
+
+    par_df = pd.DataFrame({
+        "parameterId": ["k"],
+        "parameterScale": ["lin"],
+        "lowerBound": [0.01],
+        "upperBound": [3.0],
+        "estimate": [1],
+        "objectivePriorType": ["uniform"],
+        "objectivePriorParameters": ["0.01;3.0"],
+    }).set_index("parameterId")
+    t_max, n_steps = 2.0, 20
+    obs_idx = np.asarray([4, 9, 14, 19])
+    times = (obs_idx + 1) * (t_max / n_steps)
+    rng = np.random.default_rng(0)
+    data = np.exp(-0.7 * times) + 0.05 * rng.normal(size=times.shape)
+
+    def rhs(y, theta):
+        return -theta[:, 0:1] * y
+
+    importer = ODEPetabImporter(
+        par_df, rhs=rhs, y0=[1.0], t_max=t_max, n_steps=n_steps,
+        obs_idx=obs_idx, measurements={"y0": data}, sigma=0.05)
+    abc = pt.ABCSMC(
+        models=importer.create_model(),
+        parameter_priors=importer.create_prior(),
+        distance_function=importer.create_kernel(),
+        population_size=PETAB_POP,
+        eps=pt.Temperature(),
+        acceptor=pt.StochasticAcceptor(),
+        sampler=pt.VectorizedSampler(min_batch_size=1 << 18,
+                                     max_batch_size=1 << 18),
+        seed=0)
+    abc.new("sqlite://", importer.get_observed())
+    rate, s_per_gen = _timed_generations(abc, PETAB_POP, 2, 1)
+    return {"petab_ode_pop100k_accepted_per_sec": round(rate, 1),
+            "petab_ode_pop100k_wallclock_s_per_gen": round(s_per_gen, 2)}
 
 
 def _lv_problem():
