@@ -124,7 +124,9 @@ def bench_kde_1e6():
     import jax
     import jax.numpy as jnp
 
-    from pyabc_tpu.ops.kde import weighted_kde_logpdf
+    # the production dispatcher (fused Pallas kernel on TPU at this shape)
+    from pyabc_tpu.ops.kde import weighted_kde_logpdf_auto as \
+        weighted_kde_logpdf
 
     d, n = 2, 1_000_000
     key = jax.random.PRNGKey(0)
@@ -239,7 +241,6 @@ def bench_petab_ode():
     acceptance (StochasticAcceptor + Temperature), pop 1e5 — the
     reference's AMICI/PEtab pipeline (petab/amici.py:26-170), backed here
     by the on-device ODE integrator and likelihood kernel."""
-    import numpy as np
     import pandas as pd
 
     import pyabc_tpu as pt

@@ -74,7 +74,8 @@ def plot_kde_2d(df, w, x: str, y: str, ax=None, colorbar: bool = True,
 
     mx, my, dens = kde_2d(df, w, x, y, **{k: v for k, v in kwargs.items()
                                           if k in ("xmin", "xmax", "ymin",
-                                                   "ymax", "numx", "numy")})
+                                                   "ymax", "numx", "numy",
+                                                   "kde")})
     if ax is None:
         _, ax = plt.subplots()
     mesh = ax.pcolormesh(mx, my, dens, shading=shading)
@@ -108,21 +109,29 @@ def plot_kde_matrix_highlevel(history, m: int = 0, t=None, **kwargs):
 
 def plot_kde_matrix(df, w, limits: Optional[dict] = None, refval=None,
                     kde=None, names: Optional[list] = None):
-    """Pairwise KDE matrix (reference kde.py:266-515)."""
+    """Pairwise KDE matrix (reference kde.py:266-515). ``limits`` maps
+    parameter name -> (min, max) plot range."""
     import matplotlib.pyplot as plt
 
     names = names or list(df.columns)
     n = len(names)
+    limits = limits or {}
     fig, axes = plt.subplots(n, n, figsize=(2.5 * n, 2.5 * n),
                              squeeze=False)
     for i, yi in enumerate(names):
         for j, xj in enumerate(names):
             ax = axes[i][j]
+            # limits values may be tuples or arrays — test for presence,
+            # never truthiness (ambiguous for arrays)
+            xlo, xhi = limits.get(xj, (None, None))
             if i == j:
-                plot_kde_1d(df, w, xj, ax=ax, refval=refval, kde=kde)
+                plot_kde_1d(df, w, xj, ax=ax, refval=refval, kde=kde,
+                            xmin=xlo, xmax=xhi)
             elif i > j:
+                ylo, yhi = limits.get(yi, (None, None))
                 plot_kde_2d(df, w, xj, yi, ax=ax, colorbar=False,
-                            refval=refval)
+                            refval=refval, kde=kde,
+                            xmin=xlo, xmax=xhi, ymin=ylo, ymax=yhi)
             else:
                 ax.axis("off")
     fig.tight_layout()

@@ -1,0 +1,18 @@
+"""Docs-as-tests: every example script runs end to end (parity: the
+reference executes its 9 example notebooks in CI, test/run_notebooks.sh)."""
+
+import os
+import runpy
+
+import pytest
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+@pytest.mark.parametrize("script", sorted(
+    f for f in os.listdir(EXAMPLES) if f.endswith(".py")))
+def test_example_runs(script, monkeypatch):
+    monkeypatch.setenv("ABC_EXAMPLE_POP", "200")
+    monkeypatch.setenv("ABC_EXAMPLE_GENS", "3")
+    runpy.run_path(os.path.join(EXAMPLES, script), run_name="__main__")
