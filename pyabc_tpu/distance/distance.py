@@ -103,6 +103,13 @@ class AdaptivePNormDistance(PNormDistance):
     sum-stats of ALL particles (accepted and rejected) of the previous
     generation — which is why it requests rejected recording via
     ``configure_sampler`` (reference: distance/distance.py:210-224).
+
+    ``scale_function`` contract: the recorded stats block stays
+    device-resident and pads unused rows with NaN (sampler/base.py
+    ``append_record_batch``), so a CUSTOM callable must be NaN-aware —
+    use ``jnp.nanstd``/``jnp.nanmedian``-style reducers like the built-in
+    ``SCALE_FUNCTIONS`` (distance/scale.py) do; a plain ``jnp.std`` would
+    return NaN and zero out every weight.
     """
 
     requires_all_sum_stats = True

@@ -7,6 +7,11 @@ inverse scales (pyabc/distance/distance.py:139-363).
 
 Everything runs on-device over the dense block — the reference loops keys in
 Python; here a single reduction handles all components at once.
+
+All reductions are NaN-aware (``jnp.nan*``): the device-resident record
+buffers pad unused tail rows with NaN (sampler/device_loop.py harvest), so
+padded rows — and candidates whose host simulation failed (NaN stats) —
+drop out of the scale estimate instead of poisoning it.
 """
 
 from __future__ import annotations
@@ -18,34 +23,34 @@ Array = jnp.ndarray
 
 def standard_deviation(data: Array, x_0: Array = None) -> Array:
     """std over the sample (reference scale.py:47)."""
-    return jnp.std(data, axis=0)
+    return jnp.nanstd(data, axis=0)
 
 
 def mean(data: Array, x_0: Array = None) -> Array:
-    return jnp.mean(jnp.abs(data), axis=0)
+    return jnp.nanmean(jnp.abs(data), axis=0)
 
 
 def median(data: Array, x_0: Array = None) -> Array:
-    return jnp.median(jnp.abs(data), axis=0)
+    return jnp.nanmedian(jnp.abs(data), axis=0)
 
 
 def span(data: Array, x_0: Array = None) -> Array:
-    return jnp.max(data, axis=0) - jnp.min(data, axis=0)
+    return jnp.nanmax(data, axis=0) - jnp.nanmin(data, axis=0)
 
 
 def mean_absolute_deviation(data: Array, x_0: Array = None) -> Array:
     """mean |x - mean(x)| (reference scale.py:56)."""
-    return jnp.mean(jnp.abs(data - jnp.mean(data, axis=0)), axis=0)
+    return jnp.nanmean(jnp.abs(data - jnp.nanmean(data, axis=0)), axis=0)
 
 
 def median_absolute_deviation(data: Array, x_0: Array = None) -> Array:
     """median |x - median(x)| (reference scale.py:38)."""
-    return jnp.median(jnp.abs(data - jnp.median(data, axis=0)), axis=0)
+    return jnp.nanmedian(jnp.abs(data - jnp.nanmedian(data, axis=0)), axis=0)
 
 
 def bias(data: Array, x_0: Array) -> Array:
     """|mean(x) - x_0| (reference scale.py:65)."""
-    return jnp.abs(jnp.mean(data, axis=0) - x_0)
+    return jnp.abs(jnp.nanmean(data, axis=0) - x_0)
 
 
 def root_mean_square_deviation(data: Array, x_0: Array) -> Array:
@@ -55,17 +60,17 @@ def root_mean_square_deviation(data: Array, x_0: Array) -> Array:
 
 def standard_deviation_to_observation(data: Array, x_0: Array) -> Array:
     """std of (x - x_0) deviations (reference scale.py:85)."""
-    return jnp.sqrt(jnp.mean((data - x_0) ** 2, axis=0))
+    return jnp.sqrt(jnp.nanmean((data - x_0) ** 2, axis=0))
 
 
 def mean_absolute_deviation_to_observation(data: Array, x_0: Array) -> Array:
     """mean |x - x_0| (reference scale.py:96)."""
-    return jnp.mean(jnp.abs(data - x_0), axis=0)
+    return jnp.nanmean(jnp.abs(data - x_0), axis=0)
 
 
 def median_absolute_deviation_to_observation(data: Array, x_0: Array) -> Array:
     """median |x - x_0| (reference scale.py:107)."""
-    return jnp.median(jnp.abs(data - x_0), axis=0)
+    return jnp.nanmedian(jnp.abs(data - x_0), axis=0)
 
 
 def combined_mean_absolute_deviation(data: Array, x_0: Array) -> Array:

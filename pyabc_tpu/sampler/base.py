@@ -72,6 +72,32 @@ class SamplingError(Exception):
     pass
 
 
+_NAN_MASK_CACHE: dict = {}
+
+
+def _nan_mask_records(batch: dict, rc) -> dict:
+    """NaN out record rows at index >= rc (device op; the jitted masker is
+    module-cached so it compiles once per bucket shape — rc is a traced
+    argument, not a shape)."""
+    if "fn" not in _NAN_MASK_CACHE:
+        import jax
+
+        @jax.jit
+        def mask(batch, rc):
+            keep = jnp.arange(batch["distance"].shape[0]) < rc
+            out = {}
+            for k in ("stats", "distance", "theta", "log_proposal"):
+                v = batch[k]
+                m = keep[:, None] if v.ndim == 2 else keep
+                out[k] = jnp.where(m, v, jnp.nan)
+            out["accepted"] = batch["accepted"] & keep
+            out["m"] = jnp.where(keep, batch["m"], 0)
+            return out
+
+        _NAN_MASK_CACHE["fn"] = mask
+    return _NAN_MASK_CACHE["fn"](batch, rc)
+
+
 class Sample:
     """Host-side accumulator over rounds (parity: sampler/base.py:17-120).
 
@@ -121,6 +147,7 @@ class Sample:
                 "m": np.asarray(rr.m)[take],
                 "theta": np.asarray(rr.theta)[take],
                 "log_proposal": np.asarray(rr.log_proposal)[take],
+                "__count": int(take.size),
             })
             self._n_recorded += take.size
 
@@ -154,6 +181,7 @@ class Sample:
                     "theta": np.asarray(out["rec_theta"][:rc]),
                     "log_proposal": np.asarray(
                         out["rec_log_proposal"][:rc]),
+                    "__count": rc,
                 })
                 self._n_recorded += rc
 
@@ -180,14 +208,23 @@ class Sample:
         rc = min(int(rec_count), self.max_records - self._n_recorded)
         if rc <= 0:
             return
-        self._rec.append({
-            "stats": rec["rec_stats"][:rc],
-            "distance": rec["rec_distance"][:rc],
-            "accepted": rec["rec_accepted"][:rc],
-            "m": rec["rec_m"][:rc],
-            "theta": rec["rec_theta"][:rc],
-            "log_proposal": rec["rec_log_proposal"][:rc],
-        })
+        # slice device arrays at a POW2 bucket, not the exact count: an
+        # exact dynamic length would compile a fresh slice kernel every
+        # generation (~4 s/gen through the remote compiler); the bucketed
+        # shapes are few and cache.  Rows >= rc are then NaN-masked with
+        # the count as a traced ARGUMENT (cached per bucket shape), so the
+        # tail is exactly NaN even when the max_records budget truncated
+        # below the harvested count.  NaN-aware reducers (the scale fns)
+        # consume the buffers directly; exact-count consumers use the
+        # stored "__count" after host materialization.
+        cap = rec["rec_stats"].shape[0]
+        bucket = min(int(2 ** np.ceil(np.log2(max(rc, 1)))), cap)
+        batch = _nan_mask_records(
+            {k: rec[f"rec_{k}"][:bucket]
+             for k in ("stats", "distance", "accepted", "m", "theta",
+                       "log_proposal")}, rc)
+        batch["__count"] = rc
+        self._rec.append(batch)
         self._n_recorded += rc
 
     @property
@@ -241,13 +278,23 @@ class Sample:
                 np.zeros((0, 0), np.float32)
         return self._concat(self._rec, "stats")
 
-    def get_records_arrays(self) -> Optional[dict]:
-        """All recorded candidates as column arrays, or None if none."""
+    _RECORD_KEYS = ("m", "theta", "stats", "distance", "accepted",
+                    "log_proposal")
+
+    def get_records_arrays(self, keys=None) -> Optional[dict]:
+        """Recorded candidates as EXACT-count numpy column arrays, or None
+        if none.  Device batches are stored at pow2-bucket sizes with NaN
+        tails (see append_record_batch); each requested column is
+        materialized to host and truncated to the batch's true count.
+        Pass ``keys`` to fetch only what you need — ``stats`` is the big
+        [R, S] block and costs a relay transfer per batch."""
         if not self._rec:
             return None
-        return {k: self._concat(self._rec, k)
-                for k in ("m", "theta", "stats", "distance", "accepted",
-                          "log_proposal")}
+        out = {}
+        for k in (keys if keys is not None else self._RECORD_KEYS):
+            parts = [np.asarray(b[k])[:b["__count"]] for b in self._rec]
+            out[k] = np.concatenate(parts, axis=0)
+        return out
 
     def get_records_columns(self) -> Optional[Dict[str, np.ndarray]]:
         """Per-candidate record columns for temperature schemes (reference
@@ -260,7 +307,10 @@ class Sample:
         only use the ratio pd/pd_prev, which is shift-invariant.  Array
         columns (not dicts): at the 1e6-records scale the control plane
         must stay vectorized."""
-        recs = self.get_records_arrays()
+        # the temperature schemes never read the [R, S] stats block —
+        # don't pull it through the relay
+        recs = self.get_records_arrays(
+            keys=("m", "theta", "distance", "accepted", "log_proposal"))
         if recs is None:
             return None
         log_prev = np.asarray(recs["log_proposal"], dtype=np.float64)

@@ -59,6 +59,20 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
     cap = n_target + B
     rc = max(record_cap, 1)
 
+    def _fresh_rec():
+        # unused record rows are NaN, not zero: consumers reduce over the
+        # buffers directly (NaN-aware scale functions), so padding must
+        # drop out of the statistics rather than contribute zeros
+        return {
+            "rec_stats": jnp.full((rc, s), jnp.nan, dtype=jnp.float32),
+            "rec_distance": jnp.full((rc,), jnp.nan, dtype=jnp.float32),
+            "rec_accepted": jnp.zeros((rc,), dtype=bool),
+            "rec_m": jnp.zeros((rc,), dtype=jnp.int32),
+            "rec_theta": jnp.full((rc, d), jnp.nan, dtype=jnp.float32),
+            "rec_log_proposal": jnp.full((rc,), jnp.nan,
+                                         dtype=jnp.float32),
+        }
+
     def start():
         return {
             "count": jnp.int32(0),
@@ -69,12 +83,7 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
             "distance": jnp.full((cap,), jnp.nan, dtype=jnp.float32),
             "log_weight": jnp.full((cap,), -jnp.inf, dtype=jnp.float32),
             "stats": jnp.zeros((cap, s), dtype=jnp.float32),
-            "rec_stats": jnp.zeros((rc, s), dtype=jnp.float32),
-            "rec_distance": jnp.zeros((rc,), dtype=jnp.float32),
-            "rec_accepted": jnp.zeros((rc,), dtype=bool),
-            "rec_m": jnp.zeros((rc,), dtype=jnp.int32),
-            "rec_theta": jnp.zeros((rc, d), dtype=jnp.float32),
-            "rec_log_proposal": jnp.zeros((rc,), dtype=jnp.float32),
+            **_fresh_rec(),
         }
 
     def scatter(bufs, count, rr):
@@ -136,13 +145,15 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
         return out
 
     def harvest_rec(state):
-        """(per-call record harvest, state with the record cursor reset).
+        """(per-call record harvest, state with fresh record buffers).
 
-        Records are fetched and reset EVERY call (not carried like the
+        Records are harvested and reset EVERY call (not carried like the
         accepted buffers): carrying them would silently cap a generation's
         records at the device buffer size, where the contract is
         ``max_records`` across calls with earliest-first retention
-        (host-side accounting in ``Sample.append_record_batch``).
+        (host-side accounting in ``Sample.append_record_batch``).  The
+        fresh buffers are NaN-filled so the harvested arrays' unused tail
+        rows are NaN (see ``_fresh_rec``).
         """
         rec = {k: state[k] for k in
                ("rec_stats", "rec_distance", "rec_accepted", "rec_m",
@@ -150,6 +161,7 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
         rec["rec_count"] = state["rec_count"]
         new_state = dict(state)
         new_state["rec_count"] = jnp.int32(0)
+        new_state.update(_fresh_rec())
         return rec, new_state
 
     return start, step, finalize, harvest_rec
