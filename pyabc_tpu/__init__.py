@@ -12,6 +12,7 @@ from .acceptor import (
     Acceptor,
     AcceptorResult,
     ScaledPDFNorm,
+    SimpleFunctionAcceptor,
     StochasticAcceptor,
     UniformAcceptor,
     pdf_norm_from_kernel,
@@ -21,6 +22,7 @@ from .distance import (
     SCALE_LIN,
     SCALE_LOG,
     AcceptAllDistance,
+    DistanceWithMeasureList,
     AdaptiveAggregatedDistance,
     AdaptivePNormDistance,
     AggregatedDistance,
@@ -45,6 +47,7 @@ from .distance import (
 )
 from .epsilon import (
     AcceptanceRateScheme,
+    TemperatureScheme,
     ConstantEpsilon,
     DalyScheme,
     Epsilon,
@@ -63,13 +66,14 @@ from .epsilon import (
 )
 from .model import IntegratedModel, Model, ModelResult, SimpleModel
 from .parameters import Parameter, ParameterSpace
-from .population import Population
+from .population import Particle, Population
 from .populationstrategy import (
     AdaptivePopulationSize,
     ConstantPopulationSize,
     ListPopulationSize,
 )
 from .random_variables import (
+    RVDecorator,
     RV,
     Distribution,
     LowerBoundDecorator,
@@ -83,6 +87,7 @@ from .sampler import (
     MappingSampler,
     MulticoreEvalParallelSampler,
     MulticoreParticleParallelSampler,
+    RedisEvalParallelSampler,
     RoundKernel,
     Sample,
     Sampler,
@@ -91,7 +96,7 @@ from .sampler import (
     VectorizedSampler,
 )
 from .smc import ABCSMC
-from .storage import History
+from .storage import History, create_sqlite_db_id
 from .sumstat import SumStatSpec
 from .transition import (
     AggregatedTransition,
@@ -113,7 +118,10 @@ for _name in ("ABC", "ABC.Sampler", "ABC.Distance", "ABC.Epsilon",
     _logging.getLogger(_name).setLevel(_log_level)
 
 __all__ = [
-    "ABCSMC", "History", "Population", "Parameter", "ParameterSpace",
+    "ABCSMC", "History", "create_sqlite_db_id", "Population",
+    "Particle", "Parameter",
+    "ParameterSpace", "RVDecorator", "SimpleFunctionAcceptor",
+    "TemperatureScheme", "DistanceWithMeasureList",
     "SumStatSpec",
     "Model", "SimpleModel", "IntegratedModel", "ModelResult",
     "RV", "RVBase", "Distribution", "ModelPerturbationKernel",
@@ -139,6 +147,17 @@ __all__ = [
     "Sampler", "Sample", "VectorizedSampler", "ShardedSampler",
     "SingleCoreSampler", "MulticoreEvalParallelSampler",
     "MulticoreParticleParallelSampler", "MappingSampler",
+    "RedisEvalParallelSampler",
     "ConcurrentFutureSampler", "DaskDistributedSampler", "RoundKernel",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    """Lazy subpackage access (``pyabc_tpu.visualization`` parity with the
+    reference's eager import — kept lazy so importing the framework does
+    not pull matplotlib)."""
+    if name in ("visualization", "visserver"):
+        import importlib
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,9 +1,16 @@
 """Acceptors (parity: pyabc/acceptor/)."""
 
-from .acceptor import Acceptor, AcceptorResult, StochasticAcceptor, UniformAcceptor
+from .acceptor import (
+    Acceptor,
+    AcceptorResult,
+    SimpleFunctionAcceptor,
+    StochasticAcceptor,
+    UniformAcceptor,
+)
 from .pdf_norm import ScaledPDFNorm, pdf_norm_from_kernel, pdf_norm_max_found
 
 __all__ = [
+    "SimpleFunctionAcceptor",
     "Acceptor", "AcceptorResult", "UniformAcceptor", "StochasticAcceptor",
     "pdf_norm_from_kernel", "pdf_norm_max_found", "ScaledPDFNorm",
 ]

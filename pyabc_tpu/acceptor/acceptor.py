@@ -79,6 +79,27 @@ class Acceptor:
         return {"name": type(self).__name__}
 
 
+class SimpleFunctionAcceptor(Acceptor):
+    """Wrap a plain function as an acceptor (reference acceptor.py:193-232).
+
+    TPU adaptation of the reference's per-particle
+    ``fun(distance_function, eps, x, x_0, t, par)``: here ``fun`` is
+    BATCHED and pure — ``fun(distance[N], eps) -> accept[N] bool`` (it runs
+    inside the compiled round, so no Python-side state).
+    """
+
+    def __init__(self, fun: Callable):
+        self.fun = fun
+
+    def accept(self, key, distance, params):
+        acc = self.fun(distance, params["eps"])
+        return acc, jnp.ones_like(distance)
+
+    def get_config(self):
+        return {"name": type(self).__name__,
+                "fun": getattr(self.fun, "__name__", "custom")}
+
+
 class UniformAcceptor(Acceptor):
     """Accept iff distance ≤ ε (reference acceptor.py:279-306)."""
 

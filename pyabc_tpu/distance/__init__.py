@@ -9,6 +9,7 @@ from .base import (
     to_distance,
 )
 from .distance import (
+    DistanceWithMeasureList,
     AdaptiveAggregatedDistance,
     AdaptivePNormDistance,
     AggregatedDistance,
@@ -42,6 +43,7 @@ from .scale import (
 )
 
 __all__ = [
+    "DistanceWithMeasureList",
     "Distance", "NoDistance", "AcceptAllDistance", "IdentityFakeDistance",
     "SimpleFunctionDistance", "to_distance",
     "PNormDistance", "AdaptivePNormDistance", "AggregatedDistance",

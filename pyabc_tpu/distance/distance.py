@@ -316,14 +316,45 @@ class PCADistance(Distance):
         return jnp.sqrt(jnp.sum(z**2, axis=-1))
 
 
-class RangeEstimatorDistance(PNormDistance):
-    """p-norm normalized by a calibrated per-component range
-    (reference distance.py:732-809): the range's inverse IS the p-norm
-    weight vector, so the kernel is inherited from :class:`PNormDistance`.
-    Subclasses define ``lower``/``upper`` over the calibration sample."""
+class DistanceWithMeasureList(PNormDistance):
+    """Base for distances over a subset of summary statistics
+    (reference distance.py:634-706): ``measures_to_use`` selects which
+    sum-stat keys enter the distance ("all" or a list of key names);
+    unused keys get weight 0 in the dense block."""
 
-    def __init__(self, p: float = 2.0):
+    def __init__(self, measures_to_use="all", p: float = 2.0):
         super().__init__(p=p)
+        self.measures_to_use = measures_to_use
+
+    def _measure_mask(self) -> np.ndarray:
+        """Per-component 0/1 mask over the flat block from the key list."""
+        if self.measures_to_use == "all":
+            return np.ones(self.spec.total_size, dtype=np.float32)
+        return self.spec.expand_key_values(
+            {k: 1.0 for k in self.measures_to_use}, default=0.0)
+
+    def get_params(self, t):
+        params = super().get_params(t)
+        params["w"] = params["w"] * jnp.asarray(self._measure_mask())
+        return params
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["measures_to_use"] = (self.measures_to_use
+                                  if self.measures_to_use == "all"
+                                  else list(self.measures_to_use))
+        return cfg
+
+
+class RangeEstimatorDistance(DistanceWithMeasureList):
+    """p-norm normalized by a calibrated per-component range
+    (reference distance.py:732-809, subclassing the measure-list base as
+    the reference does): the range's inverse IS the p-norm weight vector,
+    so the kernel is inherited from :class:`PNormDistance`.  Subclasses
+    define ``lower``/``upper`` over the calibration sample."""
+
+    def __init__(self, measures_to_use="all", p: float = 2.0):
+        super().__init__(measures_to_use=measures_to_use, p=p)
         self._inv_range: Optional[np.ndarray] = None
 
     @staticmethod
@@ -349,7 +380,7 @@ class RangeEstimatorDistance(PNormDistance):
                                        0.0).astype(np.float32)
 
     def get_params(self, t):
-        return {"w": jnp.asarray(self._inv_range)}
+        return {"w": jnp.asarray(self._inv_range * self._measure_mask())}
 
 
 class MinMaxDistance(RangeEstimatorDistance):

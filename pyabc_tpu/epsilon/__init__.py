@@ -3,6 +3,7 @@
 from .base import Epsilon, NoEpsilon
 from .epsilon import ConstantEpsilon, ListEpsilon, MedianEpsilon, QuantileEpsilon
 from .temperature import (
+    TemperatureScheme,
     AcceptanceRateScheme,
     DalyScheme,
     EssScheme,
@@ -16,6 +17,7 @@ from .temperature import (
 )
 
 __all__ = [
+    "TemperatureScheme",
     "Epsilon", "NoEpsilon", "ConstantEpsilon", "ListEpsilon",
     "QuantileEpsilon", "MedianEpsilon", "TemperatureBase", "ListTemperature",
     "Temperature", "AcceptanceRateScheme", "ExpDecayFixedIterScheme",

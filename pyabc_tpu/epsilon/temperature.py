@@ -186,7 +186,21 @@ def _records_to_arrays(get_all_records, kernel_scale):
     return logdens, w / w.sum()
 
 
-class AcceptanceRateScheme:
+class TemperatureScheme:
+    """Base class for temperature-proposal schemes (reference
+    temperature.py:210-255): a callable
+    ``scheme(t, get_all_records=..., pdf_norm=..., kernel_scale=...,
+    prev_temperature=..., acceptance_rate=...) -> Optional[float]``
+    proposing the next temperature; ``None`` abstains.  Schemes that need
+    per-candidate records set ``requires_all_records``."""
+
+    requires_all_records = False
+
+    def __call__(self, t, **kwargs):
+        raise NotImplementedError
+
+
+class AcceptanceRateScheme(TemperatureScheme):
     """Solve T so the expected acceptance rate hits ``target_rate``
     (reference temperature.py:258-364, bisection on the importance-weighted
     mean of min(1, exp((logdens - c)/T)))."""
@@ -226,7 +240,7 @@ class AcceptanceRateScheme:
         return float(1.0 / np.exp(b_opt))
 
 
-class ExpDecayFixedIterScheme:
+class ExpDecayFixedIterScheme(TemperatureScheme):
     """Geometric decay to T = 1 over the remaining generations
     (reference temperature.py:367-431): T_t = T_prev^((n_to_go - 1)/n_to_go).
     """
@@ -241,7 +255,7 @@ class ExpDecayFixedIterScheme:
         return float(prev_temperature ** ((t_to_go - 1) / t_to_go))
 
 
-class ExpDecayFixedRatioScheme:
+class ExpDecayFixedRatioScheme(TemperatureScheme):
     """T_t = alpha · T_prev, clamped ≥ 1 (reference temperature.py:434-500).
 
     Includes the reference's rate guards: decay slows when acceptance gets
@@ -269,7 +283,7 @@ class ExpDecayFixedRatioScheme:
         return float(max(alpha * prev_temperature, 1.0))
 
 
-class PolynomialDecayFixedIterScheme:
+class PolynomialDecayFixedIterScheme(TemperatureScheme):
     """Polynomial decay to 1 over remaining generations
     (reference temperature.py:503-564): T = 1 + (T_prev - 1)·x^exponent with
     x = (n_to_go - 1)/n_to_go."""
@@ -288,7 +302,7 @@ class PolynomialDecayFixedIterScheme:
         return float(1.0 + (prev_temperature - 1.0) * x**self.exponent)
 
 
-class DalyScheme:
+class DalyScheme(TemperatureScheme):
     """Daly et al. 2017 feedback scheme (reference temperature.py:567-632):
     keep a step size k_t; shrink it multiplicatively, and halve it whenever
     the acceptance rate drops below ``min_rate``."""
@@ -314,7 +328,7 @@ class DalyScheme:
         return float(max(prev_temperature - k, 1.0))
 
 
-class FrielPettittScheme:
+class FrielPettittScheme(TemperatureScheme):
     """Power-posterior schedule β_t = ((t+1)/n)² (reference :635-673)."""
 
     def __call__(self, t, max_nr_populations=None, prev_temperature=None,
@@ -326,7 +340,7 @@ class FrielPettittScheme:
         return float(1.0 / max(beta, 1e-8))
 
 
-class EssScheme:
+class EssScheme(TemperatureScheme):
     """Match a target relative ESS (reference temperature.py:676-733):
     find β ∈ [β_prev, 1] s.t. ESS(w_i · exp(Δβ · logdens_i)) = target · N."""
 

@@ -28,6 +28,32 @@ import numpy as np
 Array = jnp.ndarray
 
 
+class Particle:
+    """One particle, reference-compatible view (pyabc/population.py:19-95).
+
+    The TPU data plane never builds these — :class:`Population` is the unit
+    of computation — but analysis code ported from the reference can
+    iterate ``population.to_particles()``.
+    """
+
+    def __init__(self, m: int, parameter: dict, weight: float,
+                 accepted_sum_stats=None, accepted_distances=None,
+                 rejected_sum_stats=None, rejected_distances=None,
+                 accepted: bool = True):
+        self.m = int(m)
+        self.parameter = parameter
+        self.weight = float(weight)
+        self.accepted_sum_stats = accepted_sum_stats or []
+        self.accepted_distances = accepted_distances or []
+        self.rejected_sum_stats = rejected_sum_stats or []
+        self.rejected_distances = rejected_distances or []
+        self.accepted = bool(accepted)
+
+    def __repr__(self):
+        return (f"Particle(m={self.m}, parameter={self.parameter}, "
+                f"weight={self.weight:.3g}, accepted={self.accepted})")
+
+
 @jax.tree_util.register_pytree_node_class
 class Population:
     """Dense weighted particle population."""
@@ -82,6 +108,27 @@ class Population:
         return [
             {"m": int(m[i]), "parameter": theta[i], "weight": float(w[i]),
              "distance": float(d[i])}
+            for i in range(len(m))
+        ]
+
+    def to_particles(self, param_names=None):
+        """Reference-compat :class:`Particle` objects (host-side; for
+        analysis code ported from the reference — the data plane never
+        leaves array form)."""
+        m = np.asarray(self.m)
+        theta = np.asarray(self.theta)
+        w = np.asarray(self.weight)
+        d = np.asarray(self.distance)
+        acc = np.asarray(self.accepted)
+        names = param_names or [f"p{i}" for i in range(theta.shape[1])]
+        return [
+            Particle(
+                m=int(m[i]),
+                parameter={k: float(theta[i, j])
+                           for j, k in enumerate(names)},
+                weight=float(w[i]),
+                accepted_distances=[float(d[i])],
+                accepted=bool(acc[i]))
             for i in range(len(m))
         ]
 

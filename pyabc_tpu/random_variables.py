@@ -258,7 +258,32 @@ class Poisson(RVBase):
         return x * jnp.log(self.mu) - self.mu - gammaln(x + 1.0)
 
 
-class TruncatedRV(RVBase):
+class RVDecorator(RVBase):
+    """Base class for decorators around a component RV (reference
+    random_variables.py:470-536): delegates the full RV surface to
+    ``base``; subclasses override what they modify."""
+
+    def __init__(self, base: RVBase):
+        self.base = base
+
+    @property
+    def discrete(self) -> bool:
+        return self.base.discrete
+
+    def sample(self, key, shape=()):
+        return self.base.sample(key, shape)
+
+    def log_pdf(self, x):
+        return self.base.log_pdf(x)
+
+    def cdf(self, x):
+        return self.base.cdf(x)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.base!r})"
+
+
+class TruncatedRV(RVDecorator):
     """Truncate ``base`` to ``[lower, upper]`` with exact renormalization.
 
     Replaces the reference's ``LowerBoundDecorator`` rejection loop

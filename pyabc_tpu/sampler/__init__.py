@@ -6,7 +6,7 @@ from .dask_sampler import DaskDistributedSampler
 from .eps_mixin import EPSMixin
 from .mapping import ConcurrentFutureSampler, MappingSampler
 from .rounds import RoundKernel
-from .sharded import ShardedSampler
+from .sharded import RedisEvalParallelSampler, ShardedSampler
 from .vectorized import (
     MulticoreEvalParallelSampler,
     MulticoreParticleParallelSampler,
@@ -19,5 +19,6 @@ __all__ = [
     "VectorizedSampler", "ShardedSampler", "SingleCoreSampler",
     "MulticoreEvalParallelSampler", "MulticoreParticleParallelSampler",
     "MappingSampler", "ConcurrentFutureSampler", "DaskDistributedSampler",
+    "RedisEvalParallelSampler",
     "EPSMixin",
 ]

@@ -87,3 +87,18 @@ class ShardedSampler(VectorizedSampler):
             return sharded(keys, params)
 
         return run
+
+
+class RedisEvalParallelSampler(ShardedSampler):
+    """Reference-compat name for the distributed sampler
+    (pyabc/sampler/redis_eps/sampler.py:15-153): the Redis
+    broker/blackboard protocol is redesigned as SPMD shard_map rounds over
+    a device mesh with XLA collectives (see module docstring) — same DYN
+    semantics, no broker process.  Broker-specific constructor arguments
+    (host/port/password) are accepted and ignored."""
+
+    def __init__(self, host=None, port=None, password=None, batch_size=None,
+                 **kwargs):
+        if batch_size is not None:  # reference network-amortization knob
+            kwargs.setdefault("min_batch_size", batch_size)
+        super().__init__(**kwargs)

@@ -1,8 +1,8 @@
 """Storage (parity: pyabc/storage/)."""
 
 from .bytes_storage import from_bytes, to_bytes
-from .history import PRE_TIME, History
+from .history import PRE_TIME, History, create_sqlite_db_id
 from .json import load_dict_from_json, save_dict_to_json
 
-__all__ = ["History", "PRE_TIME", "save_dict_to_json", "load_dict_from_json",
+__all__ = ["History", "PRE_TIME", "create_sqlite_db_id", "save_dict_to_json", "load_dict_from_json",
            "to_bytes", "from_bytes"]

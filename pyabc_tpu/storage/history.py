@@ -38,6 +38,16 @@ from .bytes_storage import from_bytes, to_bytes
 
 PRE_TIME = -1  # calibration-sample time index (reference history.py:135)
 
+
+def create_sqlite_db_id(dir_: Optional[str] = None,
+                        file_: str = "pyabc_test.db") -> str:
+    """Convenience sqlite identifier ``sqlite:///<dir>/<file>`` (reference
+    history.py:64-86; defaults to the system temp dir — fine for tests,
+    use a durable location for real runs)."""
+    import tempfile
+    base = dir_ if dir_ is not None else tempfile.gettempdir()
+    return "sqlite:///" + os.path.join(base, file_)
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS abc_smc (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
