@@ -27,7 +27,7 @@ import io
 import json
 import os
 import sqlite3
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -146,6 +146,10 @@ class History:
             " epsilon, population_strategy) VALUES (?,?,?,?,?)",
             (datetime.datetime.now().isoformat(),
              json.dumps({"ground_truth_model": ground_truth_model,
+                         "ground_truth_parameter":
+                             {k: float(v) for k, v
+                              in dict(ground_truth_parameter).items()}
+                             if ground_truth_parameter else None,
                          "model_names": model_names, **(options or {})}),
              distance_function_json, eps_function_json,
              population_strategy_json))
@@ -333,22 +337,34 @@ class History:
             off += size
         return out
 
+    def _raw_weighted_sum_stats(self, t: int, m: int
+                                ) -> Tuple[np.ndarray, List[Dict]]:
+        """Un-normalized (weights, per-particle sum-stat dicts) of one
+        model — shared by the all-models and per-model accessors."""
+        row = self._conn.execute(
+            "SELECT weight FROM model_populations WHERE abc_smc_id=? "
+            "AND t=? AND m=?", (self.id, t, m)).fetchone()
+        if row is None:
+            return np.zeros(0), []
+        w = _unpack(row[0])
+        keyed = self.get_sum_stats(t, m)
+        dicts = [{k: v[i] for k, v in keyed.items()}
+                 for i in range(w.shape[0])]
+        return w, dicts
+
     def get_weighted_sum_stats(self, t: Optional[int] = None
                                ) -> Tuple[np.ndarray, List[Dict]]:
         """(weights, one sum-stat dict per particle) across all models —
         reference history.py:1004-1040 signature."""
         t = self.max_t if t is None else t
         rows = self._conn.execute(
-            "SELECT m, weight FROM model_populations WHERE abc_smc_id=? "
+            "SELECT m FROM model_populations WHERE abc_smc_id=? "
             "AND t=? ORDER BY m", (self.id, t)).fetchall()
         weights, dicts = [], []
-        for m, wb in rows:
-            w = _unpack(wb)
-            keyed = self.get_sum_stats(t, m)
-            n = w.shape[0]
+        for (m,) in rows:
+            w, d = self._raw_weighted_sum_stats(t, m)
             weights.append(w)
-            for i in range(n):
-                dicts.append({k: v[i] for k, v in keyed.items()})
+            dicts.extend(d)
         if not weights:
             return np.zeros(0), []
         w = np.concatenate(weights)
@@ -365,13 +381,93 @@ class History:
             "SELECT id, start_time FROM abc_smc").fetchall()
         return pd.DataFrame(rows, columns=["id", "start_time"])
 
-    def model_names(self) -> List[str]:
+    # ---- reference-surface accessors (history.py:88-132, 418-470) --------
+
+    def db_file(self) -> str:
+        return self.db_path
+
+    @property
+    def db_size(self) -> float:
+        """DB size in MB, -1 for in-memory (reference history.py:125-132)."""
+        if self.in_memory:
+            return -1.0
+        try:
+            return os.path.getsize(self.db_path) / 1e6
+        except OSError:
+            return -1.0
+
+    @property
+    def total_nr_simulations(self) -> int:
+        row = self._conn.execute(
+            "SELECT SUM(nr_samples) FROM populations WHERE abc_smc_id=?",
+            (self.id,)).fetchone()
+        return int(row[0] or 0)
+
+    def _json_parameters(self) -> dict:
         row = self._conn.execute(
             "SELECT json_parameters FROM abc_smc WHERE id=?",
             (self.id,)).fetchone()
-        if row is None:
-            return []
-        return json.loads(row[0]).get("model_names", [])
+        return json.loads(row[0]) if row and row[0] else {}
+
+    def get_ground_truth_parameter(self) -> dict:
+        """(reference history.py:418-434)."""
+        return self._json_parameters().get("ground_truth_parameter") or {}
+
+    def nr_of_models_alive(self, t: Optional[int] = None) -> int:
+        return len(self.alive_models(t))
+
+    def get_weighted_sum_stats_for_model(self, m: int = 0,
+                                         t: Optional[int] = None
+                                         ) -> Tuple[np.ndarray, List[Dict]]:
+        """(weights, sum-stat dicts) for one model (reference
+        history.py:966-1002)."""
+        t = self.max_t if t is None else t
+        w, dicts = self._raw_weighted_sum_stats(t, m)
+        if w.size == 0:
+            return w, dicts
+        return w / max(w.sum(), 1e-300), dicts
+
+    def get_population_extended(self, m: Optional[int] = None,
+                                t: Union[int, str, None] = "last"
+                                ) -> pd.DataFrame:
+        """Long-form particle table over generations (reference
+        history.py:1043-1078): columns t, m, w, distance + parameters."""
+        if t == "last":
+            ts = [self.max_t]
+        elif t is None or t == "all":
+            # includes the calibration sample (t = PRE_TIME), as the
+            # reference's unfiltered query does
+            ts = [r[0] for r in self._conn.execute(
+                "SELECT DISTINCT t FROM model_populations WHERE "
+                "abc_smc_id=? ORDER BY t", (self.id,)).fetchall()]
+        else:
+            ts = [int(t)]
+        frames = []
+        for ti in ts:
+            query = ("SELECT m, theta, weight, distance, param_names FROM "
+                     "model_populations WHERE abc_smc_id=? AND t=?")
+            args = [self.id, ti]
+            if m is not None:
+                query += " AND m=?"
+                args.append(m)
+            rows = self._conn.execute(query + " ORDER BY m",
+                                      args).fetchall()
+            for mi, tb, wb, db_, names_json in rows:
+                theta = _unpack(tb)
+                names = (json.loads(names_json)
+                         or [f"p{i}" for i in range(theta.shape[1])])
+                df = pd.DataFrame(theta[:, :len(names)], columns=names)
+                df.insert(0, "distance", _unpack(db_))
+                df.insert(0, "w", _unpack(wb))
+                df.insert(0, "m", mi)
+                df.insert(0, "t", ti)
+                frames.append(df)
+        if not frames:
+            return pd.DataFrame(columns=["t", "m", "w", "distance"])
+        return pd.concat(frames, ignore_index=True)
+
+    def model_names(self) -> List[str]:
+        return self._json_parameters().get("model_names", [])
 
     def done(self):
         self._conn.commit()

@@ -220,3 +220,29 @@ def test_old_schema_migration(db_path):
     # and new writes work against the migrated table
     h.store_initial_data(None, {}, {"z": np.asarray([3.0])}, None, ["m0"])
     assert np.allclose(h.observed_sum_stat()["z"], [3.0])
+
+
+def test_reference_history_accessors(db_path):
+    """db_file/db_size/total_nr_simulations/gt-parameter/extended table
+    (reference history.py:88-132, 418-470, 1043-1078)."""
+    h = History(db_path)
+    h.store_initial_data(1, {}, {"y": np.asarray([1.0])}, {"mu": 0.5},
+                         ["m0", "m1"])
+    pop = _population(n=30)
+    h.append_population(0, 0.4, pop, 90, ["m0", "m1"],
+                        param_names=["a", "b"])
+    h.append_population(1, 0.2, pop, 120, ["m0", "m1"],
+                        param_names=["a", "b"])
+    assert h.db_file() == db_path
+    assert h.db_size > 0
+    assert h.total_nr_simulations == 210
+    assert h.get_ground_truth_parameter() == {"mu": 0.5}
+    assert h.nr_of_models_alive() == 2
+    df = h.get_population_extended()           # last generation
+    assert set(df.t) == {1} and {"m", "w", "distance", "a", "b"} <= set(df)
+    df_all = h.get_population_extended(t="all")
+    assert set(df_all.t) == {0, 1}
+    df_m0 = h.get_population_extended(m=0, t=0)
+    assert (df_m0.m == 0).all()
+    w, stats = h.get_weighted_sum_stats_for_model(m=0, t=1)
+    assert w.shape[0] == len(stats) and abs(w.sum() - 1) < 1e-6
