@@ -170,7 +170,7 @@ class VectorizedSampler(Sampler):
         state = start()
         call_idx = 0
         count = rounds = 0
-        out_dev = None
+        out = None
         while True:
             key, sub = jax.random.split(key)
             state = step(sub, params, state)
@@ -182,28 +182,31 @@ class VectorizedSampler(Sampler):
                 # the arrays stay device-resident (Sample materializes
                 # only what consumers actually read)
                 rec, state = harvest(state)
-            # optimistic prefetch: when this call is expected to finish the
-            # generation, start the result transfer concurrently with the
-            # scalar sync below — hides most of the relay's per-transfer
-            # latency on the (common) single-call generation
+            # ONE host transfer per call.  When this call is expected to
+            # finish the generation (the common single-call case), fetch
+            # the finalized buffers directly — count/rounds ride along, so
+            # no separate scalar round-trip.  Otherwise sync just the
+            # scalars; the buffers stay device-resident.
             expected = count + B * self.max_rounds_per_call * self._rate_est
-            out_dev = None
+            out = None
             if expected >= n:
-                out_dev = finalize(state)
-                for leaf in jax.tree_util.tree_leaves(out_dev):
-                    try:
-                        leaf.copy_to_host_async()
-                    except Exception:
-                        break
-            # ONE bundled scalar sync per call — the buffers stay
-            # device-resident (count/rounds/rec_count in one transfer)
-            scalars = [state["count"], state["rounds"]]
+                fetch = [finalize(state)]
+                if rec is not None:
+                    fetch.append(rec["rec_count"])
+                fetch = jax.device_get(fetch)
+                out = fetch[0]
+                count, rounds = int(out["count"]), int(out["rounds"])
+                if rec is not None:
+                    rec["rec_count_host"] = int(fetch[1])
+            else:
+                scalars = [state["count"], state["rounds"]]
+                if rec is not None:
+                    scalars.append(rec["rec_count"])
+                scalars = jax.device_get(scalars)
+                count, rounds = int(scalars[0]), int(scalars[1])
+                if rec is not None:
+                    rec["rec_count_host"] = int(scalars[2])
             if rec is not None:
-                scalars.append(rec["rec_count"])
-            scalars = jax.device_get(scalars)
-            count, rounds = int(scalars[0]), int(scalars[1])
-            if rec is not None:
-                rec["rec_count_host"] = int(scalars[2])
                 sample.append_record_batch(rec)
             call_idx += 1
             rate_obs = count / max(rounds * B, 1)
@@ -216,12 +219,14 @@ class VectorizedSampler(Sampler):
             if count >= n:
                 break
             if rounds * B >= max_eval:
+                # a mis-predicted prefetch already fetched valid buffers —
+                # keep them rather than re-transferring identical data
                 logger.warning("max_eval=%s reached with %d/%d accepted",
                                max_eval, count, n)
                 break
-        if out_dev is None:
-            out_dev = finalize(state)
-        out = jax.device_get(out_dev)
+            out = None  # mis-predicted prefetch: discard, keep sampling
+        if out is None:
+            out = jax.device_get(finalize(state))
         sample.append_device_batch(out, rounds * B)
         if bar is not None:
             bar.finish()
