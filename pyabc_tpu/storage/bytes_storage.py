@@ -41,9 +41,14 @@ def to_bytes(obj: Any) -> Tuple[str, bytes]:
         obj.to_frame(name=obj.name if obj.name is not None else "__series__"
                      ).to_parquet(buf)
         return TAG_SERIES, buf.getvalue()
-    if isinstance(obj, np.ndarray) and obj.dtype != object:
+    if isinstance(obj, (np.ndarray, np.generic)) and \
+            not isinstance(obj, np.character) and \
+            np.asarray(obj).dtype != object:
+        # numeric np.generic BEFORE the plain-scalar branch: np.float64
+        # subclasses float, and the npy path is what preserves its dtype
+        # (np.str_/np.bytes_ subclass str/bytes and stay on those paths)
         buf = io.BytesIO()
-        np.save(buf, obj, allow_pickle=False)
+        np.save(buf, np.asarray(obj), allow_pickle=False)
         return TAG_NPY, buf.getvalue()
     if isinstance(obj, (bytes, bytearray)):
         return TAG_BYTES, bytes(obj)
@@ -75,7 +80,10 @@ def to_bytes(obj: Any) -> Tuple[str, bytes]:
 def from_bytes(tag: str, blob: bytes) -> Any:
     """Inverse of :func:`to_bytes`."""
     if tag == TAG_NPY:
-        return np.load(io.BytesIO(blob), allow_pickle=False)
+        arr = np.load(io.BytesIO(blob), allow_pickle=False)
+        # a stored numpy SCALAR comes back as a scalar (np.float64 IS a
+        # float), not a 0-d array
+        return arr[()] if arr.ndim == 0 else arr
     if tag == TAG_DF:
         return pd.read_parquet(io.BytesIO(blob))
     if tag == TAG_SERIES:

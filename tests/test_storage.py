@@ -246,3 +246,24 @@ def test_reference_history_accessors(db_path):
     assert (df_m0.m == 0).all()
     w, stats = h.get_weighted_sum_stats_for_model(m=0, t=1)
     assert w.shape[0] == len(stats) and abs(w.sum() - 1) < 1e-6
+
+
+def test_bytes_storage_numpy_dtypes_roundtrip():
+    """Exotic numpy dtypes round-trip losslessly (reference
+    test_numpy_bytes_storage.py / test_bytesstorage.py coverage)."""
+    from pyabc_tpu.storage import from_bytes, to_bytes
+
+    cases = [
+        np.arange(6, dtype=np.int8).reshape(2, 3),
+        np.asarray([True, False, True]),
+        np.asarray([1.5, 2.5], dtype=np.float16),
+        np.asarray([1 + 2j, 3 - 4j]),                      # complex
+        np.asarray(["2020-01-01", "2021-06-15"], "datetime64[D]"),
+        np.zeros(3, dtype=[("a", np.int32), ("b", np.float64)]),  # struct
+        np.float64(3.25),                                  # 0-d scalar
+    ]
+    for arr in cases:
+        tag, blob = to_bytes(arr)
+        back = from_bytes(tag, blob)
+        assert back.dtype == np.asarray(arr).dtype, arr.dtype
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
