@@ -290,9 +290,17 @@ class Sample:
         [R, S] block and costs a relay transfer per batch."""
         if not self._rec:
             return None
+        keys = tuple(keys if keys is not None else self._RECORD_KEYS)
+        # ONE bundled host transfer for all requested columns of all
+        # batches (per-column np.asarray would pay the relay's
+        # per-transaction constant keys x batches times)
+        import jax
+        fetched = jax.device_get([{k: b[k] for k in keys}
+                                  for b in self._rec])
         out = {}
-        for k in (keys if keys is not None else self._RECORD_KEYS):
-            parts = [np.asarray(b[k])[:b["__count"]] for b in self._rec]
+        for k in keys:
+            parts = [np.asarray(f[k])[:b["__count"]]
+                     for f, b in zip(fetched, self._rec)]
             out[k] = np.concatenate(parts, axis=0)
         return out
 

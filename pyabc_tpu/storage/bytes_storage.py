@@ -81,8 +81,10 @@ def from_bytes(tag: str, blob: bytes) -> Any:
     """Inverse of :func:`to_bytes`."""
     if tag == TAG_NPY:
         arr = np.load(io.BytesIO(blob), allow_pickle=False)
-        # a stored numpy SCALAR comes back as a scalar (np.float64 IS a
-        # float), not a 0-d array
+        # CONVENTION: .npy cannot distinguish a 0-d array from a scalar
+        # (both serialize identically), so 0-d always decodes to the numpy
+        # SCALAR (np.float64 IS a float, np.str_ IS a str).  Callers that
+        # need an ndarray wrap with np.asarray().
         return arr[()] if arr.ndim == 0 else arr
     if tag == TAG_DF:
         return pd.read_parquet(io.BytesIO(blob))
