@@ -123,3 +123,42 @@ def test_continuous_non_gaussian(db_path):
     grid = np.linspace(0.1, 1.0, 50)
     f_emp = np.interp(grid, xs, cdf)
     assert np.abs(f_emp - f_expected(grid)).max() < 0.12
+
+
+def test_exponential_gamma_conjugate(db_path):
+    """y_i ~ Exp(lam), lam ~ Gamma(a, b): posterior is
+    Gamma(a + n, b + sum y) — the ABC posterior mean must approach
+    (a + n) / (b + sum_y) as epsilon shrinks (conjugate-pair check in the
+    spirit of the reference's gaussian suite)."""
+    a, b = 2.0, 1.0
+    n_obs = 8
+    lam_true = 1.6
+    rng = np.random.default_rng(5)
+    y = rng.exponential(1.0 / lam_true, size=n_obs).astype(np.float32)
+
+    def model(key, theta):
+        import jax
+        import jax.numpy as jnp
+        lam = jnp.maximum(theta[:, :1], 1e-6)
+        u = jax.random.uniform(key, (theta.shape[0], n_obs),
+                               minval=1e-7, maxval=1.0)
+        draws = -jnp.log(u) / lam
+        # sufficient statistic: the sample mean
+        return {"ybar": jnp.mean(draws, axis=1)}
+
+    abc = pt.ABCSMC(
+        pt.SimpleModel(model),
+        pt.Distribution(lam=pt.RV("gamma", a, scale=1.0 / b)),
+        pt.PNormDistance(p=1),
+        population_size=800,
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 15),
+        seed=17)
+    abc.new(db_path, {"ybar": float(np.mean(y))})
+    h = abc.run(max_nr_populations=7, minimum_epsilon=1e-3)
+
+    df, w = h.get_distribution()
+    lam_mean = float(np.sum(df["lam"].to_numpy() * w))
+    posterior_mean = (a + n_obs) / (b + float(np.sum(y)))
+    # ABC targets p(lam | ybar), not p(lam | y): with the sufficient
+    # statistic these coincide for the exponential likelihood
+    assert lam_mean == pytest.approx(posterior_mean, rel=0.2)
