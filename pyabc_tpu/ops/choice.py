@@ -27,6 +27,13 @@ def fast_weighted_choice(key, log_w: Array, n: int) -> Array:
     w = jax.nn.softmax(log_w)
     cdf = jnp.cumsum(w)
     u = jax.random.uniform(key, (n,), dtype=cdf.dtype) * cdf[-1]
+    # uniform*cdf[-1] can round UP to exactly cdf[-1] in f32 (uniform near 1),
+    # in which case side='right' finds no cdf[i] > u and returns N — and a
+    # plain N-1 clamp would land on a zero-weight padded row.  Capping u at
+    # the float just below cdf[-1] makes searchsorted return the LAST
+    # positive-weight index instead (trailing flat CDF segments all equal
+    # cdf[-1], so the first cdf[i] > u is the final real entry).
+    u = jnp.minimum(u, jnp.nextafter(cdf[-1], jnp.zeros((), cdf.dtype)))
     # side='right': smallest i with cdf[i] > u — a flat (zero-weight) CDF
     # segment is skipped even when u lands EXACTLY on its value (incl. the
     # u = 0.0 draw against a zero-weight first entry, which side='left'

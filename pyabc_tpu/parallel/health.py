@@ -211,14 +211,21 @@ def stop_requested(directory: Optional[str] = None) -> bool:
     inside the next generation's collectives.
     """
     directory = directory if directory is not None else run_dir()
-    if not directory:
-        return False
     import jax
     if jax.process_count() > 1:
+        # Every host MUST enter the collective, even those launched without
+        # --run-dir (directory unset): an early per-host `return False` would
+        # leave the run-dir hosts blocked in the collective while the rest
+        # move on — a permanent hang at the generation boundary.  The
+        # decision is an OR over ALL hosts' sentinel checks (not process 0's
+        # alone) so a stop still lands when process 0 happens to be a host
+        # without a run dir.
         from jax.experimental import multihost_utils
         import numpy as np
-        local = (jax.process_index() == 0 and
+        local = (bool(directory) and
                  os.path.exists(os.path.join(directory, STOP_SENTINEL)))
-        return bool(multihost_utils.broadcast_one_to_all(
-            np.asarray(local)))
+        seen = multihost_utils.process_allgather(np.asarray(local))
+        return bool(np.any(seen))
+    if not directory:
+        return False
     return os.path.exists(os.path.join(directory, STOP_SENTINEL))

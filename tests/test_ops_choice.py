@@ -28,6 +28,20 @@ def test_unnormalized_and_padded_weights():
     np.testing.assert_allclose(freq[:2], [0.5, 0.5], atol=0.02)
 
 
+def test_uniform_at_one_never_hits_padding(monkeypatch):
+    # Worst case of the f32 rounding edge: uniform*cdf[-1] landing EXACTLY on
+    # cdf[-1] (simulated by forcing uniform == 1.0).  searchsorted would then
+    # return N and a bare N-1 clamp would select the zero-weight padded row;
+    # the nextafter guard must route the draw to the last REAL entry instead.
+    def ones_uniform(key, shape=(), dtype=jnp.float32, **kw):
+        return jnp.ones(shape, dtype)
+
+    monkeypatch.setattr(jax.random, "uniform", ones_uniform)
+    log_w = jnp.asarray([0.0, 0.0, -1e30, -1e30], jnp.float32)
+    idx = np.asarray(fast_weighted_choice(jax.random.PRNGKey(3), log_w, 64))
+    assert (idx == 1).all()
+
+
 def test_single_point_support():
     idx = np.asarray(fast_weighted_choice(
         jax.random.PRNGKey(2), jnp.zeros(1), 16))
