@@ -106,10 +106,12 @@ def bench_northstar():
         models, priors, distance,
         population_size=NORTHSTAR_POP,
         eps=pt.ConstantEpsilon(0.2),
-        # short fused dispatches: a 64-round fuse at this scale is one
-        # multi-minute XLA program, which the remote-TPU relay kills
+        # bounded fused dispatches: the remote-TPU relay kills multi-minute
+        # XLA programs; with the deferred-proposal rounds (~0.3 s each) 8
+        # rounds per call stays a ~3 s program while amortizing the relay's
+        # per-call sync constant
         sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
-                                     max_rounds_per_call=2),
+                                     max_rounds_per_call=8),
         seed=0)
     abc.new("sqlite://", observed)
     # warmup = calibration + prior gen + one full KDE generation (compiles)

@@ -204,11 +204,12 @@ def clear_stop(directory: str):
 def stop_requested(directory: Optional[str] = None) -> bool:
     """Polled by the orchestrator between generations.
 
-    Multi-host safe: with >1 ``jax.distributed`` processes the decision is
-    taken on process 0 and broadcast through a collective, so every host
-    leaves the generation loop at the SAME boundary — a per-host filesystem
-    poll could desynchronize (NFS attribute-cache lag) and strand one host
-    inside the next generation's collectives.
+    Multi-host safe: with >1 ``jax.distributed`` processes every host's
+    sentinel check enters an allgather and the results are OR-ed, so all
+    hosts take the SAME stop decision at the same generation boundary — a
+    per-host filesystem poll could desynchronize (NFS attribute-cache lag)
+    and strand one host inside the next generation's collectives, and a
+    host launched without --run-dir still participates (its vote is False).
     """
     directory = directory if directory is not None else run_dir()
     import jax

@@ -354,6 +354,10 @@ class Sampler:
     def __init__(self):
         self.nr_evaluations_ = 0
         self.record_rejected = False
+        #: set (with record_rejected) by TemperatureBase.configure_sampler:
+        #: records must carry real per-candidate proposal densities, which
+        #: disables the deferred-proposal fast path (VectorizedSampler)
+        self.record_proposal_density = False
         self.show_progress = False
         #: cap on recorded candidates per generation; the orchestrator sets
         #: this from ABCSMC.max_nr_recorded_particles (reference

@@ -29,7 +29,8 @@ Array = jnp.ndarray
 
 
 def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
-                        max_rounds: int, record_cap: int, d: int, s: int):
+                        max_rounds: int, record_cap: int, d: int, s: int,
+                        weight_correction: Callable = None):
     """Carry-state generation loop for the remote-relay regime: accepted particles ACCUMULATE in device-resident buffers
     across host calls, so the host fetches one scalar (``count``) per call
     and the full buffers exactly ONCE per generation.
@@ -48,13 +49,19 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
     - ``start() -> state`` — zeroed buffers (jitted, cheap)
     - ``step(key, params, state) -> state`` — up to ``max_rounds`` rounds;
       donates ``state`` so buffers update in place
-    - ``finalize(state) -> out`` — accepted buffers + counts for the one
-      full host fetch per generation
+    - ``finalize(state, params) -> out`` — accepted buffers + counts for
+      the one full host fetch per generation
     - ``harvest_rec(state) -> (rec, state)`` — per-call record fetch with
       cursor reset (see its docstring)
 
     ``d``/``s`` are the theta/stats widths (state shapes must be known
     before the first round runs).
+
+    ``weight_correction(m, theta, params) -> log_denom``, when given,
+    marks the rounds as having produced PARTIAL log weights (proposal
+    density skipped — see ``RoundKernel.generation_round``); finalize then
+    subtracts the proposal log density computed ONCE over the accepted
+    buffer, instead of every round paying the full-batch KDE.
     """
     cap = n_target + B
     rc = max(record_cap, 1)
@@ -136,10 +143,17 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
             cond, body, (key, state, jnp.int32(0)))
         return state
 
-    def finalize(state):
+    def finalize(state, params):
         keys = ("m", "theta", "distance", "log_weight", "stats")
         out = {k: state[k][:n_target] for k in keys}
         out["accepted_mask"] = jnp.arange(n_target) < state["count"]
+        if weight_correction is not None:
+            log_denom = weight_correction(out["m"], out["theta"], params)
+            # unfilled rows carry -inf partial weights; leave them alone
+            # (-inf − -inf would be NaN if the density underflowed too)
+            lw = out["log_weight"]
+            out["log_weight"] = jnp.where(
+                jnp.isfinite(lw), lw - log_denom, lw)
         out["count"] = state["count"]
         out["rounds"] = state["rounds"]
         return out

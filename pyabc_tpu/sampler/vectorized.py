@@ -68,10 +68,19 @@ class VectorizedSampler(Sampler):
         return jax.jit(raw) if self._jit else raw
 
     def _build_stateful(self, round_fn: Callable, B: int, n_target: int,
-                        record_cap: int, d: int, s: int):
-        raw = self._raw_round(round_fn, B)
+                        record_cap: int, d: int, s: int,
+                        defer: bool = False):
+        if defer:
+            # rounds skip the proposal-density KDE (the hot op); finalize
+            # subtracts it once over the accepted buffer instead
+            raw = self._raw_round(round_fn, B, with_proposal=False)
+            weight_fn = round_fn.__self__.proposal_log_density
+        else:
+            raw = self._raw_round(round_fn, B)
+            weight_fn = None
         start, step, finalize, harvest = build_stateful_loop(
-            raw, B, n_target, self.max_rounds_per_call, record_cap, d, s)
+            raw, B, n_target, self.max_rounds_per_call, record_cap, d, s,
+            weight_correction=weight_fn)
         if self._jit:
             # donate the carry so the cap-sized buffers update in place
             return (jax.jit(start), jax.jit(step, donate_argnums=(2,)),
@@ -122,6 +131,11 @@ class VectorizedSampler(Sampler):
                                 **kwargs) -> Sample:
         sample = Sample(record_rejected=self.record_rejected,
                         max_records=self.max_records)
+        # params arrive as host numpy (pad_params is control-plane work);
+        # pin them on device ONCE — otherwise every step/finalize call
+        # re-uploads the ~MBs of transition support (measured 0.43 s/call
+        # at the 1e6 north star through the relay)
+        params = jax.device_put(params)
         if all_accepted:
             # calibration: exact-size rounds (reference all_accepted path,
             # smc.py:534-537); normally ONE round suffices, but failed host
@@ -164,9 +178,15 @@ class VectorizedSampler(Sampler):
         record_cap = (min(self.max_records_cap(),
                           B * self.max_rounds_per_call)
                       if self.record_rejected else 0)
+        # defer the proposal-density KDE to one per-generation pass over
+        # the accepted buffer whenever nothing consumes per-candidate
+        # densities (only temperature schemes do, via record columns)
+        defer = (getattr(round_fn, "supports_deferred_proposal", False)
+                 and hasattr(round_fn, "__self__")
+                 and not self.record_proposal_density)
         d, s = self._round_shape(round_fn, B, params)
         start, step, finalize, harvest = self._get(
-            "sloop", round_fn, B, n, record_cap, d, s)
+            "sloop", round_fn, B, n, record_cap, d, s, defer)
         state = start()
         call_idx = 0
         count = rounds = 0
@@ -186,11 +206,14 @@ class VectorizedSampler(Sampler):
             # finish the generation (the common single-call case), fetch
             # the finalized buffers directly — count/rounds ride along, so
             # no separate scalar round-trip.  Otherwise sync just the
-            # scalars; the buffers stay device-resident.
+            # scalars; the buffers stay device-resident.  In DEFERRED mode
+            # finalize contains the full-population proposal-density KDE,
+            # so a mispredicted prefetch would pay (and discard) the
+            # dominant op — there, only finalize on a known-complete count.
             expected = count + B * self.max_rounds_per_call * self._rate_est
             out = None
-            if expected >= n:
-                fetch = [finalize(state)]
+            if expected >= n and not defer:
+                fetch = [finalize(state, params)]
                 if rec is not None:
                     fetch.append(rec["rec_count"])
                 fetch = jax.device_get(fetch)
@@ -226,7 +249,7 @@ class VectorizedSampler(Sampler):
                 break
             out = None  # mis-predicted prefetch: discard, keep sampling
         if out is None:
-            out = jax.device_get(finalize(state))
+            out = jax.device_get(finalize(state, params))
         sample.append_device_batch(out, rounds * B)
         if bar is not None:
             bar.finish()

@@ -110,6 +110,8 @@ class ABCSMC:
         self._obs_flat = None
         self._kernel: Optional[RoundKernel] = None
         self._trans_params: Optional[tuple] = None
+        #: per-model transition padding buckets (see _pad_bucket)
+        self._pad_buckets: Dict[int, int] = {}
         self.minimum_epsilon = 0.0
         self.max_nr_populations = np.inf
         self.min_acceptance_rate = 0.0
@@ -196,10 +198,32 @@ class ABCSMC:
                np.ones((1,), dtype=np.float32))
         return tr.pad_params(tr.get_params(), n_pad)
 
+    def _pad_bucket(self, m: int, count: int, n_pad: int) -> int:
+        """Per-model pow2 padding bucket with hysteresis.
+
+        Padding every model's support to the full population doubles the
+        proposal-density KDE pair-work with M=2 (the dominant op at the
+        1e6 north star); a pow2 bucket of the model's ACTUAL particle
+        count keeps shapes stable across generations (few distinct
+        programs) while only paying for real support.  Hysteresis: a
+        fitted bucket only shrinks when the count falls below a quarter
+        of it, so model-probability drift between adjacent generations
+        doesn't bill recompiles.
+        """
+        from .sampler.vectorized import _pow2_at_least
+        need = min(max(_pow2_at_least(count), 256), n_pad)
+        prev = self._pad_buckets.get(m)
+        if prev is not None and prev <= n_pad and count <= prev \
+                and count > prev // 4:
+            return prev
+        self._pad_buckets[m] = need
+        return need
+
     def _fit_transitions(self, t: int, population=None):
         """KDE refit from the last generation (reference smc.py:1065-1079),
-        padded to the population size for shape stability.  The in-memory
-        population is used when at hand; the DB read only serves resume."""
+        padded to a per-model pow2 bucket for shape stability.  The
+        in-memory population is used when at hand; the DB read only serves
+        resume."""
         if t == 0:
             return
         pop = (population if population is not None
@@ -210,7 +234,8 @@ class ABCSMC:
         for m in range(self.M):
             idx = np.nonzero(m_arr == m)[0]
             if idx.size == 0:
-                params.append(self._dummy_trans_params(m, n_pad))
+                params.append(self._dummy_trans_params(
+                    m, self._pad_bucket(m, 1, n_pad)))
                 continue
             dim_m = self.parameter_priors[m].dim
             theta_m = np.asarray(pop.theta)[idx, :dim_m]
@@ -218,7 +243,8 @@ class ABCSMC:
             self.transitions[m].fit(theta_m, w_m)
             # padding policy lives in the Transition contract (pad_params)
             params.append(self.transitions[m].pad_params(
-                self.transitions[m].get_params(), n_pad))
+                self.transitions[m].get_params(),
+                self._pad_bucket(m, idx.size, n_pad)))
         self._trans_params = tuple(params)
 
     def _adapt_population_size(self, t: int):
@@ -385,6 +411,10 @@ class ABCSMC:
             self._calibrate(t0)
         else:
             self._initialize_from_history(t0)
+        # fresh feature requests each run: a previous run's eps/distance
+        # must not leave stale record flags on a reused sampler
+        self.sampler.record_rejected = False
+        self.sampler.record_proposal_density = False
         self.distance_function.configure_sampler(self.sampler)
         self.eps.configure_sampler(self.sampler)
         self.sampler.max_records = self.max_nr_recorded_particles

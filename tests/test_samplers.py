@@ -110,3 +110,44 @@ def test_graft_entry_single_and_multichip():
     # always a true 8-device pass: dryrun_multichip self-provisions a
     # virtual 8-CPU mesh in a subprocess when this interpreter has fewer
     ge.dryrun_multichip(8)
+
+
+def test_deferred_proposal_weight_equivalence(db_path):
+    """The deferred-proposal fast path (rounds skip the proposal-density
+    KDE; finalize subtracts it over the accepted buffer) must yield the
+    same populations as the eager per-round computation."""
+    def run(eager: bool):
+        models, priors, distance, observed, _ = make_two_gaussians_problem()
+        abc = pt.ABCSMC(models, priors, distance,
+                        population_size=400,
+                        sampler=pt.VectorizedSampler(),
+                        seed=11)
+        abc.new("sqlite://", observed)
+        if eager:
+            # force the eager path the way a temperature scheme would:
+            # flip the record flags after smc's per-run reset
+            from pyabc_tpu.sampler import vectorized as vz
+            orig_sua = vz.VectorizedSampler.sample_until_n_accepted
+
+            def sua(self, *a, **kw):
+                self.record_proposal_density = True
+                self.record_rejected = True
+                return orig_sua(self, *a, **kw)
+            vz.VectorizedSampler.sample_until_n_accepted = sua
+            try:
+                h = abc.run(max_nr_populations=3)
+            finally:
+                vz.VectorizedSampler.sample_until_n_accepted = orig_sua
+        else:
+            h = abc.run(max_nr_populations=3)
+        pop = h.get_population(h.max_t)
+        return (np.asarray(pop.m), np.asarray(pop.theta),
+                np.asarray(pop.weight))
+
+    m_e, th_e, w_e = run(eager=True)
+    m_d, th_d, w_d = run(eager=False)
+    # same seed -> identical particle sets; weights agree to f32 tolerance
+    # (the KDE runs at different batch shapes on the two paths)
+    np.testing.assert_array_equal(m_e, m_d)
+    np.testing.assert_allclose(th_e, th_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w_e, w_d, rtol=2e-4, atol=1e-7)

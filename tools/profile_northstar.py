@@ -1,0 +1,170 @@
+"""Profile the north-star (pop 1e6) generation: component shares.
+
+Run on the real TPU:  python tools/profile_northstar.py
+"""
+import json
+import time
+
+import numpy as np
+
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/pyabc_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+
+
+def _sync(out):
+    """block_until_ready doesn't actually block through the axon relay;
+    force completion with a scalar reduce + host fetch (~0.2 s constant)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    return float(sum(jnp.sum(jnp.asarray(l, jnp.float32).ravel()[:1])
+                     for l in leaves))
+
+
+def timed(fn, *args, n=3, **kw):
+    _sync(fn(*args, **kw))
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        _sync(fn(*args, **kw))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), None
+
+
+def main():
+    res = {}
+    B = 1 << 19
+    N = 1 << 20
+    d = 1
+    key = jax.random.PRNGKey(0)
+
+    # --- KDE logpdf at north-star shape, XLA vs Pallas -------------------
+    from pyabc_tpu.ops.kde import weighted_kde_logpdf
+    from pyabc_tpu.ops.kde_pallas import (pallas_available,
+                                          weighted_kde_logpdf_pallas)
+    support = jax.random.normal(key, (N, d), dtype=jnp.float32)
+    log_w = jnp.full((N,), -float(np.log(N)), jnp.float32)
+    chol = jnp.eye(d, dtype=jnp.float32) * 0.1
+    log_norm = jnp.asarray(-d / 2 * np.log(2 * np.pi) - d * np.log(0.1),
+                           jnp.float32)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (B, d), jnp.float32)
+    t, _ = timed(weighted_kde_logpdf, x, support, log_w, chol, log_norm)
+    res["kde_xla_B19_N20_s"] = round(t, 3)
+    res["kde_xla_B19_N20_gpairs"] = round(B * N / t / 1e9, 1)
+    if pallas_available():
+        t, _ = timed(weighted_kde_logpdf_pallas, x, support, log_w, chol,
+                     log_norm)
+        res["kde_pallas_B19_N20_s"] = round(t, 3)
+        res["kde_pallas_B19_N20_gpairs"] = round(B * N / t / 1e9, 1)
+    # half-support (what per-model pow2 bucketing would give at p~0.5)
+    for NB, tag in ((1 << 19, "N19"), (1 << 18, "N18")):
+        t, _ = timed(weighted_kde_logpdf, x, support[:NB], log_w[:NB], chol,
+                     log_norm)
+        res[f"kde_xla_B19_{tag}_s"] = round(t, 3)
+
+    # --- weighted choice at round shape ----------------------------------
+    from pyabc_tpu.ops import fast_weighted_choice
+    t, _ = timed(fast_weighted_choice, key, log_w, B)
+    res["choice_B19_N20_s"] = round(t, 4)
+
+    # --- device->host transfer of the finalize payload --------------------
+    # device-COMPUTED arrays (host-created zeros may be served from a
+    # client-side cache without a real transfer)
+    n_target = 1_000_000
+    kk = jax.random.split(key, 6)
+    payload = {
+        "m": jax.random.randint(kk[0], (n_target,), 0, 2),
+        "theta": jax.random.normal(kk[1], (n_target, 1), jnp.float32),
+        "distance": jax.random.normal(kk[2], (n_target,), jnp.float32),
+        "log_weight": jax.random.normal(kk[3], (n_target,), jnp.float32),
+        "stats": jax.random.normal(kk[4], (n_target, 1), jnp.float32),
+        "accepted_mask": jax.random.normal(kk[5], (n_target,)) > 0,
+        "count": jnp.int32(0),
+        "rounds": jnp.int32(0),
+    }
+    _sync(payload)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(payload)
+        ts.append(time.perf_counter() - t0)
+    res["finalize_fetch_s"] = round(float(np.median(ts)), 3)
+
+    # --- full abc generation, instrumented --------------------------------
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.sampler import base as sampler_base
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=n_target,
+        eps=pt.ConstantEpsilon(0.2),
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                     max_rounds_per_call=2),
+        seed=0)
+    abc.new("sqlite://", observed)
+
+    marks = []
+    orig_adb = sampler_base.Sample.append_device_batch
+
+    def patched_adb(self, out, n_evals):
+        t0 = time.perf_counter()
+        r = orig_adb(self, out, n_evals)
+        marks.append(("append_device_batch", time.perf_counter() - t0))
+        return r
+
+    sampler_base.Sample.append_device_batch = patched_adb
+
+    t0 = time.perf_counter()
+    abc.run(max_nr_populations=2)   # warmup: calibration + prior + 1 kde gen
+    res["warmup_2gen_s"] = round(time.perf_counter() - t0, 2)
+    marks.clear()
+    t0 = time.perf_counter()
+    abc.run(max_nr_populations=1)
+    res["gen_total_s"] = round(time.perf_counter() - t0, 2)
+    res["marks"] = [(k, round(v, 3)) for k, v in marks]
+
+    # separately: sampling-only time for one more generation (sampler call
+    # vs the rest of the generation loop)
+    import pyabc_tpu.smc as smc_mod
+    orig_sua = type(abc.sampler).sample_until_n_accepted
+    tmarks = {}
+
+    def patched_sua(self, *a, **kw):
+        t0 = time.perf_counter()
+        r = orig_sua(self, *a, **kw)
+        tmarks["sample_until_n_accepted_s"] = round(
+            time.perf_counter() - t0, 2)
+        return r
+
+    type(abc.sampler).sample_until_n_accepted = patched_sua
+
+    # every wait in the sampler loop funnels through jax.device_get
+    # (dispatch is async): time each call to decompose compute vs transfer
+    get_marks = []
+    orig_get = jax.device_get
+
+    def timed_get(x):
+        t0 = time.perf_counter()
+        r = orig_get(x)
+        leaves = jax.tree_util.tree_leaves(r)
+        nbytes = sum(getattr(l, "nbytes", 8) for l in leaves)
+        get_marks.append((nbytes, round(time.perf_counter() - t0, 3)))
+        return r
+
+    jax.device_get = timed_get
+    t0 = time.perf_counter()
+    abc.run(max_nr_populations=1)
+    jax.device_get = orig_get
+    res["gen2_total_s"] = round(time.perf_counter() - t0, 2)
+    res.update(tmarks)
+    res["gen2_nonsampling_s"] = round(
+        res["gen2_total_s"] - tmarks.get("sample_until_n_accepted_s", 0), 2)
+    res["device_get_marks"] = get_marks
+
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()
