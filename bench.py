@@ -32,6 +32,14 @@ is one-off).
   multi-host deployment of the same program)
 - ``petab_ode_pop100k_*``  — config #5, PEtab ODE + StochasticAcceptor
   (exact-likelihood triple), pop 1e5
+- ``sharded_mesh1_*``      — ShardedSampler on the real chip's 1-device
+  mesh (shard_map overhead vs the primary row must be ~0)
+- ``sharded_cpu8_*``       — the same sharded program on an 8-device
+  virtual CPU mesh (collective data-plane correctness timing)
+
+Every row times 3 generations individually and reports the MEDIAN, with
+the per-generation list alongside (``*_gen_times_s``) so run-to-run
+spread is visible in the captured JSON.
 """
 
 from __future__ import annotations
@@ -68,15 +76,33 @@ def _enable_compilation_cache():
         pass
 
 
-def _timed_generations(abc, pop, warmup, timed):
-    """(rate, wallclock_per_gen) over `timed` steady-state generations."""
-    abc.run(max_nr_populations=warmup)
-    t0 = time.perf_counter()
-    h = abc.run(max_nr_populations=timed)
-    elapsed = time.perf_counter() - t0
-    pops = h.get_all_populations()
-    n_timed = len(pops[pops.t >= warmup])
-    return pop * n_timed / elapsed, elapsed / max(n_timed, 1)
+def _timed_generations(abc, pop, warmup, timed=3):
+    """(median rate, median s/gen, per-gen times) over ``timed``
+    individually-timed steady-state generations.
+
+    Each generation is timed on its own and the MEDIAN is reported, so a
+    one-off infrastructure hiccup (a compile billed by an empty cache, a
+    slow relay transaction) cannot define the row — the round-2 LV row
+    swung 2.6x between otherwise-identical runs for exactly that reason.
+    The per-generation list rides along so the spread is visible in the
+    captured JSON.
+    """
+    import pandas as pd
+
+    # ONE run() call for warmup + timed generations: a second run() call
+    # would bill its startup (DB re-fit of the transitions) to the first
+    # timed generation.  Per-generation durations come from the stored
+    # population_end_time stamps.
+    abc.run(max_nr_populations=warmup + timed)
+    pops = abc.history.get_all_populations().sort_values("t")
+    ends = pd.to_datetime(pops.population_end_time)
+    dur = ends.diff().dt.total_seconds()
+    times = dur[np.asarray(pops.t) >= warmup].tolist()
+    if not times:
+        raise RuntimeError("no timed generations completed "
+                           "(run stopped during warmup)")
+    med = float(np.median(times))
+    return pop / med, med, [round(t, 2) for t in times]
 
 
 def bench_primary():
@@ -91,9 +117,9 @@ def bench_primary():
         sampler=pt.VectorizedSampler(max_batch_size=1 << 20),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, _ = _timed_generations(
+    rate, _, times = _timed_generations(
         abc, POP, WARMUP_GENERATIONS, TIMED_GENERATIONS)
-    return rate
+    return rate, times
 
 
 def bench_northstar():
@@ -115,9 +141,10 @@ def bench_northstar():
         seed=0)
     abc.new("sqlite://", observed)
     # warmup = calibration + prior gen + one full KDE generation (compiles)
-    rate, s_per_gen = _timed_generations(abc, NORTHSTAR_POP, 2, 1)
+    rate, s_per_gen, times = _timed_generations(abc, NORTHSTAR_POP, 2, 3)
     return {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
-            "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2)}
+            "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2),
+            "northstar_pop1e6_gen_times_s": times}
 
 
 def bench_kde_1e6():
@@ -141,13 +168,17 @@ def bench_kde_1e6():
                           dtype=jnp.float32)
     # compile
     float(jnp.sum(weighted_kde_logpdf(x, support, log_w, chol, log_norm)))
-    t0 = time.perf_counter()
-    s = float(jnp.sum(weighted_kde_logpdf(x, support, log_w, chol,
-                                          log_norm)))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(s)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = float(jnp.sum(weighted_kde_logpdf(x, support, log_w, chol,
+                                              log_norm)))
+        ts.append(time.perf_counter() - t0)
+        assert np.isfinite(s)
+    dt = float(np.median(ts))
     return {"kde_1e6x1e6_logpdf_s": round(dt, 2),
-            "kde_1e6x1e6_gpairs_per_sec": round(n * n / dt / 1e9, 1)}
+            "kde_1e6x1e6_gpairs_per_sec": round(n * n / dt / 1e9, 1),
+            "kde_1e6x1e6_times_s": [round(t, 2) for t in ts]}
 
 
 def _bench_problem(make_problem, pop, prefix):
@@ -165,13 +196,42 @@ def _bench_problem(make_problem, pop, prefix):
                                      max_batch_size=1 << 19),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, s_per_gen = _timed_generations(abc, pop, 2, 1)
+    rate, s_per_gen, times = _timed_generations(abc, pop, 2, 3)
     return {f"{prefix}_accepted_per_sec": round(rate, 1),
-            f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 2)}
+            f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 2),
+            f"{prefix}_gen_times_s": times}
 
 
 SUB_BENCHES = ("kde_1e6", "northstar", "lotka_volterra", "sir",
-               "petab_ode")
+               "petab_ode", "sharded_mesh1", "sharded_cpu8")
+
+
+def bench_sharded(pop: int, prefix: str) -> dict:
+    """ShardedSampler on whatever mesh the current platform exposes —
+    mesh=1 on the real chip (shard_map overhead vs VectorizedSampler must
+    be ~0), 8 virtual devices when run under the CPU-mesh env (collective
+    data-plane timing; see main()'s env override for 'sharded_cpu8')."""
+    import jax
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.parallel.mesh import make_mesh
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=pop,
+        eps=pt.ConstantEpsilon(0.2),
+        sampler=pt.ShardedSampler(mesh=make_mesh(),
+                                  max_batch_size=1 << 20),
+        seed=0)
+    abc.new("sqlite://", observed)
+    rate, s_per_gen, times = _timed_generations(
+        abc, pop, WARMUP_GENERATIONS, 3)
+    return {f"{prefix}_accepted_per_sec": round(rate, 1),
+            f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 3),
+            f"{prefix}_gen_times_s": times,
+            f"{prefix}_n_devices": len(jax.devices())}
 
 
 def _run_sub(name: str) -> dict:
@@ -186,6 +246,10 @@ def _run_sub(name: str) -> dict:
                               f"sir_pop{SIR_POP // 1000}k")
     if name == "petab_ode":
         return bench_petab_ode()
+    if name == "sharded_mesh1":
+        return bench_sharded(POP, "sharded_mesh1")
+    if name == "sharded_cpu8":
+        return bench_sharded(POP, "sharded_cpu8")
     raise ValueError(name)
 
 
@@ -194,7 +258,8 @@ def main():
     _enable_compilation_cache()
 
     _log("bench: primary (pop16384 gaussian mixture)")
-    rate = bench_primary()
+    rate, primary_times = bench_primary()
+    extra["primary_gen_times_s"] = primary_times
 
     # each sub-bench runs in its OWN process: a TPU-runtime crash in one
     # (e.g. a watchdog kill) must not poison the others or the primary line
@@ -203,10 +268,19 @@ def main():
     for name in SUB_BENCHES:
         _log(f"bench: {name}")
         t0 = time.perf_counter()
+        env = os.environ.copy()
+        if name == "sharded_cpu8":
+            # the sharded data plane on an 8-device VIRTUAL mesh: same
+            # program the driver's multichip dryrun compiles, with a
+            # timing on the collective path (CPU-hosted, so the number
+            # is a correctness-plane figure, not a TPU rate)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=8")
         try:
             proc = subprocess.run(
                 [sys.executable, here, "--sub", name],
-                capture_output=True, text=True, timeout=1800)
+                capture_output=True, text=True, timeout=1800, env=env)
             if proc.returncode == 0:
                 extra.update(json.loads(proc.stdout.strip().splitlines()[-1]))
                 _log(f"bench: {name} done in "
@@ -280,9 +354,10 @@ def bench_petab_ode():
                                      max_batch_size=1 << 18),
         seed=0)
     abc.new("sqlite://", importer.get_observed())
-    rate, s_per_gen = _timed_generations(abc, PETAB_POP, 2, 1)
+    rate, s_per_gen, times = _timed_generations(abc, PETAB_POP, 2, 3)
     return {"petab_ode_pop100k_accepted_per_sec": round(rate, 1),
-            "petab_ode_pop100k_wallclock_s_per_gen": round(s_per_gen, 2)}
+            "petab_ode_pop100k_wallclock_s_per_gen": round(s_per_gen, 2),
+            "petab_ode_pop100k_gen_times_s": times}
 
 
 def _lv_problem():
@@ -297,6 +372,14 @@ def _sir_problem():
 
 if __name__ == "__main__":
     if len(sys.argv) == 3 and sys.argv[1] == "--sub":
+        if sys.argv[2] == "sharded_cpu8":
+            # the TPU plugin's sitecustomize pins JAX_PLATFORMS at
+            # interpreter start, so the parent's env override is not
+            # enough — force the cpu backend through jax.config too
+            # (same workaround as tests/conftest.py)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
         _enable_compilation_cache()
         print(json.dumps(_run_sub(sys.argv[2])))
     else:

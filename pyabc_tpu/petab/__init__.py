@@ -2,5 +2,9 @@
 
 from .base import PetabImporter
 from .ode import LikelihoodODEModel, ODEPetabImporter
+from .problem import PetabProblem, PetabSBMLModel, SBMLPetabImporter
+from .sbml import SBMLModel, parse_sbml
 
-__all__ = ["PetabImporter", "ODEPetabImporter", "LikelihoodODEModel"]
+__all__ = ["PetabImporter", "ODEPetabImporter", "LikelihoodODEModel",
+           "PetabProblem", "PetabSBMLModel", "SBMLPetabImporter",
+           "SBMLModel", "parse_sbml"]

@@ -1,0 +1,382 @@
+"""PEtab problem-directory ingestion: YAML + tables + SBML -> runnable model.
+
+Reference parity: ``AmiciPetabImporter`` (pyabc/petab/amici.py:26-170)
+takes a ``petab.Problem`` and produces model/prior/kernel with zero user
+code — the SBML model is compiled by AMICI (:72-116) and simulations
+return the measurement log-likelihood as the single summary statistic.
+
+Here the same contract is met TPU-natively: the SBML subset parser
+(petab/sbml.py) builds a batched JAX RHS, the whole population integrates
+in one fixed-step RK4 ``lax.scan``, observables are evaluated from the
+trajectory via the PEtab observable formulas, and the measurement
+log-likelihood (normal/laplace noise, lin/log/log10 transformations) is a
+fused reduction.  ``ODEPetabImporter`` (petab/ode.py) remains the manual
+escape hatch for models outside the SBML subset.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..distance.kernel import SCALE_LOG, SimpleFunctionKernel
+from ..model import Model
+from .base import LIN, LOG, LOG10, PetabImporter
+from .ode import LLH
+from .sbml import ExprError, SBMLModel, eval_expr, expr_names, parse_sbml
+
+Array = jnp.ndarray
+
+
+def _read_table(path: str):
+    import pandas as pd
+    sep = "\t" if path.endswith((".tsv", ".txt")) else ","
+    return pd.read_csv(path, sep=sep)
+
+
+class PetabProblem:
+    """A loaded PEtab problem: tables + parsed SBML model.
+
+    ``from_yaml`` reads the standard PEtab YAML layout; the constructor
+    also accepts in-memory DataFrames + an :class:`SBMLModel` (or SBML
+    XML string) for programmatic use.
+    """
+
+    def __init__(self, sbml_model, parameter_df, observable_df,
+                 measurement_df, condition_df=None):
+        if isinstance(sbml_model, str):
+            sbml_model = parse_sbml(sbml_model)
+        self.model: SBMLModel = sbml_model
+        self.parameter_df = parameter_df.set_index("parameterId") \
+            if "parameterId" in parameter_df.columns else parameter_df
+        self.observable_df = observable_df.set_index("observableId") \
+            if "observableId" in observable_df.columns else observable_df
+        self.measurement_df = measurement_df
+        self.condition_df = condition_df
+        if condition_df is not None and "conditionId" in condition_df.columns:
+            self.condition_df = condition_df.set_index("conditionId")
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "PetabProblem":
+        import yaml
+        with open(path) as f:
+            spec = yaml.safe_load(f)
+        base = os.path.dirname(os.path.abspath(path))
+
+        def resolve(name):
+            return os.path.join(base, name)
+
+        import pandas as pd
+        prob = spec["problems"][0]
+        parameter_file = spec.get("parameter_file") or prob.get(
+            "parameter_file")
+        parameter_df = _read_table(resolve(parameter_file))
+        sbml_files = prob.get("sbml_files") or [prob["sbml_file"]]
+        sbml_model = parse_sbml(resolve(sbml_files[0]))
+        observable_df = pd.concat(
+            [_read_table(resolve(f)) for f in prob["observable_files"]])
+        measurement_df = pd.concat(
+            [_read_table(resolve(f)) for f in prob["measurement_files"]])
+        condition_df = None
+        if prob.get("condition_files"):
+            condition_df = pd.concat(
+                [_read_table(resolve(f)) for f in prob["condition_files"]])
+        return cls(sbml_model, parameter_df, observable_df, measurement_df,
+                   condition_df)
+
+    def estimated_ids(self) -> List[str]:
+        df = self.parameter_df
+        est = df[df.get("estimate", 1).astype(int) == 1] \
+            if "estimate" in df.columns else df
+        return [str(i) for i in est.index]
+
+    def parameter_scales(self) -> Dict[str, str]:
+        df = self.parameter_df
+        if "parameterScale" not in df.columns:
+            return {str(i): LIN for i in df.index}
+        return {str(i): str(s) for i, s in df["parameterScale"].items()}
+
+    def nominal_values(self) -> Dict[str, float]:
+        df = self.parameter_df
+        if "nominalValue" not in df.columns:
+            return {}
+        return {str(i): float(v) for i, v in df["nominalValue"].items()
+                if np.isfinite(v)}
+
+
+def _unscale(value, scale: str):
+    if scale == LOG:
+        return jnp.exp(value)
+    if scale == LOG10:
+        return 10.0**value
+    return value
+
+
+class PetabSBMLModel(Model):
+    """Batched RK4 simulation of a PEtab problem returning ``{'llh': [N]}``
+    (reference amici.py:117-147: AMICI returns the problem llh per
+    parameter vector; here the whole population integrates at once).
+
+    One integration per simulation condition (conditions are few; the
+    population axis is the batch).  Measurement times are read off the
+    trajectory by linear interpolation, so arbitrary PEtab time points
+    need no grid alignment.
+    """
+
+    def __init__(self, problem: PetabProblem, n_steps: int = 200,
+                 name: str = "petab_sbml"):
+        super().__init__(name)
+        self.problem = problem
+        self.n_steps = int(n_steps)
+        self._rhs = problem.model.make_rhs()
+        self._state_ids = problem.model.state_ids()
+        self._scales = problem.parameter_scales()
+        self._estimated = problem.estimated_ids()
+        self._nominal = problem.nominal_values()
+        self._conditions = self._group_measurements()
+        self._t_max = max(
+            (float(row["time"]) for _, _, rows in self._conditions
+             for row in rows),
+            default=1.0) or 1.0
+
+    # ---- measurement bookkeeping ---------------------------------------
+
+    def _group_measurements(self):
+        """[(condition_id, overrides, rows)] with rows =
+        [{observableId, time, measurement, noise_override}]."""
+        mdf = self.problem.measurement_df
+        groups = []
+        cond_ids = (mdf["simulationConditionId"].unique()
+                    if "simulationConditionId" in mdf.columns else [None])
+        for cid in cond_ids:
+            sel = mdf if cid is None else mdf[
+                mdf["simulationConditionId"] == cid]
+            overrides = {}
+            if cid is not None and self.problem.condition_df is not None \
+                    and cid in self.problem.condition_df.index:
+                row = self.problem.condition_df.loc[cid]
+                for col, val in row.items():
+                    if col in ("conditionName",):
+                        continue
+                    if isinstance(val, float) and np.isnan(val):
+                        continue
+                    overrides[str(col)] = val
+            rows = []
+            for _, r in sel.iterrows():
+                rows.append({
+                    "observableId": str(r["observableId"]),
+                    "time": float(r["time"]),
+                    "measurement": float(r["measurement"]),
+                    "noiseParameters": r.get("noiseParameters"),
+                    "observableParameters": r.get("observableParameters"),
+                })
+            groups.append((cid, overrides, rows))
+        return groups
+
+    # ---- simulation -----------------------------------------------------
+
+    def _theta_env(self, theta: Array) -> Dict[str, Array]:
+        """Estimated parameters (unscaled, [N]) + fixed nominals.
+
+        Only theta needs unscaling: estimated parameters travel on the
+        objective (parameterScale) scale, while the table's nominalValue
+        column is ALWAYS linear-scale per the PEtab spec."""
+        env = {}
+        for pid, val in self._nominal.items():
+            if pid not in self._estimated:
+                env[pid] = val
+        for j, pid in enumerate(self._estimated):
+            env[pid] = _unscale(theta[:, j], self._scales.get(pid, LIN))
+        return env
+
+    def _resolve_override(self, val, env, n):
+        """A condition-table cell: numeric, or a parameter/entity name."""
+        try:
+            return jnp.full((n,), float(val))
+        except (TypeError, ValueError):
+            pass
+        name = str(val)
+        if name in env:
+            return jnp.broadcast_to(env[name], (n,))
+        base = self.problem.model.base_env()
+        if name in base:
+            return jnp.full((n,), float(base[name]))
+        raise ExprError(f"cannot resolve condition override {val!r}")
+
+    def _integrate(self, theta_env: Dict[str, Array],
+                   overrides: Dict[str, object], n: int):
+        """RK4 over the grid; returns (times [T+1], state [T+1, N, S])."""
+        from jax import lax
+
+        model = self.problem.model
+        dt = self._t_max / self.n_steps
+        y0_vals = model.y0()
+        y0_cols = []
+        for i, sid in enumerate(self._state_ids):
+            if sid in overrides:
+                y0_cols.append(self._resolve_override(
+                    overrides[sid], theta_env, n))
+            else:
+                y0_cols.append(jnp.full((n,), y0_vals[i]))
+        y = jnp.stack(y0_cols, axis=-1)
+        env = dict(theta_env)
+        for k, v in overrides.items():
+            if k not in self._state_ids:
+                env[k] = self._resolve_override(v, theta_env, n)
+
+        def step(carry, i):
+            y = carry
+            t = i * dt
+            k1 = self._rhs(y, env, t)
+            k2 = self._rhs(y + 0.5 * dt * k1, env, t + 0.5 * dt)
+            k3 = self._rhs(y + 0.5 * dt * k2, env, t + 0.5 * dt)
+            k4 = self._rhs(y + dt * k3, env, t + dt)
+            y = y + dt / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
+            return y, y
+
+        _, traj = lax.scan(step, y, jnp.arange(self.n_steps))
+        full = jnp.concatenate([y[None], traj], axis=0)   # [T+1, N, S]
+        times = np.linspace(0.0, self._t_max, self.n_steps + 1)
+        return times, full, env
+
+    def _observable_series(self, obs_id: str, times, full, env, row=None):
+        """Evaluate the observable formula over the trajectory -> [N, T+1].
+        ``observableParameter{n}_{obsId}`` placeholders resolve from the
+        measurement row's observableParameters column."""
+        odf = self.problem.observable_df
+        formula = str(odf.loc[obs_id, "observableFormula"])
+        # [N]-shaped parameter arrays get a trailing axis so formulas can
+        # mix them with [N, T+1] state series (e.g. 'scaling_par * A')
+        local = {k: (v[:, None] if getattr(v, "ndim", 0) == 1 else v)
+                 for k, v in env.items()}
+        for i, sid in enumerate(self._state_ids):
+            local[sid] = jnp.moveaxis(full[..., i], 0, -1)   # [N, T+1]
+        base = self.problem.model.base_env()
+        for k, v in base.items():
+            local.setdefault(k, v)
+        local = self.problem.model.resolve_assignments(local) \
+            if self.problem.model.assignment_rules else local
+        if row is not None:
+            local.update(self._placeholder_env(
+                "observableParameter", obs_id,
+                row.get("observableParameters")))
+        val = eval_expr(formula, local)
+        n = full.shape[1]
+        return jnp.broadcast_to(val, (n, full.shape[0]))
+
+    @staticmethod
+    def _placeholder_env(prefix: str, obs_id: str, cell) -> Dict[str, float]:
+        if cell is None or (isinstance(cell, float) and np.isnan(cell)):
+            return {}
+        parts = str(cell).split(";")
+        return {f"{prefix}{i + 1}_{obs_id}": float(p)
+                for i, p in enumerate(parts)}
+
+    def _noise_value(self, obs_id: str, env, row):
+        odf = self.problem.observable_df
+        formula = odf.loc[obs_id].get("noiseFormula", 1.0)
+        local = dict(env)
+        base = self.problem.model.base_env()
+        for k, v in base.items():
+            local.setdefault(k, v)
+        local.update(self._placeholder_env(
+            "noiseParameter", obs_id, row.get("noiseParameters")))
+        return eval_expr(str(formula), local)
+
+    def sample(self, key, theta: Array) -> Dict[str, Array]:
+        n = theta.shape[0]
+        env = self._theta_env(theta)
+        llh = jnp.zeros((n,))
+        odf = self.problem.observable_df
+        for cid, overrides, rows in self._conditions:
+            times, full, cenv = self._integrate(env, overrides, n)
+            dt = times[1] - times[0] if len(times) > 1 else 1.0
+            series_cache: Dict[str, Array] = {}
+            for row in rows:
+                oid = row["observableId"]
+                has_op = row.get("observableParameters") is not None and \
+                    not (isinstance(row.get("observableParameters"), float)
+                         and np.isnan(row.get("observableParameters")))
+                if oid in series_cache and not has_op:
+                    series = series_cache[oid]
+                else:
+                    series = self._observable_series(
+                        oid, times, full, cenv, row)
+                    if not has_op:
+                        series_cache[oid] = series
+                # linear interpolation at the measurement time
+                pos = row["time"] / dt
+                i0 = int(np.clip(np.floor(pos), 0, len(times) - 2))
+                frac = float(pos - i0)
+                y_sim = series[:, i0] * (1 - frac) + series[:, i0 + 1] * frac
+                sigma = self._noise_value(oid, cenv, row)
+                sigma = jnp.broadcast_to(jnp.asarray(sigma, jnp.float32),
+                                         (n,))
+                m = row["measurement"]
+                trans = LIN
+                if "observableTransformation" in odf.columns:
+                    tcell = odf.loc[oid, "observableTransformation"]
+                    if isinstance(tcell, str):
+                        trans = tcell
+                dist = "normal"
+                if "noiseDistribution" in odf.columns:
+                    dcell = odf.loc[oid, "noiseDistribution"]
+                    if isinstance(dcell, str):
+                        dist = dcell
+                if trans == LOG:
+                    resid = jnp.log(m) - jnp.log(y_sim)
+                    jac = -np.log(m)
+                elif trans == LOG10:
+                    resid = np.log10(m) - jnp.log10(y_sim)
+                    jac = -np.log(m * np.log(10.0))
+                else:
+                    resid = m - y_sim
+                    jac = 0.0
+                if dist == "laplace":
+                    llh = llh + (-jnp.abs(resid) / sigma
+                                 - jnp.log(2 * sigma) + jac)
+                else:
+                    llh = llh + (-0.5 * (resid / sigma) ** 2
+                                 - 0.5 * jnp.log(2 * jnp.pi * sigma**2)
+                                 + jac)
+        return {LLH: llh}
+
+
+class SBMLPetabImporter(PetabImporter):
+    """Zero-code PEtab import (reference AmiciPetabImporter parity,
+    amici.py:26-170): point it at a PEtab YAML (or a built
+    :class:`PetabProblem`) and get prior + model + kernel.
+
+    >>> importer = SBMLPetabImporter.from_yaml("problem.yaml")
+    >>> abc = ABCSMC(importer.create_model(), importer.create_prior(),
+    ...              importer.create_kernel(), eps=Temperature(),
+    ...              acceptor=StochasticAcceptor())
+    >>> abc.new("sqlite://", importer.get_observed())
+    """
+
+    def __init__(self, problem: PetabProblem, n_steps: int = 200):
+        super().__init__(problem.parameter_df)
+        self.petab_problem = problem
+        self.n_steps = int(n_steps)
+
+    @classmethod
+    def from_yaml(cls, path: str, n_steps: int = 200) -> "SBMLPetabImporter":
+        return cls(PetabProblem.from_yaml(path), n_steps=n_steps)
+
+    def create_model(self) -> PetabSBMLModel:
+        return PetabSBMLModel(self.petab_problem, n_steps=self.n_steps)
+
+    def create_kernel(self) -> SimpleFunctionKernel:
+        """Kernel reading the model-computed log-likelihood back
+        (reference amici.py:151-170)."""
+        return SimpleFunctionKernel(
+            lambda x, x_0: jnp.reshape(x[LLH], (-1,)),
+            ret_scale=SCALE_LOG)
+
+    def get_observed(self) -> Dict[str, float]:
+        """Observed-stat placeholder: the data lives in the measurement
+        table (same convention as ODEPetabImporter.get_observed)."""
+        return {LLH: 0.0}

@@ -1,0 +1,333 @@
+"""PEtab problem-directory ingestion: SBML subset parser, expression
+compiler, and the zero-code importer (parity: reference
+AmiciPetabImporter, pyabc/petab/amici.py:26-170 — a petab problem in,
+runnable model/prior/kernel out, no user model code)."""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.petab import (PetabProblem, SBMLPetabImporter, parse_sbml)
+from pyabc_tpu.petab.sbml import (ExprError, eval_expr, expr_names,
+                                  mathml_to_infix)
+
+SBML_DECAY = textwrap.dedent("""\
+    <?xml version="1.0" encoding="UTF-8"?>
+    <sbml xmlns="http://www.sbml.org/sbml/level3/version2/core"
+          level="3" version="2">
+      <model id="decay">
+        <listOfCompartments>
+          <compartment id="cell" size="1" constant="true"/>
+        </listOfCompartments>
+        <listOfSpecies>
+          <species id="A" compartment="cell" initialConcentration="1"
+                   boundaryCondition="false" constant="false"/>
+        </listOfSpecies>
+        <listOfParameters>
+          <parameter id="k1" value="0.7" constant="true"/>
+        </listOfParameters>
+        <listOfReactions>
+          <reaction id="degrade" reversible="false">
+            <listOfReactants>
+              <speciesReference species="A" stoichiometry="1"/>
+            </listOfReactants>
+            <kineticLaw>
+              <math xmlns="http://www.w3.org/1998/Math/MathML">
+                <apply><times/><ci>k1</ci><ci>A</ci></apply>
+              </math>
+            </kineticLaw>
+          </reaction>
+        </listOfReactions>
+      </model>
+    </sbml>
+""")
+
+SBML_RATE_RULE = textwrap.dedent("""\
+    <?xml version="1.0" encoding="UTF-8"?>
+    <sbml xmlns="http://www.sbml.org/sbml/level3/version2/core"
+          level="3" version="2">
+      <model id="raterule">
+        <listOfCompartments>
+          <compartment id="c" size="1" constant="true"/>
+        </listOfCompartments>
+        <listOfSpecies>
+          <species id="x" compartment="c" initialConcentration="2"
+                   constant="false"/>
+        </listOfSpecies>
+        <listOfParameters>
+          <parameter id="k" value="0.5" constant="true"/>
+          <parameter id="x_scaled" value="0" constant="false"/>
+        </listOfParameters>
+        <listOfRules>
+          <rateRule variable="x">
+            <math xmlns="http://www.w3.org/1998/Math/MathML">
+              <apply><minus/>
+                <apply><times/><ci>k</ci><ci>x</ci></apply>
+              </apply>
+            </math>
+          </rateRule>
+          <assignmentRule variable="x_scaled">
+            <math xmlns="http://www.w3.org/1998/Math/MathML">
+              <apply><times/><cn>2.0</cn><ci>x</ci></apply>
+            </math>
+          </assignmentRule>
+        </listOfRules>
+      </model>
+    </sbml>
+""")
+
+
+# ---------------------------------------------------------------------------
+# expression compiler
+# ---------------------------------------------------------------------------
+
+def test_eval_expr_arrays():
+    env = {"a": jnp.asarray([1.0, 2.0]), "b": 3.0}
+    out = eval_expr("a * b + exp(0) - a^2", env)
+    np.testing.assert_allclose(np.asarray(out), [3.0, 3.0])
+
+
+def test_expr_names():
+    assert expr_names("k1 * A + exp(offset)") == {"k1", "A", "offset"}
+
+
+@pytest.mark.parametrize("bad", [
+    "__import__('os').system('true')",
+    "a.b", "[1,2]", "lambda: 1", "f'{x}'", "open('x')",
+])
+def test_eval_expr_rejects_non_math(bad):
+    with pytest.raises(ExprError):
+        eval_expr(bad, {})
+
+
+def test_eval_expr_unknown_symbol():
+    with pytest.raises(ExprError, match="unknown symbol"):
+        eval_expr("k_missing * 2", {})
+
+
+def test_mathml_e_notation_and_log():
+    import xml.etree.ElementTree as ET
+    m = ET.fromstring(
+        '<math xmlns="http://www.w3.org/1998/Math/MathML">'
+        '<apply><times/><cn type="e-notation">1.5<sep/>-2</cn>'
+        '<apply><ln/><ci>x</ci></apply></apply></math>')
+    s = mathml_to_infix(m)
+    assert abs(eval_expr(s, {"x": float(np.e)}) - 0.015) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# SBML parser + RHS
+# ---------------------------------------------------------------------------
+
+def test_parse_decay_model():
+    doc = parse_sbml(SBML_DECAY)
+    assert list(doc.species) == ["A"]
+    assert doc.parameters["k1"] == 0.7
+    assert doc.state_ids() == ["A"]
+    assert doc.y0() == [1.0]
+    rhs = doc.make_rhs()
+    y = jnp.asarray([[2.0], [4.0]])
+    dy = rhs(y, {"k1": jnp.asarray([0.5, 1.0])})
+    np.testing.assert_allclose(np.asarray(dy), [[-1.0], [-4.0]])
+
+
+def test_rate_rule_and_assignment():
+    doc = parse_sbml(SBML_RATE_RULE)
+    assert doc.state_ids() == ["x"]
+    assert "x_scaled" in doc.assignment_rules
+    rhs = doc.make_rhs()
+    dy = rhs(jnp.asarray([[2.0]]), {})
+    np.testing.assert_allclose(np.asarray(dy), [[-1.0]])
+    env = doc.resolve_assignments({**doc.base_env(), "x": 3.0})
+    assert env["x_scaled"] == 6.0
+
+
+def test_unsupported_constructs_raise():
+    bad = SBML_DECAY.replace(
+        "<listOfReactions>",
+        "<listOfEvents/><listOfReactions>")
+    with pytest.raises(ExprError, match="events"):
+        parse_sbml(bad)
+
+
+# ---------------------------------------------------------------------------
+# problem directory -> runnable model, ZERO hand-written model code
+# ---------------------------------------------------------------------------
+
+def _write_problem_dir(tmp_path, scale="lin"):
+    times = np.asarray([0.5, 1.0, 1.5, 2.0])
+    rng = np.random.default_rng(0)
+    data = np.exp(-0.7 * times) + 0.05 * rng.normal(size=times.shape)
+
+    (tmp_path / "model.xml").write_text(SBML_DECAY)
+    lo, hi = (0.01, 3.0)
+    if scale == "log10":
+        plo, phi = np.log10(lo), np.log10(hi)
+    else:
+        plo, phi = lo, hi
+    (tmp_path / "parameters.tsv").write_text(
+        "parameterId\tparameterScale\tlowerBound\tupperBound\testimate\t"
+        "objectivePriorType\tobjectivePriorParameters\n"
+        f"k1\t{scale}\t{lo}\t{hi}\t1\t"
+        + ("parameterScaleUniform" if scale == "log10" else "uniform")
+        + f"\t{plo};{phi}\n")
+    (tmp_path / "observables.tsv").write_text(
+        "observableId\tobservableFormula\tnoiseFormula\n"
+        "obs_a\tA\t0.05\n")
+    lines = ["observableId\tsimulationConditionId\ttime\tmeasurement"]
+    for t, m in zip(times, data):
+        lines.append(f"obs_a\tc0\t{t}\t{m}")
+    (tmp_path / "measurements.tsv").write_text("\n".join(lines) + "\n")
+    (tmp_path / "conditions.tsv").write_text("conditionId\nc0\n")
+    (tmp_path / "problem.yaml").write_text(textwrap.dedent("""\
+        format_version: 1
+        parameter_file: parameters.tsv
+        problems:
+          - sbml_files: [model.xml]
+            condition_files: [conditions.tsv]
+            observable_files: [observables.tsv]
+            measurement_files: [measurements.tsv]
+    """))
+    return tmp_path / "problem.yaml", data, times
+
+
+def test_from_yaml_llh(tmp_path):
+    yaml_path, data, times = _write_problem_dir(tmp_path)
+    importer = SBMLPetabImporter.from_yaml(str(yaml_path), n_steps=100)
+    prior = importer.create_prior()
+    assert prior.space.names == ("k1",)
+    model = importer.create_model()
+    theta = jnp.asarray([[0.7], [2.5]])
+    out = model.simulate(jax.random.PRNGKey(0), theta)
+    llh = np.asarray(out["llh"])
+    assert llh.shape == (2,)
+    # true-parameter llh beats a far-off parameter and matches the
+    # analytic solution's llh to integrator tolerance
+    analytic = np.exp(-0.7 * times)
+    ref_llh = float(np.sum(
+        -0.5 * ((data - analytic) / 0.05) ** 2
+        - 0.5 * np.log(2 * np.pi * 0.05**2)))
+    assert llh[0] > llh[1]
+    assert abs(llh[0] - ref_llh) < 0.05
+
+
+def test_log10_parameter_scale(tmp_path):
+    yaml_path, data, times = _write_problem_dir(tmp_path, scale="log10")
+    importer = SBMLPetabImporter.from_yaml(str(yaml_path), n_steps=100)
+    model = importer.create_model()
+    # theta on log10 scale: 10**(-0.1549) ~= 0.7
+    theta = jnp.asarray([[np.log10(0.7)]])
+    out = model.simulate(jax.random.PRNGKey(0), theta)
+    analytic = np.exp(-0.7 * times)
+    ref_llh = float(np.sum(
+        -0.5 * ((data - analytic) / 0.05) ** 2
+        - 0.5 * np.log(2 * np.pi * 0.05**2)))
+    assert abs(float(out["llh"][0]) - ref_llh) < 0.05
+
+
+def test_condition_override_initial(tmp_path):
+    yaml_path, _, _ = _write_problem_dir(tmp_path)
+    problem = PetabProblem.from_yaml(str(yaml_path))
+    import pandas as pd
+    problem.condition_df = pd.DataFrame(
+        {"conditionId": ["c0"], "A": [2.0]}).set_index("conditionId")
+    from pyabc_tpu.petab import PetabSBMLModel
+    model = PetabSBMLModel(problem, n_steps=100)
+    out = model.simulate(jax.random.PRNGKey(0), jnp.asarray([[0.7]]))
+    # doubling the initial concentration shifts the simulated series, so
+    # the llh must move away from the (un-overridden) fit
+    base_model = PetabSBMLModel(PetabProblem.from_yaml(str(yaml_path)),
+                                n_steps=100)
+    base = base_model.simulate(jax.random.PRNGKey(0), jnp.asarray([[0.7]]))
+    assert float(out["llh"][0]) < float(base["llh"][0])
+
+
+def test_e2e_abc_posterior(tmp_path):
+    """Zero-code end-to-end: PEtab dir -> ABCSMC -> posterior covers the
+    true rate (the VERDICT round-3 'done' criterion)."""
+    yaml_path, _, _ = _write_problem_dir(tmp_path)
+    importer = SBMLPetabImporter.from_yaml(str(yaml_path), n_steps=60)
+    abc = pt.ABCSMC(
+        models=importer.create_model(),
+        parameter_priors=importer.create_prior(),
+        distance_function=importer.create_kernel(),
+        population_size=300,
+        eps=pt.Temperature(),
+        acceptor=pt.StochasticAcceptor(),
+        sampler=pt.VectorizedSampler(),
+        seed=1)
+    abc.new("sqlite://", importer.get_observed())
+    h = abc.run(max_nr_populations=4)
+    pop = h.get_population(h.max_t)
+    theta = np.asarray(pop.theta)[:, 0]
+    w = np.asarray(pop.weight)
+    mean = float(np.sum(theta * w))
+    assert 0.4 < mean < 1.1, mean
+
+
+def test_mathml_logbase_and_root_degree():
+    import xml.etree.ElementTree as ET
+    m = ET.fromstring(
+        '<math xmlns="http://www.w3.org/1998/Math/MathML">'
+        '<apply><log/><logbase><cn>2</cn></logbase><ci>x</ci></apply>'
+        '</math>')
+    assert abs(eval_expr(mathml_to_infix(m), {"x": 8.0}) - 3.0) < 1e-6
+    m = ET.fromstring(
+        '<math xmlns="http://www.w3.org/1998/Math/MathML">'
+        '<apply><root/><degree><cn>3</cn></degree><ci>x</ci></apply>'
+        '</math>')
+    assert abs(eval_expr(mathml_to_infix(m), {"x": 27.0}) - 3.0) < 1e-5
+
+
+def test_local_kinetic_parameter_collision_raises():
+    bad = SBML_DECAY.replace(
+        "<kineticLaw>",
+        "<kineticLaw><listOfLocalParameters>"
+        '<localParameter id="k1" value="0.1"/>'
+        "</listOfLocalParameters>")
+    with pytest.raises(ExprError, match="collides"):
+        parse_sbml(bad)
+
+
+def test_estimated_parameter_in_observable_formula(tmp_path):
+    """The PEtab scaling-observable pattern: observableFormula references
+    an estimated parameter alongside a state series."""
+    yaml_path, data, times = _write_problem_dir(tmp_path)
+    (tmp_path / "parameters.tsv").write_text(
+        "parameterId\tparameterScale\tlowerBound\tupperBound\testimate\t"
+        "objectivePriorType\tobjectivePriorParameters\n"
+        "k1\tlin\t0.01\t3.0\t1\tuniform\t0.01;3.0\n"
+        "scale_a\tlin\t0.1\t10.0\t1\tuniform\t0.1;10.0\n")
+    (tmp_path / "observables.tsv").write_text(
+        "observableId\tobservableFormula\tnoiseFormula\n"
+        "obs_a\tscale_a * A\t0.05\n")
+    importer = SBMLPetabImporter.from_yaml(str(yaml_path), n_steps=100)
+    model = importer.create_model()
+    out = model.simulate(jax.random.PRNGKey(0),
+                         jnp.asarray([[0.7, 1.0], [0.7, 2.0]]))
+    llh = np.asarray(out["llh"])
+    assert np.isfinite(llh).all()
+    # scale 1.0 matches how the data was generated; scale 2.0 must not
+    assert llh[0] > llh[1]
+
+
+def test_fixed_parameter_nominal_is_linear_scale(tmp_path):
+    """nominalValue is linear-scale even when parameterScale is log10:
+    a fixed log10 parameter must NOT be exponentiated."""
+    yaml_path, data, times = _write_problem_dir(tmp_path)
+    (tmp_path / "parameters.tsv").write_text(
+        "parameterId\tparameterScale\tlowerBound\tupperBound\testimate\t"
+        "nominalValue\n"
+        "k1\tlog10\t0.01\t3.0\t0\t0.7\n")
+    importer = SBMLPetabImporter.from_yaml(str(yaml_path), n_steps=100)
+    model = importer.create_model()
+    out = model.simulate(jax.random.PRNGKey(0), jnp.zeros((1, 0)))
+    analytic = np.exp(-0.7 * times)
+    ref_llh = float(np.sum(
+        -0.5 * ((data - analytic) / 0.05) ** 2
+        - 0.5 * np.log(2 * np.pi * 0.05**2)))
+    assert abs(float(out["llh"][0]) - ref_llh) < 0.05
