@@ -278,6 +278,11 @@ class PetabSBMLModel(Model):
     def _noise_value(self, obs_id: str, env, row):
         odf = self.problem.observable_df
         formula = odf.loc[obs_id].get("noiseFormula", 1.0)
+        if formula is None or (isinstance(formula, float)
+                               and np.isnan(formula)):
+            # a blank noiseFormula cell reads as NaN — default sigma,
+            # like a missing column
+            formula = 1.0
         local = dict(env)
         base = self.problem.model.base_env()
         for k, v in base.items():

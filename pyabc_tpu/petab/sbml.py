@@ -304,22 +304,25 @@ class SBMLModel:
             # boundary species: state participates in rate laws but is
             # held by rules/constants if also assigned
             env = self.resolve_assignments(env)
+            def comp_size(sid):
+                # the compartment size must come from env, not the static
+                # document: condition-table overrides (or estimation) of
+                # a size would otherwise change kinetic-law symbols but
+                # not this stoichiometric division
+                return env.get(self.species[sid].compartment, 1.0)
+
             dydt = [jnp.zeros(y.shape[0]) for _ in state]
             for rxn in self.reactions:
                 rate = eval_expr(rxn.kinetic_law, env)
                 rate = jnp.broadcast_to(rate, (y.shape[0],))
                 for sid, stoich in rxn.reactants:
                     if sid in index and not self.species[sid].boundary:
-                        size = self.compartments.get(
-                            self.species[sid].compartment, 1.0)
                         dydt[index[sid]] = (dydt[index[sid]]
-                                            - stoich * rate / size)
+                                            - stoich * rate / comp_size(sid))
                 for sid, stoich in rxn.products:
                     if sid in index and not self.species[sid].boundary:
-                        size = self.compartments.get(
-                            self.species[sid].compartment, 1.0)
                         dydt[index[sid]] = (dydt[index[sid]]
-                                            + stoich * rate / size)
+                                            + stoich * rate / comp_size(sid))
             for target, formula in self.rate_rules.items():
                 val = eval_expr(formula, env)
                 dydt[index[target]] = jnp.broadcast_to(val, (y.shape[0],))
@@ -349,6 +352,7 @@ def parse_sbml(path_or_string: str) -> SBMLModel:
     melem = model_elems[0]
 
     doc = SBMLModel()
+    amount_species: List[str] = []
     for section in melem:
         tag = _local(section.tag)
         if tag in _UNSUPPORTED_LISTS:
@@ -360,8 +364,20 @@ def parse_sbml(path_or_string: str) -> SBMLModel:
                 doc.compartments[c.get("id")] = float(c.get("size", 1.0))
         elif tag == "listOfSpecies":
             for s in section:
-                init = s.get("initialConcentration",
-                             s.get("initialAmount", "0"))
+                init = s.get("initialConcentration")
+                if init is None:
+                    # amount units only coincide with concentration in a
+                    # unit compartment; anything else would silently
+                    # mis-simulate (the /size division assumes
+                    # concentrations) — checked after all sections parse
+                    init = s.get("initialAmount", "0")
+                    amount_species.append(s.get("id"))
+                if s.get("hasOnlySubstanceUnits") == "true":
+                    raise ExprError(
+                        f"species {s.get('id')!r} uses "
+                        "hasOnlySubstanceUnits, which the vendored subset "
+                        "parser does not support (concentration semantics "
+                        "only)")
                 doc.species[s.get("id")] = SBMLSpecies(
                     id=s.get("id"),
                     compartment=s.get("compartment", ""),
@@ -431,4 +447,12 @@ def parse_sbml(path_or_string: str) -> SBMLModel:
                 doc.reactions.append(SBMLReaction(
                     id=r.get("id"), reactants=reactants,
                     products=products, kinetic_law=law))
+    for sid in amount_species:
+        size = doc.compartments.get(doc.species[sid].compartment, 1.0)
+        if size != 1.0:
+            raise ExprError(
+                f"species {sid!r} declares initialAmount in a "
+                f"compartment of size {size} — amount/concentration "
+                "conversion is not supported by the vendored subset "
+                "parser (use initialConcentration or a unit compartment)")
     return doc

@@ -80,24 +80,39 @@ class AdaptivePopulationSize(PopulationStrategy):
 
     def update(self, transitions: List, model_weights, t=None,
                test_points_per_model: Optional[List] = None):
+        """Multi-size bootstrap + power-law inversion (reference
+        populationstrategy.py:203-222 via
+        transition/predict_population_size.py:11-60): estimate the KDE CV
+        at three population sizes around the current one, fit
+        ``cv(n) = a·n^b`` and invert at the target CV."""
+        from .transition.predict_population_size import \
+            predict_population_size
+
         if test_points_per_model is None:
             test_points_per_model = [tr.theta for tr in transitions]
-        self._key, sub = jax.random.split(self._key)
-        # bisection-free heuristic (reference uses predict_population_size
-        # via a power-law fit on per-size CV estimates)
         reference_nr = self.nr_particles
-        cv_now, _ = calc_cv(reference_nr, model_weights, transitions,
-                            self.n_bootstrap, test_points_per_model, key=sub)
-        if cv_now <= 0:
+        sizes = sorted({
+            int(max(reference_nr // 2, self.min_population_size, 8)),
+            int(reference_nr),
+            int(min(reference_nr * 2, self.max_population_size)),
+        })
+        cvs = {}
+        for nn in sizes:
+            self._key, sub = jax.random.split(self._key)
+            cv_n, _ = calc_cv(nn, model_weights, transitions,
+                              self.n_bootstrap, test_points_per_model,
+                              key=sub)
+            if cv_n > 0:
+                cvs[nn] = float(cv_n)
+        if not cvs:
             return
-        # cv ~ a n^(-1/2) heuristic scaling as a 1-point power-law inverse
-        n_req = int(reference_nr * (cv_now / self.mean_cv) ** 2)
-        n_req = int(np.clip(n_req, self.min_population_size,
-                            self.max_population_size))
+        n_req = predict_population_size(
+            cvs, self.mean_cv, min_size=self.min_population_size,
+            max_size=self.max_population_size)
         if self.quantize:
             n_req = 1 << int(np.ceil(np.log2(max(n_req, 2))))
             n_req = min(n_req, self.max_population_size)
-        self.nr_particles = n_req
+        self.nr_particles = int(n_req)
 
     def get_config(self):
         return {"name": type(self).__name__,

@@ -60,7 +60,8 @@ class RoundKernel:
                  acceptor: Acceptor,
                  spec: SumStatSpec,
                  obs_flat: Array,
-                 dim: int):
+                 dim: int,
+                 nr_samples_per_parameter: int = 1):
         self.models = list(models)
         self.priors = list(parameter_priors)
         self.model_prior_logits = jnp.asarray(model_prior_logits)
@@ -75,6 +76,12 @@ class RoundKernel:
         self.obs_flat = jnp.asarray(obs_flat)
         self.dim = int(dim)
         self.M = len(self.models)
+        #: simulations per parameter (reference smc.py:664-724): a
+        #: candidate is accepted when ANY replicate accepts; its weight
+        #: carries the accepted fraction and the product of accepted
+        #: acceptance weights (reference _create_weight_function,
+        #: smc.py:793-809)
+        self.K = int(nr_samples_per_parameter)
         # unique token for sampler jit caches: id() of a freed kernel can
         # be reused by a new one, which would serve a stale compiled round
         import itertools
@@ -112,6 +119,77 @@ class RoundKernel:
     def _eps_hint(self, acceptor_params: dict) -> Array:
         return acceptor_params.get("eps", jnp.float32(jnp.inf))
 
+    def _replicated_evaluate(self, ksim, kacc, theta: Array, m: Array,
+                             params: dict, all_accepted: bool = False):
+        """K-replicate simulate + distance + accept (reference
+        ``_evaluate_proposal``, smc.py:664-724).
+
+        Returns ``(stats, distance, accepted, log_acc_term)``:
+
+        - ``accepted``: ANY replicate accepted (reference smc.py:708),
+        - ``log_acc_term``: Σ_accepted log acc_w + log(n_accepted / K) —
+          the acceptance-weight product times the accepted fraction of
+          the reference weight function (smc.py:793-809),
+        - ``stats``/``distance``: mean over ACCEPTED replicates for
+          accepted candidates (the reference keeps the accepted list;
+          the fixed-shape equivalent is their mean), mean over all
+          replicates for rejected ones (feeding rejected-candidate
+          records, population.py:178-201 analog).
+
+        With ``K == 1`` this is literally the single-simulation pipeline.
+        """
+        eps = self._eps_hint(params.get("acceptor", {}))
+        if self.K == 1:
+            stats, early = self._simulate_all(ksim, theta, m, eps)
+            d = self.distance.compute(stats, self.obs_flat,
+                                      params["distance"])
+            if all_accepted:
+                # calibration accepts everything EXCEPT non-finite
+                # distances — a failed host simulation (NaN stats) must
+                # not poison eps.initialize's median (reference drops
+                # errored simulations too, redis_eps/cli.py:141-145)
+                return stats, d, jnp.isfinite(d), jnp.zeros(d.shape)
+            acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])
+            accepted = acc & ~early & jnp.isfinite(d)
+            return stats, d, accepted, jnp.log(jnp.maximum(acc_w, 1e-38))
+
+        B = theta.shape[0]
+        n_acc = jnp.zeros((B,), jnp.int32)
+        d_acc = jnp.zeros((B,))
+        d_all = jnp.zeros((B,))
+        s_acc = jnp.zeros((B, self.spec.total_size), dtype=jnp.float32)
+        s_all = jnp.zeros_like(s_acc)
+        log_accw = jnp.zeros((B,))
+        for k in range(self.K):
+            ks = jax.random.fold_in(ksim, k)
+            ka = jax.random.fold_in(kacc, k)
+            stats_k, early_k = self._simulate_all(ks, theta, m, eps)
+            d_k = self.distance.compute(stats_k, self.obs_flat,
+                                        params["distance"])
+            if all_accepted:
+                ok_k = jnp.isfinite(d_k)
+                lw_k = jnp.zeros((B,))
+            else:
+                acc_k, accw_k = self.acceptor.accept(
+                    ka, d_k, params["acceptor"])
+                ok_k = acc_k & ~early_k & jnp.isfinite(d_k)
+                lw_k = jnp.log(jnp.maximum(accw_k, 1e-38))
+            okf = ok_k.astype(jnp.float32)
+            n_acc = n_acc + ok_k.astype(jnp.int32)
+            d_safe = jnp.where(jnp.isfinite(d_k), d_k, 0.0)
+            d_acc = d_acc + okf * d_safe
+            d_all = d_all + d_safe
+            s_acc = s_acc + okf[:, None] * stats_k
+            s_all = s_all + stats_k
+            log_accw = log_accw + okf * lw_k
+        accepted = n_acc > 0
+        denom = jnp.maximum(n_acc, 1).astype(jnp.float32)
+        d = jnp.where(accepted, d_acc / denom, d_all / self.K)
+        stats = jnp.where(accepted[:, None], s_acc / denom[:, None],
+                          s_all / self.K)
+        log_acc_term = log_accw + jnp.log(denom / self.K)
+        return stats, d, accepted, log_acc_term
+
     def _log_prior(self, m: Array, theta: Array) -> Array:
         """Joint log prior density: model prior pmf × parameter prior pdf
         (reference _create_prior_pdf, smc.py:753-766)."""
@@ -136,26 +214,13 @@ class RoundKernel:
             th_j = prior.rvs_array(jax.random.fold_in(kth, j), B)
             th_j = jnp.pad(th_j, ((0, 0), (0, self.dim - th_j.shape[-1])))
             theta = jnp.where((m == j)[:, None], th_j, theta)
-        eps = self._eps_hint(params.get("acceptor", {}))
-        stats, early = self._simulate_all(ksim, theta, m, eps)
-        d = self.distance.compute(stats, self.obs_flat, params["distance"])
-        if all_accepted:
-            # calibration accepts everything EXCEPT non-finite distances —
-            # a failed host simulation (NaN stats) must not poison
-            # eps.initialize's median with NaN (reference drops errored
-            # simulations before the calibration sample too,
-            # redis_eps/cli.py:141-145)
-            accepted = jnp.isfinite(d)
-            log_acc_w = jnp.zeros((B,))
-        else:
-            acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])
-            log_acc_w = jnp.log(jnp.maximum(acc_w, 1e-38))
-            accepted = acc & ~early & jnp.isfinite(d)
+        stats, d, accepted, log_acc_term = self._replicated_evaluate(
+            ksim, kacc, theta, m, params, all_accepted=all_accepted)
         # generating-proposal density = the prior itself at t=0
         # (reference _create_transition_pdf(0) -> prior_pdf, smc.py:726-766)
         return RoundResult(
             m=m, theta=theta, distance=d, accepted=accepted,
-            log_weight=log_acc_w, stats=stats,
+            log_weight=log_acc_term, stats=stats,
             valid=jnp.ones((B,), dtype=bool),
             log_proposal=self._log_prior(m, theta))
 
@@ -212,14 +277,12 @@ class RoundKernel:
         log_prior = self._log_prior(m, theta)
         valid = jnp.isfinite(log_prior)
 
-        # 4. simulate + distance + accept (smc.py:664-724)
-        eps = self._eps_hint(params.get("acceptor", {}))
-        stats, early = self._simulate_all(ksim, theta, m, eps)
-        d = self.distance.compute(stats, self.obs_flat, params["distance"])
-        acc, acc_w = self.acceptor.accept(kacc, d, params["acceptor"])
-        # same predicate as prior_round: +inf distances reject too (for
-        # stochastic kernels a -inf log-density already self-rejects)
-        accepted = acc & valid & ~early & jnp.isfinite(d)
+        # 4. simulate + distance + accept, K replicates per parameter
+        # (smc.py:664-724); +inf distances reject too (for stochastic
+        # kernels a -inf log-density already self-rejects)
+        stats, d, sim_accepted, log_acc_term = self._replicated_evaluate(
+            ksim, kacc, theta, m, params)
+        accepted = sim_accepted & valid
 
         # 5. importance weight (smc.py:739-750, 793-809), log space.
         # proposal density of (m, theta):
@@ -232,13 +295,12 @@ class RoundKernel:
         # buffer instead (proposal_log_density + device_loop finalize).
         # Only valid when nothing consumes per-candidate densities; the
         # record column is NaN so an unexpected consumer fails loudly.
-        log_acc_w = jnp.log(jnp.maximum(acc_w, 1e-38))
         if with_proposal:
             log_denom = self.proposal_log_density(m, theta, params)
-            log_weight = log_prior + log_acc_w - log_denom
+            log_weight = log_prior + log_acc_term - log_denom
             log_proposal = log_denom
         else:
-            log_weight = log_prior + log_acc_w
+            log_weight = log_prior + log_acc_term
             log_proposal = jnp.full((B,), jnp.nan)
         log_weight = jnp.where(accepted, log_weight, -jnp.inf)
 

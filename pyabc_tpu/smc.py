@@ -185,7 +185,9 @@ class ABCSMC:
             acceptor=self.acceptor,
             spec=self.spec,
             obs_flat=self._obs_flat,
-            dim=self.dim)
+            dim=self.dim,
+            nr_samples_per_parameter=getattr(
+                self.population_strategy, "nr_samples_per_parameter", 1))
 
     # ------------------------------------------------------------------
     # transition fitting with fixed-shape padding

@@ -469,6 +469,14 @@ class History:
     def model_names(self) -> List[str]:
         return self._json_parameters().get("model_names", [])
 
+    def to_reference_db(self, path: str, batch_stats: bool = True) -> int:
+        """Export this run into the reference pyABC ORM schema at ``path``
+        so the reference's own tooling can read it (see
+        storage/reference_export.py; schema:
+        /root/reference/pyabc/storage/db_model.py:35-127)."""
+        from .reference_export import to_reference_db
+        return to_reference_db(self, path, batch_stats=batch_stats)
+
     def done(self):
         self._conn.commit()
 

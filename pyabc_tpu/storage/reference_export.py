@@ -1,0 +1,195 @@
+"""One-way export into the reference pyABC ORM schema.
+
+The repo's native storage is array-blob sqlite (one INSERT per model per
+generation — see storage/history.py); the reference ecosystem, however,
+reads the row-per-particle ORM schema of pyabc/storage/db_model.py:35-127
+(abc_smc -> populations -> models -> particles -> parameters / samples ->
+summary_statistics).  ``to_reference_db`` materializes a run into exactly
+that layout so pyABC's own visualization/analysis tooling can open it:
+
+- table/column names and foreign keys match the SQLAlchemy DDL,
+- per-particle ``w`` is normalized WITHIN its model and the model row
+  carries ``p_model``, so ``weight = particle.w * model.p_model``
+  reconstructs the global weight (reference history.py:842,992),
+- summary-statistic values use the reference's .npy byte encoding
+  (numpy_bytes_storage.np_to_bytes: ``np.save(allow_pickle=False)``).
+"""
+
+from __future__ import annotations
+
+import datetime
+import io
+import json
+import sqlite3
+from typing import Optional
+
+import numpy as np
+
+_REFERENCE_DDL = """
+CREATE TABLE IF NOT EXISTS abc_smc (
+    id INTEGER NOT NULL PRIMARY KEY,
+    start_time DATETIME,
+    end_time DATETIME,
+    json_parameters VARCHAR(5000),
+    distance_function VARCHAR(5000),
+    epsilon_function VARCHAR(5000),
+    population_strategy VARCHAR(5000),
+    git_hash VARCHAR(120)
+);
+CREATE TABLE IF NOT EXISTS populations (
+    id INTEGER NOT NULL PRIMARY KEY,
+    abc_smc_id INTEGER REFERENCES abc_smc (id),
+    t INTEGER,
+    population_end_time DATETIME,
+    nr_samples INTEGER,
+    epsilon FLOAT
+);
+CREATE TABLE IF NOT EXISTS models (
+    id INTEGER NOT NULL PRIMARY KEY,
+    population_id INTEGER REFERENCES populations (id),
+    m INTEGER,
+    name VARCHAR(200),
+    p_model FLOAT
+);
+CREATE TABLE IF NOT EXISTS particles (
+    id INTEGER NOT NULL PRIMARY KEY,
+    model_id INTEGER REFERENCES models (id),
+    w FLOAT
+);
+CREATE TABLE IF NOT EXISTS parameters (
+    id INTEGER NOT NULL PRIMARY KEY,
+    particle_id INTEGER REFERENCES particles (id),
+    name VARCHAR(200),
+    value FLOAT
+);
+CREATE TABLE IF NOT EXISTS samples (
+    id INTEGER NOT NULL PRIMARY KEY,
+    particle_id INTEGER REFERENCES particles (id),
+    distance FLOAT
+);
+CREATE TABLE IF NOT EXISTS summary_statistics (
+    id INTEGER NOT NULL PRIMARY KEY,
+    sample_id INTEGER REFERENCES samples (id),
+    name VARCHAR(200),
+    value BLOB
+);
+"""
+
+
+def _np_bytes(value) -> bytes:
+    # same .npy encoding as the native blobs (and the reference's
+    # numpy_bytes_storage.np_to_bytes)
+    from .history import _pack
+    return _pack(np.asarray(value))
+
+
+def _sql_datetime(stamp) -> Optional[str]:
+    """SQLAlchemy's sqlite DATETIME result processor needs the
+    space-separated '%Y-%m-%d %H:%M:%S.%f' form — the native history
+    stores 'T'-separated isoformat, which pyABC's ORM cannot parse."""
+    if stamp is None:
+        return None
+    return str(stamp).replace("T", " ")
+
+
+def to_reference_db(history, path: str,
+                    batch_stats: bool = True) -> int:
+    """Write this run into a fresh reference-schema sqlite DB at ``path``.
+
+    Returns the ``abc_smc.id`` of the exported run.  ``batch_stats=False``
+    skips the per-particle summary-statistic rows (the by-far largest
+    table) when only parameters/weights/distances are needed.
+    """
+    src = history
+    dst = sqlite3.connect(path)
+    try:
+        dst.executescript(_REFERENCE_DDL)
+        meta = src._conn.execute(
+            "SELECT start_time, json_parameters, distance, epsilon, "
+            "population_strategy FROM abc_smc WHERE id=?",
+            (src.id,)).fetchone()
+        if meta is None:
+            raise ValueError(f"no run with id {src.id} in {src.db_file()}")
+        start_time, json_parameters, distance, epsilon, pop_strategy = meta
+        cur = dst.execute(
+            "INSERT INTO abc_smc (start_time, end_time, json_parameters, "
+            "distance_function, epsilon_function, population_strategy, "
+            "git_hash) VALUES (?,?,?,?,?,?,?)",
+            (_sql_datetime(start_time),
+             datetime.datetime.now().isoformat(sep=" "),
+             json_parameters, distance, epsilon, pop_strategy, None))
+        abc_id = cur.lastrowid
+
+        pops = src._conn.execute(
+            "SELECT t, epsilon, nr_samples, population_end_time FROM "
+            "populations WHERE abc_smc_id=? ORDER BY t",
+            (src.id,)).fetchall()
+        for t, eps, nr_samples, end_time in pops:
+            cur = dst.execute(
+                "INSERT INTO populations (abc_smc_id, t, "
+                "population_end_time, nr_samples, epsilon) "
+                "VALUES (?,?,?,?,?)",
+                (abc_id, t, _sql_datetime(end_time), nr_samples, eps))
+            population_id = cur.lastrowid
+            rows = src._conn.execute(
+                "SELECT m, name, p_model, theta, weight, distance, "
+                "param_names FROM model_populations WHERE abc_smc_id=? "
+                "AND t=? ORDER BY m", (src.id, t)).fetchall()
+            for m, name, p_model, theta_b, w_b, d_b, names_json in rows:
+                cur = dst.execute(
+                    "INSERT INTO models (population_id, m, name, p_model) "
+                    "VALUES (?,?,?,?)",
+                    (population_id, int(m), name, float(p_model)))
+                model_id = cur.lastrowid
+                theta = np.load(io.BytesIO(theta_b), allow_pickle=False)
+                w = np.asarray(
+                    np.load(io.BytesIO(w_b), allow_pickle=False),
+                    dtype=np.float64)
+                d = np.load(io.BytesIO(d_b), allow_pickle=False)
+                names = json.loads(names_json) if names_json else []
+                # within-model normalization (reference convention:
+                # global weight = particle.w * model.p_model)
+                w_within = w / w.sum() if w.sum() > 0 else w
+                keyed = src.get_sum_stats(t, m) if batch_stats else {}
+                n = theta.shape[0]
+                # bulk-insert with explicit ids: per-row lastrowid
+                # round-trips are the reference schema's known cost
+                base_pid = _next_id(dst, "particles")
+                dst.executemany(
+                    "INSERT INTO particles (id, model_id, w) "
+                    "VALUES (?,?,?)",
+                    ((base_pid + i, model_id, float(w_within[i]))
+                     for i in range(n)))
+                if names:
+                    base_par = _next_id(dst, "parameters")
+                    dst.executemany(
+                        "INSERT INTO parameters (id, particle_id, name, "
+                        "value) VALUES (?,?,?,?)",
+                        ((base_par + i * len(names) + j, base_pid + i,
+                          names[j], float(theta[i, j]))
+                         for i in range(n) for j in range(len(names))))
+                base_sid = _next_id(dst, "samples")
+                dst.executemany(
+                    "INSERT INTO samples (id, particle_id, distance) "
+                    "VALUES (?,?,?)",
+                    ((base_sid + i, base_pid + i, float(d[i]))
+                     for i in range(n)))
+                if keyed:
+                    keys = [k for k in keyed if k != "__flat__"] \
+                        or list(keyed)
+                    base_ss = _next_id(dst, "summary_statistics")
+                    dst.executemany(
+                        "INSERT INTO summary_statistics (id, sample_id, "
+                        "name, value) VALUES (?,?,?,?)",
+                        ((base_ss + i * len(keys) + j, base_sid + i,
+                          keys[j], _np_bytes(keyed[keys[j]][i]))
+                         for i in range(n) for j in range(len(keys))))
+        dst.commit()
+        return abc_id
+    finally:
+        dst.close()
+
+
+def _next_id(conn, table: str) -> int:
+    row = conn.execute(f"SELECT MAX(id) FROM {table}").fetchone()
+    return (row[0] or 0) + 1

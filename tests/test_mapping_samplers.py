@@ -140,3 +140,28 @@ def test_profiling_context(tmp_path):
     assert sge.map(_square, [2]) == [4]
     # a pstats dump was produced inside the (failed-preserved or cleaned)
     # job dir; since the run succeeded the dir is gone — just assert result
+
+
+def test_dask_real_local_cluster(db_path):
+    """The REAL distributed transport (reference runs its dask tests
+    against a local cluster the same way, dask_sampler.py:49-51): the
+    get_client re-resolution, ncores and distributed.wait fast paths of
+    DaskDistributedSampler execute against Client(processes=False).
+    Skips when the optional 'distributed' package is absent."""
+    distributed = pytest.importorskip("distributed")
+    client = distributed.Client(processes=False, dashboard_address=None)
+    try:
+        models, priors, distance, observed, posterior_fn = \
+            make_two_gaussians_problem()
+        abc = pt.ABCSMC(models, priors, distance,
+                        population_size=120,
+                        sampler=pt.DaskDistributedSampler(
+                            dask_client=client, batch_size=8,
+                            client_max_jobs=4),
+                        seed=5)
+        abc.new(db_path, observed)
+        h = abc.run(max_nr_populations=3)
+        probs = h.get_model_probabilities(h.max_t)
+        assert abs(float(probs.get(1, 0.0)) - posterior_fn(1.0)) < 0.25
+    finally:
+        client.close()

@@ -151,3 +151,35 @@ def test_deferred_proposal_weight_equivalence(db_path):
     np.testing.assert_array_equal(m_e, m_d)
     np.testing.assert_allclose(th_e, th_d, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(w_e, w_d, rtol=2e-4, atol=1e-7)
+
+
+def test_nr_samples_per_parameter_weights():
+    """Multi-sim-per-parameter (reference smc.py:664-724): acceptance is
+    ANY-replicate and the weight carries the accepted fraction
+    (smc.py:793-809: len(accepted)/nr_samples_per_parameter)."""
+    import jax
+    import jax.numpy as jnp
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance,
+                    population_size=pt.ConstantPopulationSize(
+                        200, nr_samples_per_parameter=2),
+                    eps=pt.ConstantEpsilon(0.3),
+                    sampler=pt.VectorizedSampler(),
+                    seed=3)
+    abc.new("sqlite://", observed)
+    assert abc._kernel.K == 2
+    params = {"distance": abc.distance_function.get_params(0),
+              "acceptor": abc.acceptor.get_params(0, abc.eps)}
+    rr = abc._kernel.prior_round(jax.random.PRNGKey(0), params, 512)
+    acc = np.asarray(rr.accepted)
+    w = np.exp(np.asarray(rr.log_weight))
+    # at t=0 the weight of an accepted candidate is exactly n_acc/K
+    assert set(np.round(w[acc], 6)) <= {0.5, 1.0}
+    assert (w[acc] > 0).all()
+    # both fractions occur at this eps (acceptance is replicate-stochastic)
+    assert 0.5 in np.round(w[acc], 6) and 1.0 in np.round(w[acc], 6)
+    # and a full run stays green with correct posterior pull
+    h = abc.run(max_nr_populations=3)
+    probs = h.get_model_probabilities(h.max_t)
+    assert float(probs.get(1, 0.0)) > 0.5

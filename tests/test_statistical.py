@@ -162,3 +162,27 @@ def test_exponential_gamma_conjugate(db_path):
     # ABC targets p(lam | ybar), not p(lam | y): with the sufficient
     # statistic these coincide for the exponential likelihood
     assert lam_mean == pytest.approx(posterior_mean, rel=0.2)
+
+
+def test_adaptive_population_size_power_law_inversion():
+    """AdaptivePopulationSize fits cv(n) = a·n^b at three sizes and
+    inverts at the target (reference populationstrategy.py:203-222):
+    a loose target must SHRINK the population, a tight one must grow it."""
+    import numpy as np
+
+    import pyabc_tpu as pt
+
+    rng = np.random.default_rng(0)
+    theta = rng.normal(size=(512, 2)).astype(np.float32)
+    w = np.full(512, 1 / 512, np.float32)
+    tr = pt.MultivariateNormalTransition()
+    tr.fit(theta, w)
+
+    loose = pt.AdaptivePopulationSize(512, mean_cv=10.0, quantize=False)
+    loose.update([tr], [1.0])
+    assert loose.nr_particles < 512, loose.nr_particles
+
+    tight = pt.AdaptivePopulationSize(512, mean_cv=1e-4, quantize=False,
+                                      max_population_size=10**6)
+    tight.update([tr], [1.0])
+    assert tight.nr_particles > 512, tight.nr_particles
