@@ -1,0 +1,119 @@
+"""Zero-code PEtab import: problem directory in, posterior out.
+
+The TPU edition of the reference's AMICI/PEtab application notebook
+(reference pyabc/petab/amici.py:26-170): write (or point at) a standard
+PEtab problem directory — SBML model + parameter/observable/measurement
+tables + YAML — and `SBMLPetabImporter` builds the prior, the batched
+RK4 likelihood model, and the acceptance kernel with no hand-written
+model code.  Paired with `StochasticAcceptor` + `Temperature` this is
+exact Bayesian inference on the ODE model.
+
+Run: ``python examples/petab_import.py``
+"""
+
+import os
+import tempfile
+import textwrap
+
+import numpy as np
+
+import pyabc_tpu as pt
+from pyabc_tpu.petab import SBMLPetabImporter
+
+POP = int(os.environ.get("ABC_EXAMPLE_POP", 2000))
+GENS = int(os.environ.get("ABC_EXAMPLE_GENS", 4))
+
+SBML = """<?xml version="1.0" encoding="UTF-8"?>
+<sbml xmlns="http://www.sbml.org/sbml/level3/version2/core"
+      level="3" version="2">
+  <model id="decay">
+    <listOfCompartments>
+      <compartment id="cell" size="1" constant="true"/>
+    </listOfCompartments>
+    <listOfSpecies>
+      <species id="A" compartment="cell" initialConcentration="1"/>
+    </listOfSpecies>
+    <listOfParameters>
+      <parameter id="k1" value="0.7" constant="true"/>
+    </listOfParameters>
+    <listOfReactions>
+      <reaction id="degrade" reversible="false">
+        <listOfReactants>
+          <speciesReference species="A" stoichiometry="1"/>
+        </listOfReactants>
+        <kineticLaw>
+          <math xmlns="http://www.w3.org/1998/Math/MathML">
+            <apply><times/><ci>k1</ci><ci>A</ci></apply>
+          </math>
+        </kineticLaw>
+      </reaction>
+    </listOfReactions>
+  </model>
+</sbml>
+"""
+
+
+def write_problem_dir(root: str) -> str:
+    """A complete toy PEtab problem: exponential decay, true k1 = 0.7."""
+    times = np.asarray([0.5, 1.0, 1.5, 2.0])
+    rng = np.random.default_rng(0)
+    data = np.exp(-0.7 * times) + 0.05 * rng.normal(size=times.shape)
+
+    def path(name):
+        return os.path.join(root, name)
+
+    with open(path("model.xml"), "w") as f:
+        f.write(SBML)
+    with open(path("parameters.tsv"), "w") as f:
+        f.write("parameterId\tparameterScale\tlowerBound\tupperBound\t"
+                "estimate\tobjectivePriorType\tobjectivePriorParameters\n"
+                "k1\tlin\t0.01\t3.0\t1\tuniform\t0.01;3.0\n")
+    with open(path("observables.tsv"), "w") as f:
+        f.write("observableId\tobservableFormula\tnoiseFormula\n"
+                "obs_a\tA\t0.05\n")
+    with open(path("measurements.tsv"), "w") as f:
+        f.write("observableId\tsimulationConditionId\ttime\tmeasurement\n")
+        for t, m in zip(times, data):
+            f.write(f"obs_a\tc0\t{t}\t{m}\n")
+    with open(path("conditions.tsv"), "w") as f:
+        f.write("conditionId\nc0\n")
+    with open(path("problem.yaml"), "w") as f:
+        f.write(textwrap.dedent("""\
+            format_version: 1
+            parameter_file: parameters.tsv
+            problems:
+              - sbml_files: [model.xml]
+                condition_files: [conditions.tsv]
+                observable_files: [observables.tsv]
+                measurement_files: [measurements.tsv]
+        """))
+    return path("problem.yaml")
+
+
+def main():
+    with tempfile.TemporaryDirectory() as tmp:
+        yaml_path = write_problem_dir(tmp)
+
+        importer = SBMLPetabImporter.from_yaml(yaml_path, n_steps=60)
+        abc = pt.ABCSMC(
+            models=importer.create_model(),
+            parameter_priors=importer.create_prior(),
+            distance_function=importer.create_kernel(),
+            population_size=POP,
+            eps=pt.Temperature(),
+            acceptor=pt.StochasticAcceptor(),
+            seed=1)
+        abc.new("sqlite://", importer.get_observed())
+        history = abc.run(max_nr_populations=GENS)
+
+        pop = history.get_population(history.max_t)
+        theta = np.asarray(pop.theta)[:, 0]
+        w = np.asarray(pop.weight)
+        mean = float(np.sum(theta * w))
+        sd = float(np.sqrt(np.sum(w * (theta - mean) ** 2)))
+        print(f"posterior k1 = {mean:.3f} +- {sd:.3f} (true 0.7)")
+        assert 0.3 < mean < 1.2
+
+
+if __name__ == "__main__":
+    main()

@@ -366,12 +366,16 @@ def parse_sbml(path_or_string: str) -> SBMLModel:
             for s in section:
                 init = s.get("initialConcentration")
                 if init is None:
-                    # amount units only coincide with concentration in a
-                    # unit compartment; anything else would silently
+                    init = s.get("initialAmount")
+                    # a NONZERO amount only coincides with concentration
+                    # in a unit compartment; anything else would silently
                     # mis-simulate (the /size division assumes
-                    # concentrations) — checked after all sections parse
-                    init = s.get("initialAmount", "0")
-                    amount_species.append(s.get("id"))
+                    # concentrations) — checked after all sections parse.
+                    # Zero amounts (empty product species) and absent
+                    # initials (set via condition tables) are fine.
+                    if init is not None and float(init) != 0.0:
+                        amount_species.append(s.get("id"))
+                    init = init if init is not None else "0"
                 if s.get("hasOnlySubstanceUnits") == "true":
                     raise ExprError(
                         f"species {s.get('id')!r} uses "

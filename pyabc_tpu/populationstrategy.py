@@ -108,7 +108,7 @@ class AdaptivePopulationSize(PopulationStrategy):
             return
         n_req = predict_population_size(
             cvs, self.mean_cv, min_size=self.min_population_size,
-            max_size=self.max_population_size)
+            max_size=self.max_population_size, fallback=reference_nr)
         if self.quantize:
             n_req = 1 << int(np.ceil(np.log2(max(n_req, 2))))
             n_req = min(n_req, self.max_population_size)

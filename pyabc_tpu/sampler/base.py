@@ -72,6 +72,31 @@ class SamplingError(Exception):
     pass
 
 
+def fetch_to_host(tree):
+    """Materialize a (possibly global) device pytree as host numpy.
+
+    Single-process arrays go through one bulk ``jax.device_get``.  Under
+    ``jax.distributed`` a sharded round/loop output spans devices this
+    process cannot address; there the global value is assembled with an
+    allgather collective — every process calls this at the same point
+    (SPMD control flow), so the collective is well-ordered.  Replicated
+    global arrays (counters, scalars) read the local replica without any
+    collective.
+    """
+    import jax
+
+    def get(leaf):
+        if getattr(leaf, "is_fully_addressable", True):
+            return leaf  # bulk-fetched below
+        if getattr(leaf, "is_fully_replicated", False):
+            return np.asarray(leaf.addressable_shards[0].data)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(leaf,
+                                                            tiled=True))
+    import jax.tree_util as tu
+    return jax.device_get(tu.tree_map(get, tree))
+
+
 _NAN_MASK_CACHE: dict = {}
 
 
@@ -125,6 +150,7 @@ class Sample:
         self.transition_log_pdf = None
 
     def append_round(self, rr: RoundResult):
+        rr = fetch_to_host(rr)
         acc_mask = np.asarray(rr.accepted)
         self.nr_evaluations += int(acc_mask.shape[0])
         self.raw_accepted += int(acc_mask.sum())
@@ -155,8 +181,7 @@ class Sample:
         """Ingest one on-device generation batch (sampler/device_loop.py):
         a single host transfer of the compacted accepted buffers (+ records).
         """
-        import jax
-        out = jax.device_get(out)  # ONE bulk d2h transfer, not one per key
+        out = fetch_to_host(out)  # ONE bulk d2h transfer, not one per key
         self.nr_evaluations += int(n_evals)
         count = int(out["count"])
         self.raw_accepted += count
@@ -294,9 +319,8 @@ class Sample:
         # ONE bundled host transfer for all requested columns of all
         # batches (per-column np.asarray would pay the relay's
         # per-transaction constant keys x batches times)
-        import jax
-        fetched = jax.device_get([{k: b[k] for k in keys}
-                                  for b in self._rec])
+        fetched = fetch_to_host([{k: b[k] for k in keys}
+                                 for b in self._rec])
         out = {}
         for k in keys:
             parts = [np.asarray(f[k])[:b["__count"]]

@@ -6,12 +6,13 @@ Parity: pyabc/sampler/mapping.py:10-117 (``MappingSampler`` — any
 eval-parallel scheduler the futures samplers share).
 
 These exist for simulators that cannot be expressed in JAX at all (external
-binaries, R scripts, legacy Python): the per-candidate work is a host
-closure farmed out over a map/executor, exactly the reference's model.  The
-round kernel is NOT used; instead the sampler evaluates the same
-proposal -> simulate -> distance -> accept pipeline per particle via a
-host-side ``simulate_one`` closure built by the orchestrator
-(``RoundKernel.host_simulate_one``).
+binaries, R scripts, legacy Python): each map/executor task evaluates the
+SAME compiled round function as the on-device samplers, just at batch size
+1 per task — the proposal -> simulate -> distance -> accept pipeline stays
+the round kernel's; only the scheduling is farmed out, exactly the
+reference's STAT/DYN split.  Host simulators plug in underneath as
+``HostFunctionModel``s (pyabc_tpu/external), so the escape hatch is the
+model, not a separate sampling code path.
 
 For JAX-able models prefer VectorizedSampler/ShardedSampler — they are
 orders of magnitude faster (see BASELINE.md).

@@ -155,8 +155,9 @@ class RoundKernel:
 
         B = theta.shape[0]
         n_acc = jnp.zeros((B,), jnp.int32)
+        n_fin = jnp.zeros((B,), jnp.int32)
         d_acc = jnp.zeros((B,))
-        d_all = jnp.zeros((B,))
+        d_fin = jnp.zeros((B,))
         s_acc = jnp.zeros((B, self.spec.total_size), dtype=jnp.float32)
         s_all = jnp.zeros_like(s_acc)
         log_accw = jnp.zeros((B,))
@@ -166,25 +167,36 @@ class RoundKernel:
             stats_k, early_k = self._simulate_all(ks, theta, m, eps)
             d_k = self.distance.compute(stats_k, self.obs_flat,
                                         params["distance"])
+            fin_k = jnp.isfinite(d_k)
             if all_accepted:
-                ok_k = jnp.isfinite(d_k)
+                ok_k = fin_k
                 lw_k = jnp.zeros((B,))
             else:
                 acc_k, accw_k = self.acceptor.accept(
                     ka, d_k, params["acceptor"])
-                ok_k = acc_k & ~early_k & jnp.isfinite(d_k)
+                ok_k = acc_k & ~early_k & fin_k
                 lw_k = jnp.log(jnp.maximum(accw_k, 1e-38))
             okf = ok_k.astype(jnp.float32)
             n_acc = n_acc + ok_k.astype(jnp.int32)
-            d_safe = jnp.where(jnp.isfinite(d_k), d_k, 0.0)
+            n_fin = n_fin + fin_k.astype(jnp.int32)
+            d_safe = jnp.where(fin_k, d_k, 0.0)
             d_acc = d_acc + okf * d_safe
-            d_all = d_all + d_safe
+            d_fin = d_fin + d_safe
             s_acc = s_acc + okf[:, None] * stats_k
             s_all = s_all + stats_k
             log_accw = log_accw + okf * lw_k
         accepted = n_acc > 0
         denom = jnp.maximum(n_acc, 1).astype(jnp.float32)
-        d = jnp.where(accepted, d_acc / denom, d_all / self.K)
+        # rejected candidates record the mean over FINITE replicates; a
+        # candidate whose every simulation failed records +inf (matching
+        # the K == 1 path, where a non-finite distance flows through) so
+        # record consumers (temperature schemes) never mistake total
+        # failure for a perfect fit
+        d_rej = jnp.where(
+            n_fin > 0,
+            d_fin / jnp.maximum(n_fin, 1).astype(jnp.float32),
+            jnp.inf)
+        d = jnp.where(accepted, d_acc / denom, d_rej)
         stats = jnp.where(accepted[:, None], s_acc / denom[:, None],
                           s_all / self.K)
         log_acc_term = log_accw + jnp.log(denom / self.K)

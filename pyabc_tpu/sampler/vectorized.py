@@ -26,7 +26,7 @@ from typing import Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from .base import Sample, Sampler, SamplingError
+from .base import Sample, Sampler, SamplingError, fetch_to_host
 from .device_loop import build_stateful_loop
 
 logger = logging.getLogger("ABC.Sampler")
@@ -216,7 +216,7 @@ class VectorizedSampler(Sampler):
                 fetch = [finalize(state, params)]
                 if rec is not None:
                     fetch.append(rec["rec_count"])
-                fetch = jax.device_get(fetch)
+                fetch = fetch_to_host(fetch)
                 out = fetch[0]
                 count, rounds = int(out["count"]), int(out["rounds"])
                 if rec is not None:
@@ -225,7 +225,7 @@ class VectorizedSampler(Sampler):
                 scalars = [state["count"], state["rounds"]]
                 if rec is not None:
                     scalars.append(rec["rec_count"])
-                scalars = jax.device_get(scalars)
+                scalars = fetch_to_host(scalars)
                 count, rounds = int(scalars[0]), int(scalars[1])
                 if rec is not None:
                     rec["rec_count_host"] = int(scalars[2])
@@ -249,7 +249,7 @@ class VectorizedSampler(Sampler):
                 break
             out = None  # mis-predicted prefetch: discard, keep sampling
         if out is None:
-            out = jax.device_get(finalize(state, params))
+            out = fetch_to_host(finalize(state, params))
         sample.append_device_batch(out, rounds * B)
         if bar is not None:
             bar.finish()

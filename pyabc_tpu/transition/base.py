@@ -195,7 +195,8 @@ class Transition:
         for n in sorted({max(current // 4, 8), max(current // 2, 8), current}):
             key, sub = jax.random.split(key)
             cvs[n] = self.mean_cv(sub, n_samples=n, n_bootstrap=n_bootstrap)
-        return predict_population_size(cvs, coefficient_of_variation)
+        return predict_population_size(cvs, coefficient_of_variation,
+                                       fallback=current)
 
 
 class NotFittedError(Exception):

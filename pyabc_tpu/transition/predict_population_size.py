@@ -25,16 +25,24 @@ def fit_powerlaw(ns, cvs):
 def predict_population_size(cv_estimates: Dict[int, float],
                             target_cv: float,
                             min_size: int = 8,
-                            max_size: int = 10**7) -> int:
-    """Invert the fitted power law at ``target_cv``."""
+                            max_size: int = 10**7,
+                            fallback: int = None) -> int:
+    """Invert the fitted power law at ``target_cv``.
+
+    ``fallback`` is returned when the fit degenerates (cv not decreasing
+    in n, or a non-finite inversion) — callers pass their CURRENT size so
+    a noisy bootstrap cannot ratchet the population upward.
+    """
     ns = list(cv_estimates.keys())
     cvs = [cv_estimates[n] for n in ns]
+    if fallback is None:
+        fallback = max(ns) if ns else min_size
     if len(ns) < 2:
-        return int(ns[0]) if ns else min_size
+        return int(ns[0]) if ns else int(fallback)
     a, b = fit_powerlaw(ns, cvs)
-    if b >= 0:  # cv not decreasing in n: keep current size
-        return int(max(ns))
+    if b >= 0:  # cv not decreasing in n: keep the caller's current size
+        return int(fallback)
     n_req = (target_cv / a) ** (1.0 / b)
     if not np.isfinite(n_req):
-        return int(max(ns))
+        return int(fallback)
     return int(np.clip(n_req, min_size, max_size))
