@@ -5,9 +5,12 @@ Parity: pyabc/pyabc_rand_choice.py:4-17 speeds up small weighted draws by
 replacing ``np.random.choice``'s machinery with a linear CDF scan.  The
 TPU analog solves the opposite regime: ``jax.random.categorical(key, logits,
 shape=(n,))`` materializes an ``[n, N]`` Gumbel block — 2.6e11 elements at
-the 1e6-population scale, ~35x slower than this inverse-CDF formulation
-(cumsum + vectorized binary search, O(N + n log N), measured 6.2 s -> 0.18 s
-at n=2^19, N=5e5 on one v5e chip).
+the 1e6-population scale.  The inverse-CDF formulation here went through
+two designs: cumsum + ``jnp.searchsorted`` (35x over categorical, 6.2 s ->
+0.18 s at n=2^19, N=5e5) and then a two-level blocked count (see
+:func:`fast_weighted_choice`) after the binary search's ~log2(N) serial
+random-gather steps per lane proved to dominate the whole sampling round
+(a further ~17x on the inversion at n=2^19, N=2^20).
 """
 
 from __future__ import annotations
@@ -18,25 +21,57 @@ import jax.numpy as jnp
 Array = jnp.ndarray
 
 
+#: support-block width for the two-level inverse-CDF search; the refine
+#: step gathers one contiguous [n, _BLOCK] slab (TPU-friendly row gather)
+_BLOCK = 256
+
+
 def fast_weighted_choice(key, log_w: Array, n: int) -> Array:
     """``n`` indices sampled ∝ ``exp(log_w)`` (unnormalized log weights).
 
     Padded entries with log_w ≈ -inf get zero probability mass (flat CDF
     segments are never hit by a strictly-below-cap uniform draw).
+
+    The inversion ``idx = smallest i with cdf[i] > u`` is a TWO-LEVEL
+    vectorized search, not ``jnp.searchsorted``: binary search lowers to
+    ~log2(N) serial random-gather steps per lane, which dominated the
+    whole sampling round at the 1e6 scale (measured ~0.08 s/round at
+    n=2^19, N=2^20 — >90 % of the non-KDE round cost).  Instead the
+    block-end CDF values are compared against every draw in one fused
+    broadcast-reduce (no gathers), then ONE contiguous [n, block] row
+    gather + count refines within the block — all parallel VPU work.
     """
     w = jax.nn.softmax(log_w)
     cdf = jnp.cumsum(w)
+    N = log_w.shape[0]
     u = jax.random.uniform(key, (n,), dtype=cdf.dtype) * cdf[-1]
     # uniform*cdf[-1] can round UP to exactly cdf[-1] in f32 (uniform near 1),
-    # in which case side='right' finds no cdf[i] > u and returns N — and a
+    # in which case no cdf[i] > u exists and the counts below hit N — and a
     # plain N-1 clamp would land on a zero-weight padded row.  Capping u at
-    # the float just below cdf[-1] makes searchsorted return the LAST
+    # the float just below cdf[-1] routes the draw to the LAST
     # positive-weight index instead (trailing flat CDF segments all equal
-    # cdf[-1], so the first cdf[i] > u is the final real entry).
+    # cdf[-1], so the first cdf[i] > u is the final real entry).  The same
+    # strictly-below-cap property makes flat (zero-weight) segments
+    # unhittable even when u lands EXACTLY on their value.
     u = jnp.minimum(u, jnp.nextafter(cdf[-1], jnp.zeros((), cdf.dtype)))
-    # side='right': smallest i with cdf[i] > u — a flat (zero-weight) CDF
-    # segment is skipped even when u lands EXACTLY on its value (incl. the
-    # u = 0.0 draw against a zero-weight first entry, which side='left'
-    # would select)
-    idx = jnp.searchsorted(cdf, u, side="right")
-    return jnp.minimum(idx, log_w.shape[0] - 1).astype(jnp.int32)
+    if N <= _BLOCK * 4:
+        # small support: one fused compare-reduce over the whole CDF
+        idx = jnp.sum((cdf[None, :] <= u[:, None]).astype(jnp.int32),
+                      axis=1)
+        return jnp.minimum(idx, N - 1).astype(jnp.int32)
+    n_blocks = -(-N // _BLOCK)
+    pad = n_blocks * _BLOCK - N
+    # pad with cdf[-1] (edge): strictly above every capped u, so padding
+    # is never counted by either level
+    cdf_p = jnp.pad(cdf, (0, pad), mode="edge") if pad else cdf
+    blocks = cdf_p.reshape(n_blocks, _BLOCK)
+    coarse = blocks[:, -1]                                    # [C]
+    # level 1: first block whose end exceeds u (fused, gather-free)
+    blk = jnp.sum((coarse[None, :] <= u[:, None]).astype(jnp.int32),
+                  axis=1)
+    blk = jnp.minimum(blk, n_blocks - 1)
+    # level 2: contiguous row gather + count within the block
+    rows = blocks[blk]                                        # [n, BLOCK]
+    off = jnp.sum((rows <= u[:, None]).astype(jnp.int32), axis=1)
+    idx = blk * _BLOCK + off
+    return jnp.minimum(idx, N - 1).astype(jnp.int32)

@@ -46,3 +46,23 @@ def test_single_point_support():
     idx = np.asarray(fast_weighted_choice(
         jax.random.PRNGKey(2), jnp.zeros(1), 16))
     assert (idx == 0).all()
+
+
+def test_two_level_matches_searchsorted():
+    """The two-level bucketed inversion must agree EXACTLY with the
+    searchsorted formulation on the same draws (fast_weighted_choice
+    consumes its key with a single jax.random.uniform call, so the
+    reference path below sees identical uniforms)."""
+    key = jax.random.PRNGKey(9)
+    for N in (3, 100, 1024, 5000, 1 << 15):
+        kw = jax.random.fold_in(key, N)
+        ku = jax.random.fold_in(key, N + 1)
+        log_w = jax.random.normal(kw, (N,))
+        got = np.asarray(fast_weighted_choice(ku, log_w, 10_000))
+
+        cdf = jnp.cumsum(jax.nn.softmax(log_w))
+        u = jax.random.uniform(ku, (10_000,), dtype=cdf.dtype) * cdf[-1]
+        u = jnp.minimum(u, jnp.nextafter(cdf[-1], jnp.zeros((), cdf.dtype)))
+        ref = np.asarray(jnp.minimum(
+            jnp.searchsorted(cdf, u, side="right"), N - 1))
+        np.testing.assert_array_equal(got, ref)
