@@ -60,3 +60,24 @@ def test_new_parity_classes_are_functional():
 
     # RedisEvalParallelSampler is the sharded data plane
     assert issubclass(pt.RedisEvalParallelSampler, pt.ShardedSampler)
+
+
+def test_round3_surface_exports():
+    """Round-3 additions resolve and carry the documented API."""
+    from pyabc_tpu.petab import (PetabProblem, PetabSBMLModel,
+                                 SBMLPetabImporter, parse_sbml)
+    from pyabc_tpu.storage import from_reference_db, to_reference_db
+
+    assert callable(SBMLPetabImporter.from_yaml)
+    assert callable(PetabProblem.from_yaml)
+    assert callable(parse_sbml)
+    assert callable(to_reference_db) and callable(from_reference_db)
+    assert callable(pt.History.from_reference_db)
+    assert callable(pt.History.to_reference_db)
+
+    # deferred-proposal contract points
+    from pyabc_tpu.sampler.base import Sampler, fetch_to_host
+    from pyabc_tpu.sampler.rounds import RoundKernel
+    assert RoundKernel.generation_round.supports_deferred_proposal
+    assert hasattr(Sampler(), "record_proposal_density")
+    assert callable(fetch_to_host)
