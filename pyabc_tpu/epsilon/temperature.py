@@ -72,9 +72,10 @@ class Temperature(TemperatureBase):
         for scheme in self.schemes:
             if getattr(scheme, "requires_all_records", False):
                 sampler.record_rejected = True
-                # schemes read pd/pd_prev ratios off the records, so rounds
-                # must compute real per-candidate proposal densities (no
-                # deferred-proposal fast path)
+                # schemes read pd/pd_prev ratios off the records, so the
+                # records must carry real per-candidate proposal densities
+                # (computed over the bucketed record slices at ingest —
+                # rounds still run in deferred mode)
                 sampler.record_proposal_density = True
 
     def initialize(self, t, get_weighted_distances=None, get_all_records=None,

@@ -72,6 +72,15 @@ class SamplingError(Exception):
     pass
 
 
+def pow4_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power of FOUR >= n (>= minimum).  Pow2 buckets still
+    produced a new shape — and a fresh ~2-4 s remote compile of every
+    shape-keyed program — almost every generation as data-dependent row
+    counts drifted; pow4 trades <=4x NaN padding for 1-2 compiled shapes
+    per run."""
+    return max(int(4 ** np.ceil(np.log2(max(n, 1)) / 2)), minimum)
+
+
 def fetch_to_host(tree):
     """Materialize a (possibly global) device pytree as host numpy.
 
@@ -243,11 +252,19 @@ class Sample:
         # consume the buffers directly; exact-count consumers use the
         # stored "__count" after host materialization.
         cap = rec["rec_stats"].shape[0]
-        bucket = min(int(2 ** np.ceil(np.log2(max(rc, 1)))), cap)
+        bucket = min(pow4_bucket(rc), cap)
         batch = _nan_mask_records(
             {k: rec[f"rec_{k}"][:bucket]
              for k in ("stats", "distance", "accepted", "m", "theta",
                        "log_proposal")}, rc)
+        density_fn = rec.get("record_density_fn")
+        if density_fn is not None:
+            # rounds ran in deferred mode (no per-candidate KDE); give the
+            # RECORDS real generating-proposal densities over the bucketed
+            # slice — total density work is bounded by the record budget,
+            # not rounds x batch.  NaN-masked tail rows yield NaN, as the
+            # record contract expects.
+            batch["log_proposal"] = density_fn(batch["m"], batch["theta"])
         batch["__count"] = rc
         self._rec.append(batch)
         self._n_recorded += rc
@@ -379,8 +396,10 @@ class Sampler:
         self.nr_evaluations_ = 0
         self.record_rejected = False
         #: set (with record_rejected) by TemperatureBase.configure_sampler:
-        #: records must carry real per-candidate proposal densities, which
-        #: disables the deferred-proposal fast path (VectorizedSampler)
+        #: records must carry real per-candidate proposal densities.
+        #: Rounds still skip the KDE (deferred mode); the densities are
+        #: computed over the BUCKETED record slices at ingest
+        #: (Sample.append_record_batch), bounded by the record budget
         self.record_proposal_density = False
         self.show_progress = False
         #: cap on recorded candidates per generation; the orchestrator sets

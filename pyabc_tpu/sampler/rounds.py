@@ -304,9 +304,10 @@ class RoundKernel:
         # With ``with_proposal=False`` (static) the density term — the
         # per-round KDE over the full support, the hot op — is SKIPPED:
         # the sampler subtracts it once per generation over the accepted
-        # buffer instead (proposal_log_density + device_loop finalize).
-        # Only valid when nothing consumes per-candidate densities; the
-        # record column is NaN so an unexpected consumer fails loudly.
+        # buffer (proposal_log_density + device_loop finalize), and when
+        # records must carry densities they are computed over the
+        # bucketed record slices at ingest.  The in-round record column
+        # is NaN so a consumer that bypasses those paths fails loudly.
         if with_proposal:
             log_denom = self.proposal_log_density(m, theta, params)
             log_weight = log_prior + log_acc_term - log_denom

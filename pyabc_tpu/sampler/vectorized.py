@@ -178,12 +178,22 @@ class VectorizedSampler(Sampler):
         record_cap = (min(self.max_records_cap(),
                           B * self.max_rounds_per_call)
                       if self.record_rejected else 0)
-        # defer the proposal-density KDE to one per-generation pass over
-        # the accepted buffer whenever nothing consumes per-candidate
-        # densities (only temperature schemes do, via record columns)
+        # defer the proposal-density KDE out of the rounds entirely:
+        # accepted weights get corrected once per generation (finalize),
+        # and when a consumer needs per-candidate densities (temperature
+        # schemes, via record columns) they are computed over the BUCKETED
+        # record slices at ingest — bounded by the record budget, not
+        # rounds x batch
         defer = (getattr(round_fn, "supports_deferred_proposal", False)
-                 and hasattr(round_fn, "__self__")
-                 and not self.record_proposal_density)
+                 and hasattr(round_fn, "__self__"))
+        record_density_fn = None
+        if defer and record_cap and self.record_proposal_density:
+            key_fn = ("density", self._fn_id(round_fn))
+            if key_fn not in self._compiled:
+                self._compiled[key_fn] = jax.jit(
+                    round_fn.__self__.proposal_log_density)
+            jitted = self._compiled[key_fn]
+            record_density_fn = lambda m, th: jitted(m, th, params)  # noqa: E731
         d, s = self._round_shape(round_fn, B, params)
         start, step, finalize, harvest = self._get(
             "sloop", round_fn, B, n, record_cap, d, s, defer)
@@ -202,6 +212,8 @@ class VectorizedSampler(Sampler):
                 # the arrays stay device-resident (Sample materializes
                 # only what consumers actually read)
                 rec, state = harvest(state)
+                if record_density_fn is not None:
+                    rec["record_density_fn"] = record_density_fn
             # ONE host transfer per call.  When this call is expected to
             # finish the generation (the common single-call case), fetch
             # the finalized buffers directly — count/rounds ride along, so

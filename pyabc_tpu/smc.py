@@ -287,13 +287,24 @@ class ABCSMC:
         log_mix = logsumexp(log_probs + log_jump, axis=0)
         log_q = np.full(m.shape, -np.inf)
         for j in range(self.M):
-            sel = m == j
-            if not sel.any():
+            sel_idx = np.nonzero(m == j)[0]
+            if sel_idx.size == 0:
                 continue
             dim_j = self.parameter_priors[j].dim
-            log_q[sel] = np.asarray(
-                self.transitions[j].log_pdf(theta[sel, :dim_j]),
-                dtype=np.float64)
+            # pad the query rows to a pow4 bucket: the per-model selection
+            # count is data-dependent, and an exact shape would bill a
+            # fresh XLA compile of the KDE log-pdf to EVERY generation
+            # (~4 s/gen through the remote compiler — measured as the
+            # dominant cost of the temperature-scheme path).  NaN padding
+            # rows yield NaN densities and are dropped on truncation.
+            from .sampler.base import pow4_bucket
+            n_s = int(sel_idx.size)
+            bucket = pow4_bucket(n_s, minimum=64)
+            th = np.full((bucket, dim_j), np.nan, dtype=np.float32)
+            th[:n_s] = theta[sel_idx, :dim_j]
+            vals = np.asarray(self.transitions[j].log_pdf(th),
+                              dtype=np.float64)[:n_s]
+            log_q[sel_idx] = vals
         return log_mix + log_q
 
     # ------------------------------------------------------------------

@@ -112,45 +112,42 @@ def test_graft_entry_single_and_multichip():
     ge.dryrun_multichip(8)
 
 
-def test_deferred_proposal_weight_equivalence(db_path):
-    """The deferred-proposal fast path (rounds skip the proposal-density
-    KDE; finalize subtracts it over the accepted buffer) must yield the
-    same populations as the eager per-round computation."""
-    def run(eager: bool):
-        models, priors, distance, observed, _ = make_two_gaussians_problem()
-        abc = pt.ABCSMC(models, priors, distance,
-                        population_size=400,
-                        sampler=pt.VectorizedSampler(),
-                        seed=11)
-        abc.new("sqlite://", observed)
-        if eager:
-            # force the eager path the way a temperature scheme would:
-            # flip the record flags after smc's per-run reset
-            from pyabc_tpu.sampler import vectorized as vz
-            orig_sua = vz.VectorizedSampler.sample_until_n_accepted
+def test_deferred_weights_match_eager_kernel(db_path):
+    """The deferred-proposal path (rounds skip the proposal-density KDE;
+    finalize subtracts it over the accepted buffer) must produce weights
+    identical to the kernel's EAGER formula, recomputed independently for
+    every accepted particle."""
+    import jax.numpy as jnp
 
-            def sua(self, *a, **kw):
-                self.record_proposal_density = True
-                self.record_rejected = True
-                return orig_sua(self, *a, **kw)
-            vz.VectorizedSampler.sample_until_n_accepted = sua
-            try:
-                h = abc.run(max_nr_populations=3)
-            finally:
-                vz.VectorizedSampler.sample_until_n_accepted = orig_sua
-        else:
-            h = abc.run(max_nr_populations=3)
-        pop = h.get_population(h.max_t)
-        return (np.asarray(pop.m), np.asarray(pop.theta),
-                np.asarray(pop.weight))
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance,
+                    population_size=400,
+                    sampler=pt.VectorizedSampler(),
+                    seed=11)
+    abc.new("sqlite://", observed)
+    h = abc.run(max_nr_populations=3)
+    t = h.max_t
+    pop = h.get_population(t)
+    pop_prev = h.get_population(t - 1)
 
-    m_e, th_e, w_e = run(eager=True)
-    m_d, th_d, w_d = run(eager=False)
-    # same seed -> identical particle sets; weights agree to f32 tolerance
-    # (the KDE runs at different batch shapes on the two paths)
-    np.testing.assert_array_equal(m_e, m_d)
-    np.testing.assert_allclose(th_e, th_d, rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(w_e, w_d, rtol=2e-4, atol=1e-7)
+    # rebuild the generation-t proposal exactly as the orchestrator did
+    abc._fit_transitions(t, population=pop_prev)
+    probs = abc._model_probabilities(t - 1)
+    with np.errstate(divide="ignore"):
+        log_probs = np.log(np.maximum(probs, 1e-300)).astype(np.float32)
+    params = {"model_log_probs": jnp.asarray(log_probs),
+              "transition": abc._trans_params}
+
+    m = jnp.asarray(np.asarray(pop.m))
+    theta = jnp.asarray(np.asarray(pop.theta, dtype=np.float32))
+    log_denom = np.asarray(
+        abc._kernel.proposal_log_density(m, theta, params), np.float64)
+    log_prior = np.asarray(abc._kernel._log_prior(m, theta), np.float64)
+    # UniformAcceptor: acc weight 1 -> weight ∝ exp(log_prior - log_denom)
+    expected = np.exp(log_prior - log_denom - (log_prior - log_denom).max())
+    expected = expected / expected.sum()
+    np.testing.assert_allclose(np.asarray(pop.weight), expected,
+                               rtol=2e-4, atol=1e-8)
 
 
 def test_nr_samples_per_parameter_weights():
