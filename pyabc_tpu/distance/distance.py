@@ -29,6 +29,41 @@ from ..sumstat import SumStatSpec
 from .base import Distance, to_distance
 from .scale import SCALE_FUNCTIONS, median_absolute_deviation, standard_deviation
 
+#: jitted scale functions, weakly cached by function identity: the scale
+#: math is a chain of reductions whose EAGER per-op dispatches each pay
+#: the remote relay's submission constant — one fused program per
+#: (fn, shape) pays it once.  Weak keys let per-instance lambdas (and
+#: their compiled executables) be collected with their distance.
+import weakref
+
+_SCALE_JIT: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_SCALE_EAGER: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _apply_scale(fn: Callable, *args):
+    """Call ``fn`` jitted when traceable; custom callables using numpy /
+    host operations (allowed by the documented contract) fall back to the
+    eager call permanently."""
+    if fn in _SCALE_EAGER:
+        return fn(*args)
+    try:
+        jitted = _SCALE_JIT.get(fn)
+        if jitted is None:
+            jitted = jax.jit(fn)
+            _SCALE_JIT[fn] = jitted
+        return jitted(*args)
+    except TypeError:
+        # unhashable/unweakrefable callable: just run it eagerly once
+        return fn(*args)
+    except Exception:
+        # not jit-traceable (numpy ops, value-dependent branching):
+        # remember and run eagerly from now on
+        try:
+            _SCALE_EAGER.add(fn)
+        except TypeError:
+            pass
+        return fn(*args)
+
 Array = jnp.ndarray
 
 
@@ -147,7 +182,8 @@ class AdaptivePNormDistance(PNormDistance):
 
     def _fit(self, t: int, data: Array):
         """Refit weights on-device, store host-side (distance.py:268-330)."""
-        scale = np.asarray(self.scale_function(data, jnp.asarray(self._x0_flat)))
+        scale = np.asarray(_apply_scale(
+            self.scale_function, data, jnp.asarray(self._x0_flat)))
         with np.errstate(divide="ignore"):
             w = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-30), 0.0)
         if self.max_weight_ratio is not None:
@@ -264,7 +300,7 @@ class AdaptiveAggregatedDistance(AggregatedDistance):
             [d.compute(data, obs, d.get_params(t)) for d in self.distances],
             axis=-1,
         )  # [N, n_dist]
-        scale = np.asarray(self.scale_function(vals, None))
+        scale = np.asarray(_apply_scale(self.scale_function, vals, None))
         with np.errstate(divide="ignore"):
             w = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-30), 1.0)
         self.weights[t] = w.astype(np.float32)

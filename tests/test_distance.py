@@ -181,3 +181,32 @@ def test_negative_binomial_kernel():
     x = {"y": jnp.asarray([5.0])}
     assert float(k(x, x0)[0]) == pytest.approx(
         ss.nbinom.logpmf(3, 5.0, 0.5), abs=1e-3)
+
+
+def test_custom_numpy_scale_function_falls_back_eager():
+    """The documented custom-callable contract allows numpy/host
+    operations; such functions must run eagerly (the jit fast path is an
+    internal optimization, not a contract change)."""
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.sumstat import SumStatSpec
+
+    calls = []
+
+    def np_scale(data, x_0=None):
+        data = np.asarray(data)       # TracerArrayConversionError under jit
+        calls.append(data.shape)
+        return np.nanstd(data, axis=0)
+
+    d = pt.AdaptivePNormDistance(p=2, scale_function=np_scale)
+    x0 = {"y": jnp.asarray([0.0, 0.0])}
+    spec = SumStatSpec.from_example(x0)
+    d.bind(spec, x0)
+    data = jnp.asarray(np.random.default_rng(0).normal(size=(64, 2)),
+                       dtype=jnp.float32)
+    d._fit(0, data)
+    d._fit(1, data)                   # second call takes the eager path too
+    assert len(calls) >= 2
+    w = d.weights[1]
+    assert w.shape == (2,) and np.isfinite(w).all() and (w > 0).all()
