@@ -43,18 +43,28 @@ _SCALE_EAGER: "weakref.WeakSet" = weakref.WeakSet()
 def _apply_scale(fn: Callable, *args):
     """Call ``fn`` jitted when traceable; custom callables using numpy /
     host operations (allowed by the documented contract) fall back to the
-    eager call permanently."""
-    if fn in _SCALE_EAGER:
-        return fn(*args)
+    eager call permanently.
+
+    Exception discipline: JAX's tracer/concretization errors SUBCLASS
+    TypeError, so hashability is probed separately — a blanket
+    ``except TypeError`` around the jitted call would shadow the
+    remember-as-eager branch and re-trace the failing fn every call.
+    """
     try:
-        jitted = _SCALE_JIT.get(fn)
-        if jitted is None:
-            jitted = jax.jit(fn)
-            _SCALE_JIT[fn] = jitted
-        return jitted(*args)
+        known_eager = fn in _SCALE_EAGER
     except TypeError:
-        # unhashable/unweakrefable callable: just run it eagerly once
+        return fn(*args)  # unhashable callable: always eager
+    if known_eager:
         return fn(*args)
+    jitted = _SCALE_JIT.get(fn)
+    if jitted is None:
+        jitted = jax.jit(fn)
+        try:
+            _SCALE_JIT[fn] = jitted
+        except TypeError:
+            pass  # unweakrefable (e.g. a ufunc): uncached jit still works
+    try:
+        return jitted(*args)
     except Exception:
         # not jit-traceable (numpy ops, value-dependent branching):
         # remember and run eagerly from now on
@@ -154,7 +164,8 @@ class AdaptivePNormDistance(PNormDistance):
                  adaptive: bool = True,
                  scale_function: Union[str, Callable] = median_absolute_deviation,
                  normalize_weights: bool = True,
-                 max_weight_ratio: Optional[float] = None):
+                 max_weight_ratio: Optional[float] = None,
+                 log_file: Optional[str] = None):
         super().__init__(p=p, weights=None, factors=factors)
         self.adaptive = adaptive
         if isinstance(scale_function, str):
@@ -162,6 +173,9 @@ class AdaptivePNormDistance(PNormDistance):
         self.scale_function = scale_function
         self.normalize_weights = normalize_weights
         self.max_weight_ratio = max_weight_ratio
+        #: side-channel JSON trajectory of the per-generation weights
+        #: (reference distance.py:359-363)
+        self.log_file = log_file
         self._x0_flat: Optional[np.ndarray] = None
 
     def _on_bind(self, x_0):
@@ -193,6 +207,9 @@ class AdaptivePNormDistance(PNormDistance):
         if self.normalize_weights and w.sum() > 0:
             w = w * w.size / w.sum()
         self.weights[t] = w.astype(np.float32)
+        if self.log_file:
+            from ..storage import save_dict_to_json
+            save_dict_to_json(self.weights, self.log_file)
 
     def get_config(self):
         return {
