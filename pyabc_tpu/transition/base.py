@@ -211,9 +211,25 @@ class AggregatedTransition(Transition):
     """
 
     def __init__(self, mapping: dict):
-        """``mapping: {(start, stop): Transition}`` over theta columns."""
+        """``mapping: {(start, stop): Transition}`` over theta columns.
+
+        The slices must tile the parameter columns contiguously from 0
+        (no gaps, no overlaps): a gap would silently misalign the
+        composed proposal columns against the per-slice density
+        evaluation.  Iteration is ALWAYS in ascending column order, so
+        insertion order of the dict does not matter."""
         super().__init__()
         self.mapping = dict(mapping)
+        slices = sorted(self.mapping)
+        expected_start = 0
+        for a, b in slices:
+            if b <= a:
+                raise ValueError(f"empty mapping slice ({a}, {b})")
+            if a != expected_start:
+                raise ValueError(
+                    f"mapping slices must tile columns contiguously from "
+                    f"0; got {slices} (gap/overlap at column {a})")
+            expected_start = b
 
     def _fit(self, theta, w):
         for (a, b), sub in self.mapping.items():
@@ -228,12 +244,42 @@ class AggregatedTransition(Transition):
         return {f"{a}:{b}": sub.pad_params(params[f"{a}:{b}"], n_pad)
                 for (a, b), sub in self.mapping.items()}
 
+    def static_fns(self):
+        """Compose the sub-transitions' static kernels so aggregated
+        proposals run inside the compiled round (the base implementation
+        would dispatch to the abstract ``rvs_from_params``).  The column
+        slices and sub-transition classes are static structure; only the
+        nested params flow through tracing.  Closures are created ONCE
+        per RoundKernel (static_fns is called at kernel construction), so
+        jit caching stays stable."""
+        subs = sorted(
+            ((a, b, sub.static_fns()) for (a, b), sub in
+             self.mapping.items()),
+            key=lambda item: item[0])
+
+        def rvs_from_params(key, params: dict, n: int):
+            cols = []
+            for i, (a, b, (sub_rvs, _)) in enumerate(subs):
+                cols.append(jnp.atleast_2d(sub_rvs(
+                    jax.random.fold_in(key, i), params[f"{a}:{b}"], n)))
+            return jnp.concatenate(cols, axis=-1)
+
+        def log_pdf_from_params(x, params: dict):
+            total = jnp.zeros(x.shape[0])
+            for a, b, (_, sub_lp) in subs:
+                total = total + sub_lp(x[:, a:b], params[f"{a}:{b}"])
+            return total
+
+        return (rvs_from_params, log_pdf_from_params)
+
     def rvs(self, key, size: Optional[int] = None):
         self._check_fitted()
         n = 1 if size is None else size
-        keys = jax.random.split(key, len(self.mapping))
+        items = sorted(self.mapping.items())  # ascending column order,
+        # matching the composed static kernel regardless of dict insertion
+        keys = jax.random.split(key, len(items))
         cols = []
-        for k, ((a, b), sub) in zip(keys, self.mapping.items()):
+        for k, ((a, b), sub) in zip(keys, items):
             cols.append(jnp.atleast_2d(sub.rvs(k, n)))
         out = jnp.concatenate(cols, axis=-1)
         return out[0] if size is None else out
@@ -242,6 +288,6 @@ class AggregatedTransition(Transition):
         self._check_fitted()
         x2 = jnp.atleast_2d(jnp.asarray(x, dtype=jnp.float32))
         total = jnp.zeros(x2.shape[0])
-        for (a, b), sub in self.mapping.items():
+        for (a, b), sub in sorted(self.mapping.items()):
             total = total + sub.log_pdf(x2[:, a:b])
         return total[0] if jnp.ndim(x) == 1 else total
