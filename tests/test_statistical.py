@@ -186,3 +186,40 @@ def test_adaptive_population_size_power_law_inversion():
                                       max_population_size=10**6)
     tight.update([tr], [1.0])
     assert tight.nr_particles > 512, tight.nr_particles
+
+
+def test_binomial_kernel_stochastic_triple_e2e():
+    """A DISCRETE stochastic kernel through the exact-likelihood triple:
+    infer a binomial success count n from observed draws k ~ Binom(n, p)
+    (reference kernel.py:372-432 + its pdf_max over admissible n)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import pyabc_tpu as pt
+
+    p_success = 0.4
+    true_n = 20
+    rng = np.random.default_rng(0)
+    observed_k = float(rng.binomial(true_n, p_success))
+
+    def model(key, theta):
+        # simulate the candidate n (rounded); the kernel evaluates
+        # Binom(k_obs | n, p) exactly
+        return {"n": jnp.maximum(jnp.round(theta[:, 0]), 0.0)}
+
+    abc = pt.ABCSMC(
+        models=pt.SimpleModel(model),
+        parameter_priors=pt.Distribution(n=pt.RV("uniform", 0.0, 60.0)),
+        distance_function=pt.BinomialKernel(p=p_success),
+        population_size=400,
+        eps=pt.Temperature(),
+        acceptor=pt.StochasticAcceptor(),
+        sampler=pt.VectorizedSampler(),
+        seed=4)
+    abc.new("sqlite://", {"n": observed_k})
+    h = abc.run(max_nr_populations=4)
+    df, w = h.get_distribution()
+    mean_n = float(np.sum(df["n"].to_numpy() * w))
+    # posterior over n given one observed k concentrates near k/p
+    assert abs(mean_n - observed_k / p_success) < 6.0, mean_n
