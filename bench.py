@@ -133,11 +133,11 @@ def bench_northstar():
         population_size=NORTHSTAR_POP,
         eps=pt.ConstantEpsilon(0.2),
         # bounded fused dispatches: the remote-TPU relay kills multi-minute
-        # XLA programs; with the deferred-proposal rounds (~0.3 s each) 8
-        # rounds per call stays a ~3 s program while amortizing the relay's
-        # per-call sync constant
+        # XLA programs; with the deferred-proposal rounds (~0.1 s each) 16
+        # rounds per call stays a ~2 s program while amortizing the relay's
+        # per-call sync constant (measured ~0.6 s/gen over 8 rounds/call)
         sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
-                                     max_rounds_per_call=8),
+                                     max_rounds_per_call=16),
         seed=0)
     abc.new("sqlite://", observed)
     # warmup = calibration + prior gen + one full KDE generation (compiles)
