@@ -25,9 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..sumstat import SumStatSpec
 from .base import Distance, to_distance
-from .scale import SCALE_FUNCTIONS, median_absolute_deviation, standard_deviation
+from .scale import SCALE_FUNCTIONS, median_absolute_deviation
 
 #: jitted scale functions, weakly cached by function identity: the scale
 #: math is a chain of reductions whose EAGER per-op dispatches each pay

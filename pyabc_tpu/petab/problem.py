@@ -17,7 +17,7 @@ escape hatch for models outside the SBML subset.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import jax.numpy as jnp
 import numpy as np
@@ -26,7 +26,7 @@ from ..distance.kernel import SCALE_LOG, SimpleFunctionKernel
 from ..model import Model
 from .base import LIN, LOG, LOG10, PetabImporter
 from .ode import LLH
-from .sbml import ExprError, SBMLModel, eval_expr, expr_names, parse_sbml
+from .sbml import ExprError, SBMLModel, eval_expr, parse_sbml
 
 Array = jnp.ndarray
 
@@ -242,7 +242,7 @@ class PetabSBMLModel(Model):
         times = np.linspace(0.0, self._t_max, self.n_steps + 1)
         return times, full, env
 
-    def _observable_series(self, obs_id: str, times, full, env, row=None):
+    def _observable_series(self, obs_id: str, full, env, row=None):
         """Evaluate the observable formula over the trajectory -> [N, T+1].
         ``observableParameter{n}_{obsId}`` placeholders resolve from the
         measurement row's observableParameters column."""
@@ -309,7 +309,7 @@ class PetabSBMLModel(Model):
                     series = series_cache[oid]
                 else:
                     series = self._observable_series(
-                        oid, times, full, cenv, row)
+                        oid, full, cenv, row)
                     if not has_op:
                         series_cache[oid] = series
                 # linear interpolation at the measurement time

@@ -27,7 +27,7 @@ import ast
 import math
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 import jax.numpy as jnp
 

@@ -17,13 +17,11 @@ inside the loop, and compaction preserves (round, lane) order.
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-
-from .base import RoundResult
 
 Array = jnp.ndarray
 

@@ -24,8 +24,7 @@ compilation serves every generation.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

@@ -21,7 +21,7 @@ acceptance rate (adaptive over-provisioning, SURVEY.md §7 hard part #1).
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Tuple
 
 import jax
 import numpy as np
