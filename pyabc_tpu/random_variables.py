@@ -258,6 +258,235 @@ class Poisson(RVBase):
         return x * jnp.log(self.mu) - self.mu - gammaln(x + 1.0)
 
 
+class T(RVBase):
+    """Student's t with ``df`` degrees of freedom (scipy.stats.t)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = jnp.float32(df)
+        self.loc = jnp.float32(loc)
+        self.scale = jnp.float32(scale)
+
+    def sample(self, key, shape=()):
+        return self.loc + self.scale * jax.random.t(key, self.df, shape)
+
+    def log_pdf(self, x):
+        return jstats.t.logpdf(x, self.df, self.loc, self.scale)
+
+    def cdf(self, x):
+        # symmetric incomplete-beta form: F(t) = 1 − I_{ν/(ν+t²)}(ν/2, ½)/2
+        z = (x - self.loc) / self.scale
+        tail = 0.5 * betainc(self.df / 2, 0.5,
+                             self.df / (self.df + z**2))
+        return jnp.where(z >= 0, 1.0 - tail, tail)
+
+
+class Chi2(RVBase):
+    """Chi-squared with ``df`` degrees of freedom (scipy.stats.chi2)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = jnp.float32(df)
+        self.loc = jnp.float32(loc)
+        self.scale = jnp.float32(scale)
+
+    def sample(self, key, shape=()):
+        return self.loc + self.scale * 2.0 * jax.random.gamma(
+            key, self.df / 2.0, shape)
+
+    def log_pdf(self, x):
+        return jstats.chi2.logpdf(x, self.df, self.loc, self.scale)
+
+    def cdf(self, x):
+        z = (x - self.loc) / self.scale
+        return gammainc(self.df / 2.0, jnp.maximum(z, 0.0) / 2.0)
+
+
+class WeibullMin(RVBase):
+    """Weibull with shape ``c`` (scipy.stats.weibull_min convention)."""
+
+    def __init__(self, c, loc=0.0, scale=1.0):
+        self.c = jnp.float32(c)
+        self.loc = jnp.float32(loc)
+        self.scale = jnp.float32(scale)
+
+    def sample(self, key, shape=()):
+        # inverse-cdf: X = scale·(−ln U)^{1/c}
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+        return self.loc + self.scale * (-jnp.log(u)) ** (1.0 / self.c)
+
+    def log_pdf(self, x):
+        z = (x - self.loc) / self.scale
+        safe = jnp.maximum(z, 1e-38)
+        val = (jnp.log(self.c / self.scale) + (self.c - 1.0) * jnp.log(safe)
+               - safe**self.c)
+        return jnp.where(z > 0, val, -jnp.inf)
+
+    def cdf(self, x):
+        z = jnp.maximum((x - self.loc) / self.scale, 0.0)
+        return 1.0 - jnp.exp(-(z**self.c))
+
+
+class Binom(RVBase):
+    """Binomial(n, p) (scipy.stats.binom)."""
+
+    discrete = True
+
+    def __init__(self, n, p):
+        self.n = jnp.float32(n)
+        self.p = jnp.float32(p)
+
+    def sample(self, key, shape=()):
+        return jax.random.binomial(key, self.n, self.p, shape=shape).astype(
+            jnp.float32)
+
+    def log_pdf(self, x):
+        from jax.scipy.special import xlog1py, xlogy
+        k = jnp.round(x)
+        # xlogy/xlog1py: 0·log 0 = 0, so degenerate p ∈ {0, 1} stays exact
+        logp = (gammaln(self.n + 1.0) - gammaln(k + 1.0)
+                - gammaln(self.n - k + 1.0)
+                + xlogy(k, self.p) + xlog1py(self.n - k, -self.p))
+        ok = (x == k) & (k >= 0) & (k <= self.n)
+        return jnp.where(ok, logp, -jnp.inf)
+
+    def cdf(self, x):
+        k = jnp.clip(jnp.floor(x), -1.0, self.n)
+        # P(X ≤ k) = I_{1−p}(n−k, k+1)
+        val = betainc(jnp.maximum(self.n - k, 1e-7), k + 1.0, 1.0 - self.p)
+        return jnp.where(k < 0, 0.0, jnp.where(k >= self.n, 1.0, val))
+
+
+class Nbinom(RVBase):
+    """Negative binomial (failures before the n-th success;
+    scipy.stats.nbinom convention)."""
+
+    discrete = True
+
+    def __init__(self, n, p):
+        self.n = jnp.float32(n)
+        self.p = jnp.float32(p)
+
+    def sample(self, key, shape=()):
+        # gamma–Poisson mixture: λ ~ Gamma(n, (1−p)/p), X ~ Poisson(λ)
+        k1, k2 = jax.random.split(key)
+        lam = jax.random.gamma(k1, self.n, shape) * (1.0 - self.p) / self.p
+        return jax.random.poisson(k2, lam, shape).astype(jnp.float32)
+
+    def log_pdf(self, x):
+        from jax.scipy.special import xlog1py, xlogy
+        k = jnp.round(x)
+        logp = (gammaln(k + self.n) - gammaln(self.n) - gammaln(k + 1.0)
+                + xlogy(self.n, self.p) + xlog1py(k, -self.p))
+        ok = (x == k) & (k >= 0)
+        return jnp.where(ok, logp, -jnp.inf)
+
+    def cdf(self, x):
+        k = jnp.floor(x)
+        # P(X ≤ k) = I_p(n, k+1)
+        return jnp.where(k < 0, 0.0,
+                         betainc(self.n, jnp.maximum(k, 0.0) + 1.0, self.p))
+
+
+class ScipyRV(RVBase):
+    """Host-evaluated fallback wrapping ANY ``scipy.stats`` distribution.
+
+    Parity: the reference ``RV`` resolves arbitrary scipy.stats names
+    (pyabc/random_variables.py:147-169, picklable shims at :27-32).  The
+    TPU-native families above cover the hot paths; everything else runs on
+    the HOST through ``jax.pure_callback`` — one batched callback per
+    compiled round (same containment pattern as ``HostFunctionModel``,
+    external/base.py), not one call per particle.  A ScipyRV prior
+    therefore pays a host round-trip inside each round; see
+    docs/performance.md for the caveat.
+    """
+
+    #: lazy probe result: does the default backend support compiled host
+    #: callbacks?  (the axon TPU relay does NOT — pure_callback raises
+    #: UNIMPLEMENTED inside jit there; CPU/GPU/direct-TPU do)
+    _callbacks_supported: Optional[bool] = None
+
+    def __init__(self, name: str, *args, **kwargs):
+        import scipy.stats as ss
+
+        dist = getattr(ss, name, None)
+        if dist is None or not hasattr(dist, "rvs"):
+            raise ValueError(f"'{name}' is not a scipy.stats distribution")
+        self.name = name
+        self.args = args
+        self.kwargs = kwargs
+        self._frozen = dist(*args, **kwargs)
+        self.discrete = not hasattr(self._frozen.dist, "pdf")
+
+    @classmethod
+    def _check_backend(cls):
+        """Fail FAST with a clear message on backends without host-callback
+        support (notably the axon TPU relay), instead of an opaque
+        UNIMPLEMENTED from deep inside the compiled round."""
+        if cls._callbacks_supported is None:
+            try:
+                import numpy as _np
+                jax.jit(lambda: jax.pure_callback(
+                    lambda: _np.float32(1.0),
+                    jax.ShapeDtypeStruct((), jnp.float32)))()
+                cls._callbacks_supported = True
+            except Exception:
+                cls._callbacks_supported = False
+        if not cls._callbacks_supported:
+            raise RuntimeError(
+                "ScipyRV needs a JAX backend with host-callback support "
+                "(jax.pure_callback); the current default backend has "
+                "none (the axon TPU relay is a known case).  Use one of "
+                "the TPU-native families instead "
+                f"({sorted(_SCIPY_NAME_MAP)}), or run on CPU.")
+
+    def __reduce__(self):  # picklable shim, reference :27-32
+        return (type(self), (self.name, *self.args),
+                {"kwargs": self.kwargs})
+
+    def __setstate__(self, state):
+        if state.get("kwargs"):
+            self.__init__(self.name, *self.args, **state["kwargs"])
+
+    def sample(self, key, shape=()):
+        self._check_backend()
+        bits = jax.random.key_data(key).ravel()[-2:].astype(jnp.uint32)
+
+        def host_rvs(b):
+            seed = (int(b[0]) << 32) | int(b[1])
+            rng = __import__("numpy").random.default_rng(seed)
+            out = self._frozen.rvs(size=shape or (1,), random_state=rng)
+            import numpy as np
+            return np.asarray(out, dtype=np.float32).reshape(shape)
+
+        return jax.pure_callback(
+            host_rvs, jax.ShapeDtypeStruct(shape, jnp.float32), bits,
+            vmap_method="sequential")
+
+    def _host_eval(self, fn, x):
+        self._check_backend()
+        import numpy as np
+
+        def host(xv):
+            with np.errstate(all="ignore"):
+                out = fn(np.asarray(xv, dtype=np.float64))
+            return np.asarray(out, dtype=np.float32).reshape(np.shape(xv))
+
+        x = jnp.asarray(x, jnp.float32)
+        return jax.pure_callback(
+            host, jax.ShapeDtypeStruct(jnp.shape(x), jnp.float32), x,
+            vmap_method="expand_dims")
+
+    def log_pdf(self, x):
+        f = (self._frozen.logpmf if self.discrete else self._frozen.logpdf)
+        return self._host_eval(f, x)
+
+    def cdf(self, x):
+        return self._host_eval(self._frozen.cdf, x)
+
+    def get_config(self) -> dict:
+        return {"name": self.name, "args": list(map(float, self.args)),
+                "kwargs": {k: float(v) for k, v in self.kwargs.items()}}
+
+
 class RVDecorator(RVBase):
     """Base class for decorators around a component RV (reference
     random_variables.py:470-536): delegates the full RV surface to
@@ -349,6 +578,11 @@ _SCIPY_NAME_MAP = {
     "beta": Beta,
     "randint": Randint,
     "poisson": Poisson,
+    "t": T,
+    "chi2": Chi2,
+    "weibull_min": WeibullMin,
+    "binom": Binom,
+    "nbinom": Nbinom,
 }
 
 
@@ -356,18 +590,24 @@ def RV(name: Union[str, RVBase], *args, **kwargs) -> RVBase:
     """Factory with reference API parity: ``RV("norm", 0, 1)``.
 
     The reference resolves names against scipy.stats
-    (pyabc/random_variables.py:147-169); here they resolve to the JAX-native
-    classes above.
+    (pyabc/random_variables.py:147-169).  Here the common families resolve
+    to the JAX-native classes above (fully on-device); any OTHER
+    scipy.stats name falls back to :class:`ScipyRV`, which evaluates on
+    the host through ``pure_callback`` — full API parity at a
+    per-round host-callback cost (see docs/performance.md).
     """
     if isinstance(name, RVBase):
         return name
+    cls = _SCIPY_NAME_MAP.get(name)
+    if cls is not None:
+        return cls(*args, **kwargs)
     try:
-        cls = _SCIPY_NAME_MAP[name]
-    except KeyError:
+        return ScipyRV(name, *args, **kwargs)
+    except ValueError:
         raise ValueError(
-            f"unknown RV '{name}'; available: {sorted(_SCIPY_NAME_MAP)}"
+            f"unknown RV '{name}': not a native family "
+            f"({sorted(_SCIPY_NAME_MAP)}) nor a scipy.stats distribution"
         ) from None
-    return cls(*args, **kwargs)
 
 
 class Distribution:

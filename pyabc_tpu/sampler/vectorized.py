@@ -53,6 +53,10 @@ class VectorizedSampler(Sampler):
         self._jit = jit
         self._compiled: Dict[Tuple, Callable] = {}
         self._shape_cache: Dict[Tuple, Tuple[int, int]] = {}
+        #: live carry buffers per compiled loop, reused across generations
+        #: (allocating them fresh cost ~1.9 s/generation at pop 1e6
+        #: through the relay; a reset is an O(1) cursor rewind)
+        self._states: Dict[Tuple, object] = {}
         #: acceptance-rate estimate carried across generations
         self._rate_est = 1.0
 
@@ -78,14 +82,15 @@ class VectorizedSampler(Sampler):
         else:
             raw = self._raw_round(round_fn, B)
             weight_fn = None
-        start, step, finalize, harvest = build_stateful_loop(
+        start, step, finalize, harvest, reset = build_stateful_loop(
             raw, B, n_target, self.max_rounds_per_call, record_cap, d, s,
             weight_correction=weight_fn)
         if self._jit:
             # donate the carry so the cap-sized buffers update in place
             return (jax.jit(start), jax.jit(step, donate_argnums=(2,)),
-                    jax.jit(finalize), jax.jit(harvest))
-        return start, step, finalize, harvest
+                    jax.jit(finalize), jax.jit(harvest),
+                    jax.jit(reset, donate_argnums=(0,)))
+        return start, step, finalize, harvest, reset
 
     @staticmethod
     def _fn_id(round_fn: Callable):
@@ -107,10 +112,14 @@ class VectorizedSampler(Sampler):
                                         int(shapes.stats.shape[1]))
         return self._shape_cache[fn_id]
 
+    def _cache_key(self, kind: str, round_fn: Callable, B: int, extra,
+                   static_kwargs) -> Tuple:
+        return (kind, self._fn_id(round_fn), B, extra,
+                tuple(sorted(static_kwargs.items())))
+
     def _get(self, kind: str, round_fn: Callable, B: int, *extra,
              **static_kwargs) -> Callable:
-        cache_key = (kind, self._fn_id(round_fn), B, extra,
-                     tuple(sorted(static_kwargs.items())))
+        cache_key = self._cache_key(kind, round_fn, B, extra, static_kwargs)
         if cache_key not in self._compiled:
             if kind == "round":
                 self._compiled[cache_key] = self._build(
@@ -123,6 +132,37 @@ class VectorizedSampler(Sampler):
     def _round_to_valid_batch(self, b: float) -> int:
         return int(np.clip(_pow2_at_least(b), self.min_batch_size,
                            self.max_batch_size))
+
+    #: finalize-prefetch budget for DEFERRED mode: a mispredicted prefetch
+    #: pays (and discards) the proposal-density KDE over the accepted
+    #: buffer, so prefetch only when that costs well under a relay
+    #: round-trip (~7e10 pairs ≈ 0.2 s).  With the grid-compressed 1-D
+    #: pdf support (transition/multivariatenormal.py) the 1e6 north star
+    #: sits at ~3e10 — comfortably inside.
+    MAX_PREFETCH_PAIRS = 1 << 36
+
+    @classmethod
+    def _deferred_finalize_pairs(cls, params, n_target: int) -> float:
+        """Estimated pair-work of one deferred-mode finalize: queries
+        (n_target) x total pdf-support rows across all models, read from
+        the params pytree structure (c_support when compressed)."""
+        rows = 0
+
+        def walk(p):
+            nonlocal rows
+            if not isinstance(p, dict):
+                return
+            if "c_support" in p:
+                rows += p["c_support"].shape[0]
+            elif "support" in p:
+                rows += p["support"].shape[0]
+            else:
+                for v in p.values():
+                    walk(v)
+
+        for model_params in params.get("transition", ()):
+            walk(model_params)
+        return float(n_target) * rows
 
     # ---- the contract ----------------------------------------------------
 
@@ -194,10 +234,19 @@ class VectorizedSampler(Sampler):
                     round_fn.__self__.proposal_log_density)
             jitted = self._compiled[key_fn]
             record_density_fn = lambda m, th: jitted(m, th, params)  # noqa: E731
+        # in DEFERRED mode finalize contains the proposal-density KDE over
+        # the accepted buffer; a mispredicted prefetch pays (and discards)
+        # it, so prefetch only when that work is small — which the
+        # grid-compressed pdf support makes the common case
+        prefetch_ok = (not defer or self._deferred_finalize_pairs(
+            params, n) <= self.MAX_PREFETCH_PAIRS)
         d, s = self._round_shape(round_fn, B, params)
-        start, step, finalize, harvest = self._get(
+        loop_key = self._cache_key(
+            "sloop", round_fn, B, (n, record_cap, d, s, defer), {})
+        start, step, finalize, harvest, reset = self._get(
             "sloop", round_fn, B, n, record_cap, d, s, defer)
-        state = start()
+        prev_state = self._states.pop(loop_key, None)
+        state = start() if prev_state is None else reset(prev_state)
         call_idx = 0
         count = rounds = 0
         out = None
@@ -218,13 +267,12 @@ class VectorizedSampler(Sampler):
             # finish the generation (the common single-call case), fetch
             # the finalized buffers directly — count/rounds ride along, so
             # no separate scalar round-trip.  Otherwise sync just the
-            # scalars; the buffers stay device-resident.  In DEFERRED mode
-            # finalize contains the full-population proposal-density KDE,
-            # so a mispredicted prefetch would pay (and discard) the
-            # dominant op — there, only finalize on a known-complete count.
+            # scalars; the buffers stay device-resident.  (``prefetch_ok``
+            # gates the deferred-mode case on the finalize KDE being
+            # cheap — see above.)
             expected = count + B * self.max_rounds_per_call * self._rate_est
             out = None
-            if expected >= n and not defer:
+            if expected >= n and prefetch_ok:
                 fetch = [finalize(state, params)]
                 if rec is not None:
                     fetch.append(rec["rec_count"])
@@ -262,6 +310,12 @@ class VectorizedSampler(Sampler):
             out = None  # mis-predicted prefetch: discard, keep sampling
         if out is None:
             out = fetch_to_host(finalize(state, params))
+        # keep the carry buffers alive for the next generation's reset;
+        # bound the cache so states orphaned by a batch-ladder change
+        # don't pin device memory
+        self._states[loop_key] = state
+        while len(self._states) > 4:
+            self._states.pop(next(iter(self._states)))
         sample.append_device_batch(out, rounds * B)
         if bar is not None:
             bar.finish()

