@@ -97,12 +97,18 @@ def _timed_generations(abc, pop, warmup, timed=3):
     pops = abc.history.get_all_populations().sort_values("t")
     ends = pd.to_datetime(pops.population_end_time)
     dur = ends.diff().dt.total_seconds()
-    times = dur[np.asarray(pops.t) >= warmup].tolist()
+    sel = np.asarray(pops.t) >= warmup
+    times = dur[sel].tolist()
     if not times:
         raise RuntimeError("no timed generations completed "
                            "(run stopped during warmup)")
     med = float(np.median(times))
-    return pop / med, med, [round(t, 2) for t in times]
+    # model-evaluation throughput rides along so regressions in the
+    # evaluation pipeline are machine-visible even when the acceptance
+    # rate drifts (VERDICT r3 #7)
+    evals = np.asarray(pops.samples)[sel]
+    evals_per_sec = float(np.median(evals / np.asarray(times)))
+    return pop / med, med, [round(t, 2) for t in times], evals_per_sec
 
 
 def bench_primary():
@@ -117,9 +123,9 @@ def bench_primary():
         sampler=pt.VectorizedSampler(max_batch_size=1 << 20),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, _, times = _timed_generations(
+    rate, _, times, evals_ps = _timed_generations(
         abc, POP, WARMUP_GENERATIONS, TIMED_GENERATIONS)
-    return rate, times
+    return rate, times, evals_ps
 
 
 def bench_northstar():
@@ -141,10 +147,12 @@ def bench_northstar():
         seed=0)
     abc.new("sqlite://", observed)
     # warmup = calibration + prior gen + one full KDE generation (compiles)
-    rate, s_per_gen, times = _timed_generations(abc, NORTHSTAR_POP, 2, 3)
+    rate, s_per_gen, times, evals_ps = _timed_generations(
+        abc, NORTHSTAR_POP, 2, 3)
     return {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
             "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2),
-            "northstar_pop1e6_gen_times_s": times}
+            "northstar_pop1e6_gen_times_s": times,
+            "northstar_pop1e6_evals_per_sec": round(evals_ps, 1)}
 
 
 def bench_kde_1e6():
@@ -176,8 +184,14 @@ def bench_kde_1e6():
         ts.append(time.perf_counter() - t0)
         assert np.isfinite(s)
     dt = float(np.median(ts))
+    gpairs = n * n / dt / 1e9
+    # MFU: the fused Pallas kernel runs a 128-lane augmented matmul as a
+    # bf16x3 split -> pairs x 128 x 2 flops x 3 passes vs the v5e chip's
+    # 197 Tflop/s bf16 peak (docs/performance.md roofline section)
+    pct_peak = gpairs * 1e9 * 128 * 2 * 3 / 197e12 * 100
     return {"kde_1e6x1e6_logpdf_s": round(dt, 2),
-            "kde_1e6x1e6_gpairs_per_sec": round(n * n / dt / 1e9, 1),
+            "kde_1e6x1e6_gpairs_per_sec": round(gpairs, 1),
+            "kde_1e6x1e6_pct_bf16_peak": round(pct_peak, 1),
             "kde_1e6x1e6_times_s": [round(t, 2) for t in ts]}
 
 
@@ -196,14 +210,65 @@ def _bench_problem(make_problem, pop, prefix):
                                      max_batch_size=1 << 19),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, s_per_gen, times = _timed_generations(abc, pop, 2, 3)
+    rate, s_per_gen, times, evals_ps = _timed_generations(abc, pop, 2, 3)
     return {f"{prefix}_accepted_per_sec": round(rate, 1),
             f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 2),
-            f"{prefix}_gen_times_s": times}
+            f"{prefix}_gen_times_s": times,
+            f"{prefix}_evals_per_sec": round(evals_ps, 1)}
 
 
 SUB_BENCHES = ("kde_1e6", "northstar", "lotka_volterra", "sir",
-               "petab_ode", "sharded_mesh1", "sharded_cpu8")
+               "petab_ode", "sharded_mesh1", "ab_vec_sharded",
+               "sharded_cpu8")
+
+
+def bench_ab_vec_vs_sharded():
+    """Same-session A/B: VectorizedSampler vs ShardedSampler(mesh=1) on
+    the identical problem/population, gen blocks INTERLEAVED in ONE
+    process so the relay weather (±30-40 % across runs, BASELINE.md)
+    cancels out of the comparison (VERDICT r3 #2).
+
+    Each sampler runs a compile/warmup segment, then two timed blocks in
+    A/B/A/B order via history resume; the first generation of each
+    resumed block is dropped (it carries the resume re-init)."""
+    import pandas as pd
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.parallel.mesh import make_mesh
+
+    def build(sampler):
+        models, priors, distance, observed, _ = make_two_gaussians_problem()
+        abc = pt.ABCSMC(models, priors, distance, population_size=POP,
+                        eps=pt.ConstantEpsilon(0.2), sampler=sampler,
+                        seed=0)
+        abc.new("sqlite://", observed)
+        return abc
+
+    abcs = {"vec": build(pt.VectorizedSampler(max_batch_size=1 << 20)),
+            "sharded": build(pt.ShardedSampler(mesh=make_mesh(),
+                                               max_batch_size=1 << 20))}
+    warm = 3
+    for abc in abcs.values():  # compile + warmup
+        abc.run(max_nr_populations=1 + warm)
+    times = {k: [] for k in abcs}
+    for _ in range(2):  # interleaved timed blocks
+        for name, abc in abcs.items():
+            t_before = abc.history.max_t
+            abc.run(max_nr_populations=3)
+            pops = abc.history.get_all_populations().sort_values("t")
+            ends = pd.to_datetime(pops.population_end_time)
+            dur = dict(zip(pops.t, ends.diff().dt.total_seconds()))
+            # drop the block's first gen (resume re-init is billed there)
+            times[name] += [dur[t] for t in range(t_before + 2,
+                                                  abc.history.max_t + 1)]
+    med = {k: float(np.median(v)) for k, v in times.items()}
+    return {"ab_vec_s_per_gen": round(med["vec"], 3),
+            "ab_sharded1_s_per_gen": round(med["sharded"], 3),
+            "ab_vec_over_sharded": round(med["vec"] / med["sharded"], 3),
+            "ab_vec_gen_times_s": [round(t, 3) for t in times["vec"]],
+            "ab_sharded1_gen_times_s": [round(t, 3)
+                                        for t in times["sharded"]]}
 
 
 def bench_sharded(pop: int, prefix: str) -> dict:
@@ -226,11 +291,12 @@ def bench_sharded(pop: int, prefix: str) -> dict:
                                   max_batch_size=1 << 20),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, s_per_gen, times = _timed_generations(
+    rate, s_per_gen, times, evals_ps = _timed_generations(
         abc, pop, WARMUP_GENERATIONS, 3)
     return {f"{prefix}_accepted_per_sec": round(rate, 1),
             f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 3),
             f"{prefix}_gen_times_s": times,
+            f"{prefix}_evals_per_sec": round(evals_ps, 1),
             f"{prefix}_n_devices": len(jax.devices())}
 
 
@@ -248,6 +314,8 @@ def _run_sub(name: str) -> dict:
         return bench_petab_ode()
     if name == "sharded_mesh1":
         return bench_sharded(POP, "sharded_mesh1")
+    if name == "ab_vec_sharded":
+        return bench_ab_vec_vs_sharded()
     if name == "sharded_cpu8":
         return bench_sharded(POP, "sharded_cpu8")
     raise ValueError(name)
@@ -258,8 +326,9 @@ def main():
     _enable_compilation_cache()
 
     _log("bench: primary (pop16384 gaussian mixture)")
-    rate, primary_times = bench_primary()
+    rate, primary_times, primary_evals_ps = bench_primary()
     extra["primary_gen_times_s"] = primary_times
+    extra["primary_evals_per_sec"] = round(primary_evals_ps, 1)
 
     # each sub-bench runs in its OWN process: a TPU-runtime crash in one
     # (e.g. a watchdog kill) must not poison the others or the primary line
@@ -362,10 +431,12 @@ def bench_petab_ode():
                                      max_batch_size=1 << 18),
         seed=0)
     abc.new("sqlite://", importer.get_observed())
-    rate, s_per_gen, times = _timed_generations(abc, PETAB_POP, 2, 3)
+    rate, s_per_gen, times, evals_ps = _timed_generations(
+        abc, PETAB_POP, 2, 3)
     return {"petab_ode_pop100k_accepted_per_sec": round(rate, 1),
             "petab_ode_pop100k_wallclock_s_per_gen": round(s_per_gen, 2),
-            "petab_ode_pop100k_gen_times_s": times}
+            "petab_ode_pop100k_gen_times_s": times,
+            "petab_ode_pop100k_evals_per_sec": round(evals_ps, 1)}
 
 
 def _lv_problem():

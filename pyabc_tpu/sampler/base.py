@@ -157,6 +157,9 @@ class Sample:
         #: NEWLY fitted proposal (reference ``transition_pd``,
         #: smc.py:1022-1032); None -> importance ratio 1
         self.transition_log_pdf = None
+        #: device-resident view of the accepted buffers (m/theta/
+        #: log_weight/count), set by append_device_batch when available
+        self.device_population: Optional[dict] = None
 
     def append_round(self, rr: RoundResult):
         rr = fetch_to_host(rr)
@@ -186,10 +189,23 @@ class Sample:
             })
             self._n_recorded += take.size
 
-    def append_device_batch(self, out: dict, n_evals: int):
+    def append_device_batch(self, out: dict, n_evals: int,
+                            device_view: Optional[dict] = None):
         """Ingest one on-device generation batch (sampler/device_loop.py):
         a single host transfer of the compacted accepted buffers (+ records).
+
+        ``device_view`` optionally carries the same batch's un-fetched
+        device arrays; they are kept on :attr:`device_population` so the
+        orchestrator can build the next generation's transition support
+        ON device (smc.py `_device_support`) instead of re-uploading ~MBs
+        of host-padded support through the relay.
         """
+        if device_view is not None and all(
+                getattr(v, "is_fully_addressable", True)
+                for v in device_view.values()):
+            self.device_population = {
+                k: device_view[k] for k in ("m", "theta", "log_weight")}
+            self.device_population["count"] = device_view["count"]
         out = fetch_to_host(out)  # ONE bulk d2h transfer, not one per key
         self.nr_evaluations += int(n_evals)
         count = int(out["count"])
@@ -197,7 +213,8 @@ class Sample:
         take = min(count, out["m"].shape[0])
         if take:
             self._acc.append({
-                "m": np.asarray(out["m"][:take]),
+                # the device loop narrows m to int8 for the fetch
+                "m": np.asarray(out["m"][:take]).astype(np.int32),
                 "theta": np.asarray(out["theta"][:take]),
                 "distance": np.asarray(out["distance"][:take]),
                 "log_weight": np.asarray(out["log_weight"][:take]),
@@ -381,12 +398,26 @@ class Sample:
 
     def get_all_records(self) -> List[dict]:
         """Reference-compat list-of-dicts view of
-        :meth:`get_records_columns` (reference smc.py:726-737)."""
+        :meth:`get_records_columns` (reference smc.py:726-737).
+
+        COMPAT PATH: building one Python dict per record is O(R) host
+        work — at the 1e6-record scale this stalls for seconds where the
+        column view is instant.  Nothing in this package calls it; a
+        consumer that does gets a loud warning pointing at
+        :meth:`get_records_columns`."""
         cols = self.get_records_columns()
         if cols is None:
             return []
+        n = cols["distance"].shape[0]
+        if n > 100_000:
+            import warnings
+            warnings.warn(
+                f"Sample.get_all_records materializes {n} per-record "
+                "dicts (O(R) Python); use get_records_columns() for "
+                "vectorized access at this scale", RuntimeWarning,
+                stacklevel=2)
         return [{k: v[i].item() for k, v in cols.items()}
-                for i in range(cols["distance"].shape[0])]
+                for i in range(n)]
 
 
 class Sampler:

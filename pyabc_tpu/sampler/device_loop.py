@@ -158,7 +158,10 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
     def finalize(state, params):
         keys = ("m", "theta", "distance", "log_weight", "stats")
         out = {k: state[k][:n_target] for k in keys}
-        out["accepted_mask"] = jnp.arange(n_target) < state["count"]
+        # the model column rides the ~6 MB/s relay as int8 (25 % of the
+        # i32 bytes); the ingest widens it back.  M is bounded far below
+        # 127 (model-selection problems have a handful of models).
+        out["m"] = out["m"].astype(jnp.int8)
         if weight_correction is not None:
             log_denom = weight_correction(out["m"], out["theta"], params)
             # unfilled rows carry -inf partial weights; leave them alone

@@ -271,9 +271,10 @@ class VectorizedSampler(Sampler):
             # gates the deferred-mode case on the finalize KDE being
             # cheap — see above.)
             expected = count + B * self.max_rounds_per_call * self._rate_est
-            out = None
+            out = out_dev = None
             if expected >= n and prefetch_ok:
-                fetch = [finalize(state, params)]
+                out_dev = finalize(state, params)
+                fetch = [out_dev]
                 if rec is not None:
                     fetch.append(rec["rec_count"])
                 fetch = fetch_to_host(fetch)
@@ -307,16 +308,17 @@ class VectorizedSampler(Sampler):
                 logger.warning("max_eval=%s reached with %d/%d accepted",
                                max_eval, count, n)
                 break
-            out = None  # mis-predicted prefetch: discard, keep sampling
+            out = out_dev = None  # mis-predicted prefetch: discard
         if out is None:
-            out = fetch_to_host(finalize(state, params))
+            out_dev = finalize(state, params)
+            out = fetch_to_host(out_dev)
         # keep the carry buffers alive for the next generation's reset;
         # bound the cache so states orphaned by a batch-ladder change
         # don't pin device memory
         self._states[loop_key] = state
         while len(self._states) > 4:
             self._states.pop(next(iter(self._states)))
-        sample.append_device_batch(out, rounds * B)
+        sample.append_device_batch(out, rounds * B, device_view=out_dev)
         if bar is not None:
             bar.finish()
         self.nr_evaluations_ = sample.nr_evaluations

@@ -45,6 +45,40 @@ def _default_sampler() -> Sampler:
     return DefaultSampler()
 
 
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("specs",))
+def _device_supports(m, theta, log_weight, count, specs):
+    """Build per-model transition supports ON DEVICE from the accepted
+    buffers of the finished generation (``Sample.device_population``).
+
+    ``specs``: tuple of ``(model_index, bucket, dim)``.  One fused
+    dispatch gathers every model's ``(support[bucket, dim], log_w
+    [bucket])`` — the exact arrays ``pad_params`` would otherwise build
+    on the host and re-UPLOAD through the relay (~10 MB ≈ 1.5 s/gen at
+    the 1e6 north star; the fit's scalars — chol, bandwidth, compressed
+    pdf grid — still come from the host fit, they are tiny).
+
+    Selection parity with the host path (`_fit_transitions`): rows
+    ``[: count]`` in round order, filtered by model index; weights are
+    re-normalized per model (``Transition.fit`` does the same).
+    """
+    n_rows = m.shape[0]
+    valid = jnp.arange(n_rows) < count
+    outs = []
+    for j, bucket, dim in specs:
+        idx = jnp.nonzero(valid & (m == j), size=bucket,
+                          fill_value=n_rows)[0]
+        ok = idx < n_rows
+        idxc = jnp.minimum(idx, n_rows - 1)
+        sup = theta[idxc, :dim]
+        lw = jnp.where(ok, log_weight[idxc], -jnp.inf)
+        lw = lw - jax.scipy.special.logsumexp(lw)
+        outs.append((sup, jnp.where(ok, lw, -1e30)))
+    return tuple(outs)
+
+
 class ABCSMC:
     """ABC-SMC with on-device populations (reference smc.py:46-1079)."""
 
@@ -125,6 +159,11 @@ class ABCSMC:
             raise ValueError(
                 "StochasticAcceptor, Temperature and a StochasticKernel "
                 "must be used together (reference pyabc/smc.py:238-248)")
+        if self.M > 127:
+            # the device loop narrows the model column to int8 for the
+            # relay fetch (sampler/device_loop.py finalize)
+            raise ValueError(
+                f"at most 127 models are supported (got {self.M})")
 
     def _split(self):
         self.key, sub = jax.random.split(self.key)
@@ -221,11 +260,17 @@ class ABCSMC:
         self._pad_buckets[m] = need
         return need
 
-    def _fit_transitions(self, t: int, population=None):
+    def _fit_transitions(self, t: int, population=None, device_pop=None):
         """KDE refit from the last generation (reference smc.py:1065-1079),
         padded to a per-model pow2 bucket for shape stability.  The
         in-memory population is used when at hand; the DB read only serves
-        resume."""
+        resume.
+
+        ``device_pop`` (``Sample.device_population``) lets the big
+        support/log_w arrays be gathered ON device (`_device_supports`)
+        instead of re-uploaded from the host-padded fit — the fit itself
+        (moments, bandwidth, pdf-grid compression) still runs here on the
+        host copies."""
         if t == 0:
             return
         pop = (population if population is not None
@@ -233,6 +278,7 @@ class ABCSMC:
         n_pad = len(pop)
         m_arr = np.asarray(pop.m)
         params = []
+        dev_specs = []
         for m in range(self.M):
             idx = np.nonzero(m_arr == m)[0]
             if idx.size == 0:
@@ -243,10 +289,22 @@ class ABCSMC:
             theta_m = np.asarray(pop.theta)[idx, :dim_m]
             w_m = np.asarray(pop.weight)[idx]
             self.transitions[m].fit(theta_m, w_m)
+            bucket = self._pad_bucket(m, idx.size, n_pad)
             # padding policy lives in the Transition contract (pad_params)
             params.append(self.transitions[m].pad_params(
-                self.transitions[m].get_params(),
-                self._pad_bucket(m, idx.size, n_pad)))
+                self.transitions[m].get_params(), bucket))
+            if (device_pop is not None
+                    and getattr(self.transitions[m], "device_support_ok",
+                                False)):
+                dev_specs.append((m, bucket, dim_m))
+        if dev_specs:
+            built = _device_supports(
+                device_pop["m"], device_pop["theta"],
+                device_pop["log_weight"], device_pop["count"],
+                tuple(dev_specs))
+            for (m, _, _), (sup, lw) in zip(dev_specs, built):
+                params[m]["support"] = sup
+                params[m]["log_w"] = lw
         self._trans_params = tuple(params)
 
     def _adapt_population_size(self, t: int):
@@ -523,7 +581,9 @@ class ABCSMC:
     def _prepare_next_iteration(self, t: int, sample: Sample,
                                 population: Population,
                                 acceptance_rate: float):
-        self._fit_transitions(t, population=population)
+        self._fit_transitions(
+            t, population=population,
+            device_pop=getattr(sample, "device_population", None))
         self._adapt_population_size(t)
 
         def get_all_stats_dict():

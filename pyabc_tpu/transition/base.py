@@ -77,6 +77,10 @@ class Transition:
 
     NO_PAD_KEYS: tuple = ()
     PAD_FILL: dict = {"log_w": -1e30}  # padded rows carry ~zero weight
+    #: True when this transition's padded params carry plain
+    #: ``support``/``log_w`` arrays that the orchestrator may replace
+    #: with device-gathered equivalents (smc.py `_device_supports`)
+    device_support_ok: bool = False
 
     def pad_params(self, params: dict, n_pad: int) -> dict:
         """Pad ``params`` leading axes to ``n_pad`` (host-side numpy: this

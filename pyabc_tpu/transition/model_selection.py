@@ -53,6 +53,11 @@ class GridSearchCV(Transition):
         return {k: v for k, v in self.base.__dict__.items()
                 if k not in ("theta", "w", "_fitted") and not k.startswith("_")}
 
+    @property
+    def device_support_ok(self) -> bool:
+        return getattr(self.best_estimator_ or self.base,
+                       "device_support_ok", False)
+
     def get_params(self):
         return self.best_estimator_.get_params()
 

@@ -75,6 +75,7 @@ class MultivariateNormalTransition(Transition):
     # shared KDE state + the grid-compressed pdf support (grid-sized, not
     # per-particle — must pass through pad_params unchanged)
     NO_PAD_KEYS = ("chol", "log_norm", "c_support", "c_log_w")
+    device_support_ok = True  # params are plain support/log_w (+ scalars)
 
     def __init__(self, scaling: float = 1.0,
                  bandwidth_selector: Callable = silverman_rule_of_thumb):

@@ -1,0 +1,113 @@
+"""Single-page UI for the visualization server (visserver/server.py).
+
+The reference serves an interactive Flask+Bokeh UI (reference
+visserver/server.py:198-202 + templates/*.html: run browsing, per-t
+posterior plots).  Flask/Bokeh are not in this image, so the same
+interactivity is delivered dependency-free: the server exposes a JSON
+API and this page renders it with inline-SVG charts — run/model/
+parameter selectors, a generation slider with play-through animation of
+the posterior, epsilon/acceptance trajectories and model-probability
+bars, all live without page reloads.
+"""
+
+PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>pyabc_tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5em;max-width:72em}
+ h1{font-size:1.3em} h2{font-size:1.05em;margin:.4em 0 .2em}
+ .row{display:flex;flex-wrap:wrap;gap:1.5em;align-items:flex-start}
+ .card{border:1px solid #ddd;border-radius:8px;padding:.8em 1em}
+ select,button,input{font:inherit;margin:0 .4em .4em 0}
+ svg{background:#fafafa;border-radius:4px}
+ .lbl{fill:#555;font-size:11px} .axis{stroke:#999;stroke-width:1}
+ .hover{fill:#c33;font-size:12px}
+ table{border-collapse:collapse;font-size:.85em}
+ td,th{border:1px solid #ddd;padding:.15em .5em;text-align:right}
+</style></head><body>
+<h1>pyabc_tpu — ABC-SMC runs</h1>
+<div>
+ run <select id=run></select>
+ model <select id=model></select>
+ parameter <select id=param></select>
+ t <input type=range id=tslider min=0 max=0 value=0 style="width:12em">
+ <span id=tlabel></span>
+ <button id=play>&#9654; play</button>
+</div>
+<div class=row>
+ <div class=card><h2>posterior KDE <span id=kdeinfo class=lbl></span></h2>
+  <svg id=kde width=420 height=260></svg></div>
+ <div class=card><h2>epsilon / acceptance</h2>
+  <svg id=eps width=340 height=260></svg></div>
+ <div class=card><h2>model probabilities</h2>
+  <svg id=probs width=340 height=260></svg></div>
+</div>
+<div class=card style="margin-top:1em"><h2>populations</h2>
+ <div id=pops></div></div>
+<script>
+const $=id=>document.getElementById(id);
+const S={run:null,model:null,t:0,param:null,meta:null,timer:null};
+async function j(u){const r=await fetch(u);if(!r.ok)throw new Error(u);return r.json()}
+function opt(sel,vals,fmt){sel.innerHTML='';for(const v of vals){const o=document.createElement('option');o.value=v;o.textContent=fmt?fmt(v):v;sel.appendChild(o)}}
+function line(svg,xs,ys,opts={}){
+ const W=svg.clientWidth||+svg.getAttribute('width'),H=svg.clientHeight||+svg.getAttribute('height');
+ const p=38,q=18;const xmin=Math.min(...xs),xmax=Math.max(...xs);
+ let ymin=opts.ymin??Math.min(...ys),ymax=opts.ymax??Math.max(...ys);
+ if(ymax===ymin){ymax+=1;ymin-=1}
+ const X=x=>p+(x-xmin)/(xmax-xmin||1)*(W-p-q), Y=y=>H-q-(y-ymin)/(ymax-ymin)*(H-q-q-8);
+ if(!opts.keep)svg.innerHTML='';
+ const ax=`<line class=axis x1=${p} y1=${H-q} x2=${W-q} y2=${H-q}/><line class=axis x1=${p} y1=${H-q} x2=${p} y2=${q}/>`+
+  `<text class=lbl x=${p} y=${H-4}>${xmin.toPrecision(3)}</text><text class=lbl x=${W-q-40} y=${H-4}>${xmax.toPrecision(3)}</text>`+
+  `<text class=lbl x=2 y=${H-q}>${ymin.toPrecision(3)}</text><text class=lbl x=2 y=${q+8}>${ymax.toPrecision(3)}</text>`;
+ const pts=xs.map((x,i)=>`${X(x).toFixed(1)},${Y(ys[i]).toFixed(1)}`).join(' ');
+ svg.innerHTML+=(opts.keep?'':ax)+`<polyline points="${pts}" fill="none" stroke="${opts.color||'#1667c0'}" stroke-width="2" opacity="${opts.opacity??1}"/>`+
+  (opts.label?`<text class=lbl x=${W-q-70} y=${q+(opts.li||0)*13+10} fill="${opts.color}">${opts.label}</text>`:'');
+ return {X,Y};
+}
+async function loadRuns(){
+ const runs=await j('/api/runs');opt($('run'),runs.map(r=>r.id),v=>'run '+v);
+ S.run=runs[0]?.id;await loadRun();
+}
+async function loadRun(){
+ S.run=+$('run').value||S.run;
+ S.meta=await j('/api/run/'+S.run);
+ opt($('model'),S.meta.models);S.model=S.meta.models[0];
+ opt($('param'),S.meta.parameters[S.model]||[]);S.param=($('param').value||null);
+ $('tslider').max=S.meta.max_t;$('tslider').value=S.meta.max_t;S.t=S.meta.max_t;
+ drawStatic();await drawKde();
+}
+function drawStatic(){
+ const P=S.meta.populations.filter(p=>p.t>=0&&p.epsilon!=null);
+ line($('eps'),P.map(p=>p.t),P.map(p=>Math.log10(Math.max(p.epsilon,1e-12))),{color:'#1667c0',label:'log10 eps'});
+ line($('eps'),P.map(p=>p.t),P.map(p=>p.acceptance_rate),{keep:true,color:'#2a9d3a',label:'acc rate',li:1,ymin:0,ymax:1});
+ const probs=S.meta.model_probabilities;const svg=$('probs');svg.innerHTML='';
+ const ts=Object.keys(probs).map(Number).sort((a,b)=>a-b);
+ const W=340,H=260,p=38,q=18,bw=(W-p-q)/Math.max(ts.length,1);
+ const colors=['#1667c0','#e08a1e','#2a9d3a','#c33','#7b52ab'];
+ ts.forEach((t,i)=>{let y=H-q;
+  for(const m of S.meta.models){const v=probs[t][m]||0;const h=v*(H-q-q);
+   svg.innerHTML+=`<rect x=${(p+i*bw).toFixed(1)} y=${(y-h).toFixed(1)} width=${Math.max(bw-2,1).toFixed(1)} height=${h.toFixed(1)} fill="${colors[m%5]}"><title>t=${t} m=${m}: ${v.toFixed(3)}</title></rect>`;y-=h}
+  svg.innerHTML+=`<text class=lbl x=${(p+i*bw).toFixed(1)} y=${H-4}>${t}</text>`});
+ let html='<table><tr><th>t</th><th>epsilon</th><th>samples</th><th>acc rate</th><th>particles</th></tr>';
+ for(const r of S.meta.populations)html+=`<tr><td>${r.t}</td><td>${r.epsilon==null?'&#8734;':r.epsilon.toPrecision(4)}</td><td>${r.samples}</td><td>${r.acceptance_rate.toFixed(4)}</td><td>${r.particles}</td></tr>`;
+ $('pops').innerHTML=html+'</table>';
+}
+async function drawKde(){
+ S.model=+$('model').value;S.param=$('param').value;S.t=+$('tslider').value;
+ $('tlabel').textContent='t='+S.t;
+ if(!S.param){$('kde').innerHTML='';return}
+ const d=await j(`/api/kde/${S.run}/${S.model}/${S.t}?x=${encodeURIComponent(S.param)}`);
+ line($('kde'),d.grid,d.density,{color:'#1667c0'});
+ $('kdeinfo').textContent=`${S.param} | model ${S.model} | ${d.n} particles`;
+}
+$('run').onchange=loadRun;
+$('model').onchange=async()=>{S.model=+$('model').value;opt($('param'),S.meta.parameters[S.model]||[]);await drawKde()};
+$('param').onchange=drawKde;$('tslider').oninput=drawKde;
+$('play').onclick=()=>{
+ if(S.timer){clearInterval(S.timer);S.timer=null;$('play').innerHTML='&#9654; play';return}
+ $('tslider').value=0;$('play').innerHTML='&#9632; stop';
+ S.timer=setInterval(async()=>{let t=+$('tslider').value;
+  if(t>=S.meta.max_t){clearInterval(S.timer);S.timer=null;$('play').innerHTML='&#9654; play';return}
+  $('tslider').value=t+1;await drawKde()},600)};
+loadRuns();
+</script></body></html>
+"""

@@ -1,10 +1,18 @@
 """Web visualization server (parity: pyabc/visserver/server.py:198-202).
 
 The reference serves a Flask+Bokeh UI over a History DB (routes
-``/abc/<id>``, ``/abc/<id>/model/<m>/t/<t>``).  Flask/Bokeh are not in this
-image, so the same routes are served with the stdlib ``http.server`` and
-matplotlib-rendered PNGs — zero extra dependencies, same capability:
-browse runs, populations, model probabilities, posterior KDEs.
+``/abc/<id>``, ``/abc/<id>/model/<m>/t/<t>``, interactive per-t plots).
+Flask/Bokeh are not in this image, so the same capability is served
+dependency-free:
+
+- ``/`` — interactive single-page UI (visserver/app.py): run/model/
+  parameter selectors, a generation slider with play-through posterior
+  animation, epsilon/acceptance and model-probability charts — the
+  Bokeh interactivity, rendered client-side from the JSON API.
+- ``/api/runs``, ``/api/run/<id>``, ``/api/kde/<id>/<m>/<t>?x=<par>`` —
+  the JSON API the page (or any notebook/tool) consumes.
+- ``/abc/<id>``, ``/abc/<id>/model/<m>/t/<t>``, ``/plot/...`` — the
+  reference's route shapes, served as HTML + matplotlib PNGs.
 
 Run: ``python -m pyabc_tpu.visserver.server --db abc.db --port 8765``.
 """
@@ -12,8 +20,9 @@ Run: ``python -m pyabc_tpu.visserver.server --db abc.db --port 8765``.
 from __future__ import annotations
 
 import io
+import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from ..storage.history import History
 
@@ -40,11 +49,19 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self._route()
         except Exception as e:  # pragma: no cover - defensive
-            self._send(_PAGE.format(body=f"<pre>error: {e}</pre>"))
+            if urlparse(self.path).path.startswith("/api/"):
+                self._json({"error": str(e)}, status=500)
+            else:
+                self._send(_PAGE.format(body=f"<pre>error: {e}</pre>"))
 
     def _route(self):
-        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
         if not parts:
+            return self._spa()
+        if parts[0] == "api":
+            return self._api(parts[1:], parse_qs(url.query))
+        if parts[0] == "runs":
             return self._index()
         if parts[0] == "abc" and len(parts) == 2:
             return self._run(int(parts[1]))
@@ -55,6 +72,82 @@ class _Handler(BaseHTTPRequestHandler):
         if parts[0] == "plot" and len(parts) == 4:
             return self._kde_png(int(parts[1]), int(parts[2]), int(parts[3]))
         self._send(_PAGE.format(body="<p>not found</p>"))
+
+    def _spa(self):
+        from .app import PAGE
+        self._send(PAGE)
+
+    def _json(self, obj, status=200):
+        def clean(o):
+            """Strict JSON: bare Infinity/NaN (e.g. the calibration
+            epsilon) breaks browsers' response.json()."""
+            if isinstance(o, dict):
+                return {k: clean(v) for k, v in o.items()}
+            if isinstance(o, list):
+                return [clean(v) for v in o]
+            if isinstance(o, float) and not (-1e308 < o < 1e308):
+                return None
+            return o
+        data = json.dumps(clean(obj), allow_nan=False).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _api(self, parts, query):
+        """JSON API: runs / run metadata / per-(m, t, parameter) KDE."""
+        if parts == ["runs"]:
+            h = History(self.db_path, abc_id=1)
+            runs = h.all_runs()
+            return self._json([
+                {"id": int(r.id), "start_time": str(r.start_time)}
+                for r in runs.itertuples()])
+        if parts[0] == "run" and len(parts) == 2:
+            h = History(self.db_path, abc_id=int(parts[1]))
+            pops = h.get_all_populations()
+            per_pop = h.get_nr_particles_per_population()
+            # one pivot query for all (t, m) probabilities; parameter
+            # names from the TEXT column — no population-blob unpacking
+            pivot = h.get_model_probabilities()
+            probs = {int(t): {int(m): float(p) for m, p in row.items()}
+                     for t, row in pivot.iterrows()}
+            models = sorted(int(m) for m in pivot.columns) or [0]
+            name_rows = h._conn.execute(
+                "SELECT m, param_names FROM model_populations WHERE "
+                "abc_smc_id=? AND t=?", (h.id, h.max_t)).fetchall()
+            names = {int(m): json.loads(pn) if pn else []
+                     for m, pn in name_rows}
+            params = {m: names.get(m, []) for m in models}
+            rows = []
+            for r in pops.itertuples():
+                n_part = int(per_pop.get(r.t, 0))
+                rows.append({
+                    "t": int(r.t), "epsilon": float(r.epsilon),
+                    "samples": int(r.samples),
+                    "acceptance_rate": (n_part / r.samples
+                                        if r.samples else 0.0),
+                    "particles": n_part})
+            return self._json({
+                "models": models, "parameters": params,
+                "max_t": int(h.max_t), "populations": rows,
+                "model_probabilities": probs})
+        if parts[0] == "kde" and len(parts) == 4:
+            abc_id, m, t = int(parts[1]), int(parts[2]), int(parts[3])
+            h = History(self.db_path, abc_id=abc_id)
+            df, w = h.get_distribution(m=m, t=t)
+            x = query.get("x", [df.columns[0]])[0]
+            from ..transition import MultivariateNormalTransition
+            from ..visualization.kde import kde_1d
+            # fixed scaling=1 here: the CV-scaled default re-runs a
+            # bootstrap grid search per request, too slow for a live
+            # t-slider; the PNG routes keep the CV default
+            grid, dens = kde_1d(df, w, x, numx=120,
+                                kde=MultivariateNormalTransition())
+            return self._json({"grid": [float(g) for g in grid],
+                               "density": [float(d) for d in dens],
+                               "n": int(len(df))})
+        self._json({"error": "unknown api route"}, status=404)
 
     def _index(self):
         h = History(self.db_path, abc_id=1)

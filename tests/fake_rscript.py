@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Stub ``Rscript`` for wire-path tests (VERDICT r3 #6).
+
+No R exists in this image, so the subprocess R transport
+(pyabc_tpu/external/base.py `R._call`) never executed in CI.  This stub
+is placed on PATH as ``Rscript`` and STRICTLY parses the exact
+expression shape the transport generates::
+
+    source("<file>"); .res <- fn(list(a=1.0), ...); .res <- as.list(.res);
+    if (is.null(names(.res))) names(.res) <- paste0("v", seq_along(.res));
+    cat(paste(names(.res), unlist(.res)), sep="\n", file="<target>")
+
+Anything that deviates from that shape (a quoting regression, a changed
+argument serialization, a missing source file) fails with a non-zero
+exit, exercising the transport's error path too.  The function table
+mirrors the R test fixture in tests/test_external.py.
+"""
+import os
+import re
+import sys
+
+
+def fail(msg):
+    print(f"fake_rscript: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def parse_r_list(text):
+    """'list(a=1.0, b=2.0)' -> {'a': 1.0, 'b': 2.0} (floats only — the
+    transport only ever serializes flat float dicts)."""
+    m = re.fullmatch(r"list\((.*)\)", text.strip())
+    if m is None:
+        fail(f"malformed R list literal: {text!r}")
+    inner = m.group(1).strip()
+    out = {}
+    if not inner:
+        return out
+    for item in inner.split(","):
+        km = re.fullmatch(r"\s*([A-Za-z._][\w._]*)\s*=\s*([-+eE.\d]+)\s*",
+                          item)
+        if km is None:
+            fail(f"malformed list item: {item!r}")
+        out[km.group(1)] = float(km.group(2))
+    return out
+
+
+FUNCS = {
+    "myModel": lambda pars: {"y": pars["mu"] * 2},
+    "mySummary": lambda x: {"s": x["y"] + 1},
+    "myDistance": lambda x, y: {"d": abs(x["s"] - y["s"])},
+    "myObservation": lambda: {"s": 3.0},
+    "myBroken": lambda *a: fail("myBroken always errors"),
+}
+
+EXPR_RE = re.compile(
+    r'^source\("(?P<src>[^"]+)"\); '
+    r"\.res <- (?P<fn>[A-Za-z._][\w._]*)(?:\((?P<args>.*)\))?; "
+    r"\.res <- as\.list\(\.res\); "
+    r"if \(is\.null\(names\(\.res\)\)\) "
+    r'names\(\.res\) <- paste0\("v", seq_along\(\.res\)\); '
+    r'cat\(paste\(names\(\.res\), unlist\(\.res\)\), sep="\\n", '
+    r'file="(?P<target>[^"]+)"\)$')
+
+
+def split_top_level(args):
+    """Split 'list(a=1), list(b=2)' on top-level commas only."""
+    parts, depth, cur = [], 0, ""
+    for ch in args:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        parts.append(cur)
+    return parts
+
+
+def main():
+    if len(sys.argv) != 3 or sys.argv[1] != "-e":
+        fail(f"expected ['-e', expr], got {sys.argv[1:]}")
+    m = EXPR_RE.match(sys.argv[2])
+    if m is None:
+        fail(f"expression does not match the transport shape: "
+             f"{sys.argv[2]!r}")
+    if not os.path.exists(m.group("src")):
+        fail(f"source file missing: {m.group('src')}")
+    fn = FUNCS.get(m.group("fn"))
+    if fn is None:
+        fail(f"unknown function {m.group('fn')!r}")
+    args = [parse_r_list(a) for a in
+            split_top_level(m.group("args") or "")]
+    res = fn(*args)
+    with open(m.group("target"), "w") as f:
+        f.write("\n".join(f"{k} {v}" for k, v in res.items()))
+
+
+if __name__ == "__main__":
+    main()

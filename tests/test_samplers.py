@@ -180,3 +180,57 @@ def test_nr_samples_per_parameter_weights():
     h = abc.run(max_nr_populations=3)
     probs = h.get_model_probabilities(h.max_t)
     assert float(probs.get(1, 0.0)) > 0.5
+
+
+def test_device_supports_matches_host_selection():
+    """The on-device support gather (smc._device_supports) must select
+    exactly the rows/weights the host pad_params path would."""
+    import jax.numpy as jnp
+
+    from pyabc_tpu.smc import _device_supports
+
+    rng = np.random.default_rng(0)
+    n = 64
+    m = jnp.asarray(rng.integers(0, 2, n), dtype=jnp.int32)
+    theta = jnp.asarray(rng.normal(size=(n, 2)), dtype=jnp.float32)
+    lw = jnp.asarray(rng.normal(size=n), dtype=jnp.float32)
+    count = jnp.int32(50)  # rows >= 50 are stale and must be ignored
+
+    specs = ((0, 32, 2), (1, 64, 1))
+    (sup0, lw0), (sup1, lw1) = _device_supports(m, theta, lw, count, specs)
+
+    m_np, th_np, lw_np = (np.asarray(m), np.asarray(theta), np.asarray(lw))
+    for j, bucket, dim, sup, lwj in ((0, 32, 2, sup0, lw0),
+                                     (1, 64, 1, sup1, lw1)):
+        idx = np.nonzero(m_np[:50] == j)[0]
+        assert sup.shape == (bucket, dim)
+        k = idx.size
+        np.testing.assert_allclose(np.asarray(sup)[:k], th_np[idx, :dim],
+                                   rtol=1e-6)
+        # per-model log-normalized weights; padding at -1e30
+        ref = lw_np[idx] - np.log(np.sum(np.exp(
+            lw_np[idx] - lw_np[idx].max()))) - lw_np[idx].max()
+        np.testing.assert_allclose(np.asarray(lwj)[:k], ref, atol=1e-5)
+        assert np.all(np.asarray(lwj)[k:] == -1e30)
+
+
+def test_device_support_path_used_in_run(db_path):
+    """An e2e VectorizedSampler run hands the orchestrator a device
+    population view and the fitted round params carry device-built
+    support (no host re-upload of the big arrays)."""
+    import jax.numpy as jnp
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=300,
+                    sampler=pt.VectorizedSampler(), seed=0)
+    abc.new(db_path, observed)
+    abc.run(max_nr_populations=3)
+    # after >= 2 generations the trans params were refit from a live
+    # device population: support must be a jax array, not host numpy
+    assert abc._trans_params is not None
+    assert any(isinstance(p.get("support"), jnp.ndarray)
+               and not isinstance(p.get("support"), np.ndarray)
+               for p in abc._trans_params)

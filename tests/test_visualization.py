@@ -220,7 +220,33 @@ def test_visserver_routes(history):
                 return r.status, r.headers.get("Content-Type"), r.read()
 
         status, ctype, body = get("/")
+        assert status == 200 and b"tslider" in body  # interactive SPA
+        status, _, body = get("/runs")
         assert status == 200 and b"ABC runs" in body
+        # JSON API consumed by the SPA
+        import json as _json
+        status, ctype, body = get("/api/runs")
+        assert status == 200 and ctype == "application/json"
+        runs = _json.loads(body)
+        assert runs and runs[0]["id"] == 1
+        status, _, body = get("/api/run/1")
+        # STRICT json (no bare Infinity/NaN): browsers' response.json()
+        # rejects them; the calibration epsilon must arrive as null
+        meta = _json.loads(body.decode(), parse_constant=lambda c: (
+            _ for _ in ()).throw(AssertionError(f"non-strict JSON: {c}")))
+        assert meta["max_t"] == history.max_t
+        assert meta["populations"][0]["t"] == -1
+        assert meta["populations"][0]["epsilon"] is None
+        assert all(0 <= p <= 1 for d in meta["model_probabilities"].values()
+                   for p in d.values())
+        par = meta["parameters"][str(meta["models"][0])] \
+            if isinstance(next(iter(meta["parameters"])), str) \
+            else meta["parameters"][meta["models"][0]]
+        status, _, body = get(
+            f"/api/kde/1/0/{history.max_t}?x={par[0]}")
+        kde = _json.loads(body)
+        assert len(kde["grid"]) == len(kde["density"]) == 120
+        assert all(d >= 0 for d in kde["density"])
         status, _, body = get("/abc/1")
         assert status == 200 and b"model probabilities" in body
         t = history.max_t

@@ -72,13 +72,13 @@ def main():
     # client-side cache without a real transfer)
     n_target = 1_000_000
     kk = jax.random.split(key, 6)
+    # mirrors device_loop.finalize's wire format (int8 m, no mask)
     payload = {
-        "m": jax.random.randint(kk[0], (n_target,), 0, 2),
+        "m": jax.random.randint(kk[0], (n_target,), 0, 2).astype(jnp.int8),
         "theta": jax.random.normal(kk[1], (n_target, 1), jnp.float32),
         "distance": jax.random.normal(kk[2], (n_target,), jnp.float32),
         "log_weight": jax.random.normal(kk[3], (n_target,), jnp.float32),
         "stats": jax.random.normal(kk[4], (n_target, 1), jnp.float32),
-        "accepted_mask": jax.random.normal(kk[5], (n_target,)) > 0,
         "count": jnp.int32(0),
         "rounds": jnp.int32(0),
     }
