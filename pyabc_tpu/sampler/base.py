@@ -204,7 +204,8 @@ class Sample:
                 getattr(v, "is_fully_addressable", True)
                 for v in device_view.values()):
             self.device_population = {
-                k: device_view[k] for k in ("m", "theta", "log_weight")}
+                k: device_view[k]
+                for k in ("m", "theta", "log_weight", "stats")}
             self.device_population["count"] = device_view["count"]
         out = fetch_to_host(out)  # ONE bulk d2h transfer, not one per key
         self.nr_evaluations += int(n_evals)

@@ -245,3 +245,33 @@ def test_adaptive_distance_weight_log_file(tmp_path):
     # doubling the data scale halves the inverse-scale weights
     np.testing.assert_allclose(np.asarray(logged["1"]),
                                np.asarray(logged["0"]) / 2, rtol=1e-5)
+
+
+def test_adaptive_update_device_stats_parity(db_path):
+    """After an adaptive-distance run the stored population distances
+    must equal the new-weight distance evaluated on the STORED sum stats
+    — pins the device-resident recompute branch (smc.py) to the same
+    rows/values as the host path it replaced."""
+    import jax
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    models, priors, _, observed, _ = make_two_gaussians_problem()
+    dist = pt.AdaptivePNormDistance()
+    abc = pt.ABCSMC(models, priors, dist, population_size=300,
+                    sampler=pt.VectorizedSampler(), seed=0)
+    abc.new(db_path, observed)
+    abc.run(max_nr_populations=3)
+    # the in-memory distance has the final generation's refit weights;
+    # recompute from the DB-stored stats of the PREVIOUS generation
+    # (the one whose distances were rewritten by the update branch)
+    t = abc.history.max_t - 1
+    pop = abc.history.get_population(t)
+    import jax.numpy as jnp
+    import numpy as np
+    stats = jnp.asarray(pop.sum_stats["__flat__"])
+    expect = np.asarray(dist.compute(
+        stats, abc._obs_flat, dist.get_params(t + 1)))
+    np.testing.assert_allclose(np.asarray(pop.distance), expect,
+                               rtol=2e-4, atol=1e-5)

@@ -41,7 +41,8 @@ def _wrap(name, fn, sync=True):
 
 
 def main():
-    pop = 1 << int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    problem = sys.argv[1] if len(sys.argv) > 1 else "northstar"
+    pop = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000
 
     import pyabc_tpu as pt
     from pyabc_tpu.models import make_two_gaussians_problem
@@ -70,15 +71,30 @@ def main():
     smc_mod0.ABCSMC._fit_transitions = _wrap(
         "fit_transitions", smc_mod0.ABCSMC._fit_transitions, sync=False)
 
-    models, priors, distance, observed, _ = make_two_gaussians_problem()
-    abc = pt.ABCSMC(
-        models, priors, distance,
-        population_size=pop,
-        eps=pt.ConstantEpsilon(0.2),
-        sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
-                                     max_rounds_per_call=16),
-        seed=0)
-    abc.new("sqlite://", observed)
+    if problem == "northstar":
+        models, priors, distance, observed, _ = \
+            make_two_gaussians_problem()
+        abc = pt.ABCSMC(
+            models, priors, distance,
+            population_size=pop,
+            eps=pt.ConstantEpsilon(0.2),
+            sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                         max_rounds_per_call=16),
+            seed=0)
+        abc.new("sqlite://", observed)
+    else:
+        from pyabc_tpu.models import (make_lotka_volterra_problem,
+                                      make_sir_problem)
+        maker = {"lv": make_lotka_volterra_problem,
+                 "sir": make_sir_problem}[problem]
+        models, priors, distance, observed = maker()
+        abc = pt.ABCSMC(
+            models, priors, distance,
+            population_size=pop,
+            sampler=pt.VectorizedSampler(min_batch_size=1 << 19,
+                                         max_batch_size=1 << 19),
+            seed=0)
+        abc.new("sqlite://", observed)
 
     gen_t0 = time.perf_counter()
     gen_marks = []
