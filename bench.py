@@ -53,7 +53,9 @@ import numpy as np
 
 POP = 16384
 WARMUP_GENERATIONS = 3
-TIMED_GENERATIONS = 3
+# 5 timed generations on the headline rows: the relay's per-run weather
+# makes a 3-sample median noisier than the effects being measured
+TIMED_GENERATIONS = 5
 FALLBACK_BASELINE = 675.19  # accepted/s, see BASELINE_MEASURED.json
 NORTHSTAR_POP = 1_000_000
 LV_POP = 100_000
@@ -148,7 +150,7 @@ def bench_northstar():
     abc.new("sqlite://", observed)
     # warmup = calibration + prior gen + one full KDE generation (compiles)
     rate, s_per_gen, times, evals_ps = _timed_generations(
-        abc, NORTHSTAR_POP, 2, 3)
+        abc, NORTHSTAR_POP, 2, TIMED_GENERATIONS)
     return {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
             "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2),
             "northstar_pop1e6_gen_times_s": times,

@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 from scipy import optimize as sp_optimize
 
@@ -107,12 +108,20 @@ class Temperature(TemperatureBase):
                     "initial_temperature": temp}
             else:
                 proposals = {}
+                # when the records callback is a Sample's bound method
+                # the device fast path (get_records_device) rides along —
+                # schemes that can solve on device use it and fetch one
+                # scalar instead of ~MBs of record columns
+                sample_obj = getattr(get_all_records, "__self__", None)
+                get_device_records = getattr(
+                    sample_obj, "get_records_device", None)
                 for scheme in self.schemes:
                     try:
                         val = scheme(
                             t=t,
                             get_weighted_distances=get_weighted_distances,
                             get_all_records=get_all_records,
+                            get_device_records=get_device_records,
                             max_nr_populations=nr_pop,
                             pdf_norm=acceptor_config.get("pdf_norm", 0.0),
                             kernel_scale=acceptor_config.get(
@@ -205,10 +214,90 @@ class TemperatureScheme:
         raise NotImplementedError
 
 
+_DEVICE_SOLVE_CACHE: dict = {}
+
+
+def _device_acceptance_rate_solve(log_dens, log_ratio, pdf_norm,
+                                  target_rate, lin_scale: bool):
+    """One compiled program: importance weights + log-beta bisection.
+
+    Same math as the host path (importance-weighted mean of
+    min(1, exp(logvals·beta)) matched to the target rate, bisected over
+    b = log beta ∈ [-100, 0]), evaluated over the DEVICE record columns
+    with NaN bucket-padding masked.  Returns (b_opt, rate_at_b0,
+    rate_at_bmin) — three scalars, one fetch.
+    """
+    import jax
+
+    key = ("solve", bool(lin_scale))
+    if key not in _DEVICE_SOLVE_CACHE:
+
+        @jax.jit
+        def solve(log_dens, log_ratio, pdf_norm, target):
+            # NaN rows are bucket padding — excluded.  A -inf log_dens is
+            # a REAL record (zero-likelihood candidate): it keeps its
+            # importance weight and contributes acceptance 0, exactly as
+            # on the host path.  A +inf log_ratio (pd_prev = 0) carries
+            # weight 0, mirroring the host's pd_prev > 0 guard.
+            valid = ~jnp.isnan(log_dens) & ~jnp.isnan(log_ratio)
+            w_ok = valid & (log_ratio < jnp.inf)
+            shift = jnp.max(jnp.where(
+                w_ok & jnp.isfinite(log_ratio), log_ratio, -jnp.inf))
+            shift = jnp.where(jnp.isfinite(shift), shift, 0.0)
+            w = jnp.where(w_ok, jnp.exp(log_ratio - shift), 0.0)
+            wsum = jnp.sum(w)
+            # all-zero ratios -> uniform over valid (host-path parity)
+            w = jnp.where(wsum > 0, w,
+                          jnp.where(valid, 1.0, 0.0))
+            w = w / jnp.maximum(jnp.sum(w), 1e-30)
+            ld = log_dens
+            if lin_scale:
+                # mirror the host clamp log(max(d, 1e-290)): f32 record
+                # storage flushes such densities to 0, so 0 maps to the
+                # host's floor value instead of -inf
+                ld = jnp.where(ld > 0, jnp.log(jnp.maximum(ld, 1e-38)),
+                               jnp.float32(np.log(1e-290)))
+            logvals = jnp.where(valid, ld - pdf_norm, -jnp.inf)
+
+            def rate(b):
+                # beta floored at the smallest f32 NORMAL: subnormal
+                # exp(b) flushes to 0 on this stack and -inf·0 = NaN
+                # would poison the sum; guard w > 0 for padding rows too
+                beta = jnp.maximum(jnp.exp(b), 1e-37)
+                acc = jnp.exp(jnp.minimum(logvals * beta, 0.0))
+                return jnp.sum(jnp.where(w > 0, w * acc, 0.0))
+
+            def body(_, lo_hi):
+                # rate(b) DECREASES in b (hotter beta -> colder accept);
+                # rate(lo) > target > rate(hi) is the loop invariant
+                lo, hi = lo_hi
+                mid = 0.5 * (lo + hi)
+                too_cold = rate(mid) < target
+                return (jnp.where(too_cold, lo, mid),
+                        jnp.where(too_cold, mid, hi))
+
+            lo, hi = jax.lax.fori_loop(
+                0, 60, body, (jnp.float32(-100.0), jnp.float32(0.0)))
+            b_opt = 0.5 * (lo + hi)
+            return b_opt, rate(0.0), rate(-100.0)
+
+        _DEVICE_SOLVE_CACHE[key] = solve
+    return _DEVICE_SOLVE_CACHE[key](
+        log_dens, log_ratio, jnp.float32(pdf_norm),
+        jnp.float32(target_rate))
+
+
 class AcceptanceRateScheme(TemperatureScheme):
     """Solve T so the expected acceptance rate hits ``target_rate``
     (reference temperature.py:258-364, bisection on the importance-weighted
-    mean of min(1, exp((logdens - c)/T)))."""
+    mean of min(1, exp((logdens - c)/T))).
+
+    When the sampler exposes device-resident records
+    (``Sample.get_records_device``) the whole solve runs as ONE compiled
+    device program with a 3-scalar fetch — the host path fetched ~MBs of
+    record columns and re-uploaded thetas for the new-proposal density
+    (~2.2 s/generation through the relay, the dominant cost of the
+    stochastic-acceptor configs)."""
 
     requires_all_records = True
 
@@ -216,14 +305,30 @@ class AcceptanceRateScheme(TemperatureScheme):
         self.target_rate = float(target_rate)
         self.min_rate = min_rate
 
-    def __call__(self, t, get_all_records=None, pdf_norm=0.0,
-                 kernel_scale=SCALE_LOG, prev_temperature=None,
-                 acceptance_rate=None, **kwargs):
-        if get_all_records is None:
+    def __call__(self, t, get_all_records=None, get_device_records=None,
+                 pdf_norm=0.0, kernel_scale=SCALE_LOG,
+                 prev_temperature=None, acceptance_rate=None, **kwargs):
+        if get_all_records is None and get_device_records is None:
             return None
         if (self.min_rate is not None and acceptance_rate is not None
                 and acceptance_rate < self.min_rate):
             return np.inf
+
+        min_b = -100.0
+        dev = get_device_records() if get_device_records else None
+        if dev is not None:
+            b_opt, rate0, rate_min = (
+                float(v) for v in _device_acceptance_rate_solve(
+                    dev["log_dens"], dev["log_ratio"], pdf_norm,
+                    self.target_rate, kernel_scale == SCALE_LIN))
+            if rate0 > self.target_rate:
+                return 1.0  # beta=1 already exceeds the target rate
+            if rate_min < self.target_rate:
+                logger.info(
+                    "AcceptanceRateScheme: numerics limit temperature")
+                return float(1.0 / np.exp(min_b))
+            return float(1.0 / np.exp(b_opt))
+
         logdens, w = _records_to_arrays(get_all_records, kernel_scale)
         logvals = logdens - pdf_norm
 
@@ -234,7 +339,6 @@ class AcceptanceRateScheme(TemperatureScheme):
             acc = np.exp(np.minimum(logvals * beta, 0.0))
             return float(np.sum(w * acc)) - self.target_rate
 
-        min_b = -100.0
         if rate_minus_target(0.0) > 0:
             return 1.0  # beta=1 already exceeds the target rate
         if rate_minus_target(min_b) < 0:

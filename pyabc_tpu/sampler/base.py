@@ -72,13 +72,16 @@ class SamplingError(Exception):
     pass
 
 
-def pow4_bucket(n: int, minimum: int = 1) -> int:
-    """Smallest power of FOUR >= n (>= minimum).  Pow2 buckets still
-    produced a new shape — and a fresh ~2-4 s remote compile of every
-    shape-keyed program — almost every generation as data-dependent row
-    counts drifted; pow4 trades <=4x NaN padding for 1-2 compiled shapes
-    per run."""
-    return max(int(4 ** np.ceil(np.log2(max(n, 1)) / 2)), minimum)
+def coarse_bucket(n: int, minimum: int = 4096) -> int:
+    """Smallest power of SIXTEEN >= n (>= minimum) — the record-path
+    shape quantization.  Exact row counts would bill a fresh ~2-4 s
+    remote compile of every shape-keyed program per generation, and
+    record counts GROW across a run as the acceptance rate falls, so
+    even power-of-four buckets crossed a boundary mid-run (measured on
+    the petab row).  Pow16 means at most 2-3 shapes ever; the <=16x
+    NaN padding is cheap because record consumers reduce over
+    NaN-aware / compressed-support paths."""
+    return max(int(16 ** np.ceil(np.log2(max(n, 1)) / 4)), minimum)
 
 
 def fetch_to_host(tree):
@@ -157,6 +160,12 @@ class Sample:
         #: NEWLY fitted proposal (reference ``transition_pd``,
         #: smc.py:1022-1032); None -> importance ratio 1
         self.transition_log_pdf = None
+        #: optional DEVICE density callback set by the orchestrator:
+        #: ``(m_dev[R], theta_dev[R, D]) -> log-density`` of the newly
+        #: fitted proposal, evaluated without leaving the device —
+        #: enables `get_records_device` (temperature schemes solve on
+        #: device instead of fetching ~MBs of record columns)
+        self.transition_log_pdf_device = None
         #: device-resident view of the accepted buffers (m/theta/
         #: log_weight/count), set by append_device_batch when available
         self.device_population: Optional[dict] = None
@@ -260,7 +269,7 @@ class Sample:
         rc = min(int(rec_count), self.max_records - self._n_recorded)
         if rc <= 0:
             return
-        # slice device arrays at a POW2 bucket, not the exact count: an
+        # slice device arrays at a COARSE bucket, not the exact count: an
         # exact dynamic length would compile a fresh slice kernel every
         # generation (~4 s/gen through the remote compiler); the bucketed
         # shapes are few and cache.  Rows >= rc are then NaN-masked with
@@ -270,7 +279,7 @@ class Sample:
         # consume the buffers directly; exact-count consumers use the
         # stored "__count" after host materialization.
         cap = rec["rec_stats"].shape[0]
-        bucket = min(pow4_bucket(rc), cap)
+        bucket = min(coarse_bucket(rc), cap)
         batch = _nan_mask_records(
             {k: rec[f"rec_{k}"][:bucket]
              for k in ("stats", "distance", "accepted", "m", "theta",
@@ -343,7 +352,7 @@ class Sample:
 
     def get_records_arrays(self, keys=None) -> Optional[dict]:
         """Recorded candidates as EXACT-count numpy column arrays, or None
-        if none.  Device batches are stored at pow2-bucket sizes with NaN
+        if none.  Device batches are stored at coarse-bucket sizes with NaN
         tails (see append_record_batch); each requested column is
         materialized to host and truncated to the batch's true count.
         Pass ``keys`` to fetch only what you need — ``stats`` is the big
@@ -396,6 +405,31 @@ class Sample:
             "transition_pd": np.exp(log_new - shift),
             "accepted": np.asarray(recs["accepted"], dtype=bool),
         }
+
+    def get_records_device(self) -> Optional[dict]:
+        """Device-resident record columns for temperature schemes:
+        ``log_dens`` (the recorded kernel value) and ``log_ratio``
+        (log new-proposal density − log generating-proposal density,
+        via :attr:`transition_log_pdf_device`) — NaN rows are bucket
+        padding / truncated tails and must be masked by the consumer.
+
+        Returns None when the device fast path is unavailable (host
+        record batches, or no device density callback); callers fall
+        back to :meth:`get_records_columns`.  Fetches NOTHING: the
+        whole point is that an on-device temperature solve replaces
+        ~MBs of per-candidate column fetch + re-upload per generation
+        (measured ~2.2 s/gen on the petab row through the relay).
+        """
+        if not self._rec or self.transition_log_pdf_device is None:
+            return None
+        if any(isinstance(b["distance"], np.ndarray) for b in self._rec):
+            return None
+        dist = self._concat(self._rec, "distance")
+        log_prev = self._concat(self._rec, "log_proposal")
+        m = self._concat(self._rec, "m")
+        theta = self._concat(self._rec, "theta")
+        log_new = self.transition_log_pdf_device(m, theta)
+        return {"log_dens": dist, "log_ratio": log_new - log_prev}
 
     def get_all_records(self) -> List[dict]:
         """Reference-compat list-of-dicts view of

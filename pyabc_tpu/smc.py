@@ -144,6 +144,7 @@ class ABCSMC:
         self._obs_flat = None
         self._kernel: Optional[RoundKernel] = None
         self._jit_dist_compute = None
+        self._jit_prop_density = None
         self._trans_params: Optional[tuple] = None
         #: per-model transition padding buckets (see _pad_bucket)
         self._pad_buckets: Dict[int, int] = {}
@@ -350,15 +351,16 @@ class ABCSMC:
             if sel_idx.size == 0:
                 continue
             dim_j = self.parameter_priors[j].dim
-            # pad the query rows to a pow4 bucket: the per-model selection
-            # count is data-dependent, and an exact shape would bill a
-            # fresh XLA compile of the KDE log-pdf to EVERY generation
-            # (~4 s/gen through the remote compiler — measured as the
-            # dominant cost of the temperature-scheme path).  NaN padding
-            # rows yield NaN densities and are dropped on truncation.
-            from .sampler.base import pow4_bucket
+            # pad the query rows to a coarse bucket: the per-model
+            # selection count is data-dependent AND grows across
+            # generations, and an exact shape would bill a fresh XLA
+            # compile of the KDE log-pdf to EVERY generation (~4 s/gen
+            # through the remote compiler — measured as the dominant
+            # cost of the temperature-scheme path).  NaN padding rows
+            # yield NaN densities and are dropped on truncation.
+            from .sampler.base import coarse_bucket
             n_s = int(sel_idx.size)
-            bucket = pow4_bucket(n_s, minimum=64)
+            bucket = coarse_bucket(n_s, minimum=256)
             th = np.full((bucket, dim_j), np.nan, dtype=np.float32)
             th[:n_s] = theta[sel_idx, :dim_j]
             vals = np.asarray(self.transitions[j].log_pdf(th),
@@ -639,6 +641,21 @@ class ABCSMC:
         probs_new = self._model_probabilities(t - 1)
         sample.transition_log_pdf = (
             lambda m, theta: self._proposal_log_pdf(probs_new, m, theta))
+        # device variant of the same density (the freshly fitted proposal
+        # evaluated at device-resident record thetas): lets temperature
+        # schemes solve ON device instead of fetching record columns
+        if self._trans_params is not None:
+            if self._jit_prop_density is None:
+                self._jit_prop_density = jax.jit(
+                    self._kernel.proposal_log_density)
+            with np.errstate(divide="ignore"):
+                log_probs_new = jnp.asarray(
+                    np.log(np.maximum(probs_new, 1e-300)), jnp.float32)
+            params_dev = {"model_log_probs": log_probs_new,
+                          "transition": self._trans_params}
+            sample.transition_log_pdf_device = (
+                lambda m, theta: self._jit_prop_density(
+                    m.astype(jnp.int32), theta, params_dev))
         self.eps.update(t, get_weighted_distances,
                         sample.get_records_columns,
                         acceptance_rate, self.acceptor.get_epsilon_config(t))

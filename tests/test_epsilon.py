@@ -120,3 +120,84 @@ def test_friel_pettitt():
     scheme = pt.FrielPettittScheme()
     T = scheme(t=0, max_nr_populations=4, prev_temperature=None)
     assert T == pytest.approx(16.0)
+
+
+def test_acceptance_rate_scheme_device_solve_parity():
+    """The on-device bisection must reproduce the host solve on the same
+    records (incl. NaN bucket-padding masking and importance ratios)."""
+    import jax.numpy as jnp
+
+    from pyabc_tpu.epsilon.temperature import (AcceptanceRateScheme,
+                                               SCALE_LOG)
+
+    rng = np.random.default_rng(0)
+    n = 5000
+    log_dens = rng.normal(-8.0, 3.0, n)
+    log_prev = rng.normal(0.0, 0.5, n)
+    log_new = log_prev + rng.normal(0.0, 0.3, n)
+
+    scheme = AcceptanceRateScheme(target_rate=0.3)
+
+    def host_records():
+        return {"distance": log_dens,
+                "transition_pd_prev": np.exp(log_prev),
+                "transition_pd": np.exp(log_new),
+                "accepted": np.ones(n, dtype=bool)}
+
+    t_host = scheme(t=1, get_all_records=host_records,
+                    pdf_norm=0.0, kernel_scale=SCALE_LOG)
+
+    # device columns with NaN padding rows appended (bucket tails)
+    pad = 777
+    ld = jnp.asarray(np.concatenate(
+        [log_dens, np.full(pad, np.nan)]), jnp.float32)
+    lr = jnp.asarray(np.concatenate(
+        [log_new - log_prev, np.full(pad, np.nan)]), jnp.float32)
+
+    t_dev = scheme(t=1, get_all_records=None,
+                   get_device_records=lambda: {"log_dens": ld,
+                                               "log_ratio": lr},
+                   pdf_norm=0.0, kernel_scale=SCALE_LOG)
+    assert t_dev == pytest.approx(t_host, rel=5e-3)
+
+    # beta=1 branch: densities so high everything accepts at T=1
+    hot = lambda: {"log_dens": jnp.zeros(64), # noqa: E731
+                   "log_ratio": jnp.zeros(64)}
+    assert scheme(t=1, get_all_records=None, get_device_records=hot,
+                  pdf_norm=0.0, kernel_scale=SCALE_LOG) == 1.0
+
+
+def test_acceptance_rate_scheme_device_solve_zero_likelihood():
+    """-inf log-densities are REAL records (zero-likelihood candidates),
+    not padding: they must keep their importance weight and contribute
+    acceptance 0, matching the host solve (review finding r4)."""
+    import jax.numpy as jnp
+
+    from pyabc_tpu.epsilon.temperature import (AcceptanceRateScheme,
+                                               SCALE_LOG)
+
+    rng = np.random.default_rng(1)
+    n = 1000
+    log_dens = rng.normal(-5.0, 2.0, n)
+    log_dens[: int(0.8 * n)] = -np.inf  # 80% zero-likelihood
+    scheme = AcceptanceRateScheme(target_rate=0.3)
+
+    def host_records():
+        return {"distance": log_dens,
+                "transition_pd_prev": np.ones(n),
+                "transition_pd": np.ones(n),
+                "accepted": np.ones(n, dtype=bool)}
+
+    t_host = scheme(t=1, get_all_records=host_records,
+                    pdf_norm=0.0, kernel_scale=SCALE_LOG)
+    pad = 100
+    dev = lambda: {  # noqa: E731
+        "log_dens": jnp.asarray(np.concatenate(
+            [log_dens, np.full(pad, np.nan)]), jnp.float32),
+        "log_ratio": jnp.asarray(np.concatenate(
+            [np.zeros(n), np.full(pad, np.nan)]), jnp.float32)}
+    t_dev = scheme(t=1, get_all_records=None, get_device_records=dev,
+                   pdf_norm=0.0, kernel_scale=SCALE_LOG)
+    # max achievable rate is 0.2 < target: both must hit the numerics
+    # limit (astronomically large T), not silently renormalize
+    assert t_host > 1e40 and t_dev > 1e40

@@ -234,3 +234,22 @@ def test_device_support_path_used_in_run(db_path):
     assert any(isinstance(p.get("support"), jnp.ndarray)
                and not isinstance(p.get("support"), np.ndarray)
                for p in abc._trans_params)
+
+
+def test_coarse_bucket_ladder():
+    """Record-path shape quantization: power-of-16 ladder with a floor —
+    at most a couple of compiled shapes across a whole run."""
+    from pyabc_tpu.sampler.base import coarse_bucket
+
+    assert coarse_bucket(1) == 4096
+    assert coarse_bucket(4096) == 4096
+    assert coarse_bucket(4097) == 65536
+    assert coarse_bucket(65536) == 65536
+    assert coarse_bucket(65537) == 1048576
+    assert coarse_bucket(200, minimum=256) == 256
+    # monotone and >= n
+    prev = 0
+    for n in (1, 10, 5000, 70000, 2**20, 2**21):
+        b = coarse_bucket(n)
+        assert b >= n and b >= prev
+        prev = b

@@ -82,6 +82,36 @@ def main():
                                          max_rounds_per_call=16),
             seed=0)
         abc.new("sqlite://", observed)
+    elif problem == "petab":
+        import pandas as pd
+
+        from pyabc_tpu.petab import ODEPetabImporter
+        par_df = pd.DataFrame({
+            "parameterId": ["k"], "parameterScale": ["lin"],
+            "lowerBound": [0.01], "upperBound": [3.0], "estimate": [1],
+            "objectivePriorType": ["uniform"],
+            "objectivePriorParameters": ["0.01;3.0"],
+        }).set_index("parameterId")
+        t_max, n_steps = 2.0, 20
+        obs_idx = np.asarray([4, 9, 14, 19])
+        times = (obs_idx + 1) * (t_max / n_steps)
+        rng = np.random.default_rng(0)
+        data = np.exp(-0.7 * times) + 0.05 * rng.normal(size=times.shape)
+        importer = ODEPetabImporter(
+            par_df, rhs=lambda y, th: -th[:, 0:1] * y, y0=[1.0],
+            t_max=t_max, n_steps=n_steps, obs_idx=obs_idx,
+            measurements={"y0": data}, sigma=0.05)
+        abc = pt.ABCSMC(
+            models=importer.create_model(),
+            parameter_priors=importer.create_prior(),
+            distance_function=importer.create_kernel(),
+            population_size=pop,
+            eps=pt.Temperature(aggregate_fun=max),
+            acceptor=pt.StochasticAcceptor(),
+            sampler=pt.VectorizedSampler(min_batch_size=1 << 18,
+                                         max_batch_size=1 << 18),
+            seed=0)
+        abc.new("sqlite://", importer.get_observed())
     else:
         from pyabc_tpu.models import (make_lotka_volterra_problem,
                                       make_sir_problem)
@@ -95,6 +125,13 @@ def main():
                                          max_batch_size=1 << 19),
             seed=0)
         abc.new("sqlite://", observed)
+
+    import pyabc_tpu.sampler.base as sbase2
+    sbase2.Sample.append_record_batch = _wrap(
+        "record_ingest", sbase2.Sample.append_record_batch, sync=False)
+    abc.eps.update = _wrap("eps_update", abc.eps.update, sync=False)
+    abc.distance_function.update = _wrap(
+        "distance_update", abc.distance_function.update, sync=False)
 
     gen_t0 = time.perf_counter()
     gen_marks = []
