@@ -18,7 +18,7 @@ The lifecycle methods mutate only host-side numpy state that feeds
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Mapping, Optional
+from typing import Callable, Mapping, Optional
 
 import jax.numpy as jnp
 import numpy as np

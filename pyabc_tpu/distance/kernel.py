@@ -24,13 +24,12 @@ log-space (f32-safe; the reference multiplies densities in linear space).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Union
+from typing import Callable, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 from jax.scipy.special import gammaln
 
-from ..sumstat import SumStatSpec
 from .base import Distance
 
 Array = jnp.ndarray

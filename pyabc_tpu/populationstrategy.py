@@ -13,7 +13,7 @@ handful of round shapes are ever compiled.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 import jax
 import numpy as np

@@ -24,7 +24,7 @@ reproducibility weakness, see SURVEY.md §7).
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Optional, Sequence, Union
+from typing import Dict, Mapping, Optional, Union
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,7 @@ per-key weights broadcast to per-component weight vectors.
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Optional, Sequence, Tuple
+from typing import Dict, Mapping, Tuple
 
 import jax.numpy as jnp
 import numpy as np

@@ -14,7 +14,6 @@ Parity: pyabc/transition/multivariatenormal.py (113 LoC):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional
 
 import jax

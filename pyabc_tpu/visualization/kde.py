@@ -7,7 +7,7 @@ only renders the resulting numpy grids.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np

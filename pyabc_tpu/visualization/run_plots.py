@@ -20,7 +20,7 @@ Parity map to pyabc/visualization/:
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Union
+from typing import Callable, List, Optional
 
 import numpy as np
 
