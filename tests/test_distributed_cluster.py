@@ -123,13 +123,34 @@ abc = pt.ABCSMC(models, priors, distance, population_size=128, seed=17)
 abc.new("sqlite://", observed)
 h = abc.run(max_nr_populations=2)
 probs = h.get_model_probabilities(h.max_t)
+
+# the stochastic triple across the same cluster: exercises the record
+# machinery + temperature schemes (incl. the device-records fast path
+# or its graceful host fallback) under multi-process SPMD
+def m1(key, theta):
+    return {"y": theta[:, 0]
+            + 0.1 * jax.random.normal(key, (theta.shape[0],))}
+
+abc2 = pt.ABCSMC(m1, pt.Distribution(a=pt.RV("norm", 0, 1)),
+                 pt.IndependentNormalKernel(var=0.01),
+                 population_size=96, eps=pt.Temperature(),
+                 acceptor=pt.StochasticAcceptor(), seed=23)
+abc2.new("sqlite://", {"y": 0.5})
+h2 = abc2.run(max_nr_populations=2)
+df2, w2 = h2.get_distribution()
+post_mean = float(df2["a"].to_numpy() @ w2)
+temp_last = float(h2.get_all_populations().epsilon.iloc[-1])
+
 out = os.environ["CLUSTER_TEST_OUT"]
 with open(out, "w") as f:
     json.dump({"process_index": jax.process_index(),
                "n_devices": len(jax.devices()),
                "sampler": type(abc.sampler).__name__,
                "max_t": int(h.max_t),
-               "p1": float(probs.get(1, 0.0))}, f)
+               "p1": float(probs.get(1, 0.0)),
+               "stoch_max_t": int(h2.max_t),
+               "stoch_post_mean": post_mean,
+               "stoch_temp": temp_last}, f)
 """
 
 
@@ -177,3 +198,10 @@ def test_multihost_abcsmc(tmp_path):
     # SPMD: every host computed the SAME global model probabilities
     assert abs(infos[0]["p1"] - infos[1]["p1"]) < 1e-12
     assert 0.3 < infos[0]["p1"] <= 1.0
+    # stochastic triple: bit-identical cross-host temperature schedule
+    # and posterior through the record/temperature machinery
+    assert infos[0]["stoch_max_t"] >= 1
+    assert abs(infos[0]["stoch_post_mean"]
+               - infos[1]["stoch_post_mean"]) < 1e-12
+    assert abs(infos[0]["stoch_temp"] - infos[1]["stoch_temp"]) < 1e-9
+    assert abs(infos[0]["stoch_post_mean"] - 0.5) < 0.4
