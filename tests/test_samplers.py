@@ -253,3 +253,57 @@ def test_coarse_bucket_ladder():
         b = coarse_bucket(n)
         assert b >= n and b >= prev
         prev = b
+
+
+def test_sampler_contract_fuzz(db_path):
+    """Seeded fuzz over random configurations: model count, parameter
+    dims, replicate count, record flags, batch ladders.  Invariants:
+    exactly n accepted with normalized finite weights, consistent
+    evaluation accounting, record budget respected, no NaN leakage."""
+    import itertools
+
+    rng = np.random.default_rng(0)
+    for case in range(8):
+        M = int(rng.integers(1, 4))
+        dims = [int(rng.integers(1, 4)) for _ in range(M)]
+        K = int(rng.choice([1, 1, 1, 2, 3]))
+        record = bool(rng.integers(0, 2))
+        n = int(rng.integers(40, 120))
+        min_b, max_b = (64, 128) if rng.integers(0, 2) else (256, 1 << 12)
+
+        def make_model(d, shift):
+            def model(key, theta):
+                noise = 0.1 * jax.random.normal(key, (theta.shape[0],))
+                return {"y": theta[:, :d].sum(axis=1) + shift + noise}
+            return model
+
+        models = [make_model(d, 0.1 * j) for j, d in enumerate(dims)]
+        priors = [pt.Distribution(**{f"p{i}": pt.RV("norm", 0.0, 1.0)
+                                     for i in range(d)}) for d in dims]
+        sampler = pt.VectorizedSampler(min_batch_size=min_b,
+                                       max_batch_size=max_b)
+        sampler.record_rejected = record
+        abc = pt.ABCSMC(
+            models, priors, pt.PNormDistance(p=2),
+            population_size=pt.ConstantPopulationSize(
+                n, nr_samples_per_parameter=K),
+            sampler=sampler, seed=case)
+        abc.new("sqlite://", {"y": 0.4})
+        h = abc.run(max_nr_populations=2)
+        assert h.max_t == 1, f"case {case}"
+        for t in (0, 1):
+            probs = h.get_model_probabilities(t)
+            assert float(sum(probs)) == pytest.approx(1.0, abs=1e-5)
+            total = 0
+            for m in range(M):
+                try:
+                    df, w = h.get_distribution(m=m, t=t)
+                except Exception:
+                    continue
+                total += len(df)
+                if len(df):
+                    assert np.all(np.isfinite(w)) and np.all(w >= 0)
+                    assert not df.isna().any().any()
+            assert total == n, f"case {case}: {total} != {n}"
+        pops = h.get_all_populations()
+        assert (pops.samples > 0).all()
