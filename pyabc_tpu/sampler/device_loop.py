@@ -42,7 +42,8 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
     killed by its watchdog (observed at pop=1e6), so the loop caps rounds
     per call and the host re-dispatches with the carried state.
 
-    Returns ``(start, step, finalize, harvest_rec, reset)``:
+    Returns ``(start, step, finalize, harvest_rec, reset,
+    step_finalize)``:
 
     - ``start() -> state`` — zeroed buffers (jitted; allocates the
       cap-sized carry ONCE per loop build — measured ~1.9 s/call through
@@ -182,6 +183,14 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
             new_state.update(_fresh_rec())
         return new_state
 
+    def step_finalize(key, params, state):
+        """Fused step + finalize: ONE dispatch for the common
+        whole-generation-in-one-call case (each separate dispatch costs
+        a relay round-trip that dominates small-population generations).
+        Callers use it when they would prefetch finalize anyway."""
+        state = step(key, params, state)
+        return state, finalize(state, params)
+
     def harvest_rec(state):
         """(per-call record harvest, state with fresh record buffers).
 
@@ -202,4 +211,4 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
         new_state.update(_fresh_rec())
         return rec, new_state
 
-    return start, step, finalize, harvest_rec, reset
+    return start, step, finalize, harvest_rec, reset, step_finalize

@@ -82,15 +82,17 @@ class VectorizedSampler(Sampler):
         else:
             raw = self._raw_round(round_fn, B)
             weight_fn = None
-        start, step, finalize, harvest, reset = build_stateful_loop(
+        fns = build_stateful_loop(
             raw, B, n_target, self.max_rounds_per_call, record_cap, d, s,
             weight_correction=weight_fn)
+        start, step, finalize, harvest, reset, step_finalize = fns
         if self._jit:
             # donate the carry so the cap-sized buffers update in place
             return (jax.jit(start), jax.jit(step, donate_argnums=(2,)),
                     jax.jit(finalize), jax.jit(harvest),
-                    jax.jit(reset, donate_argnums=(0,)))
-        return start, step, finalize, harvest, reset
+                    jax.jit(reset, donate_argnums=(0,)),
+                    jax.jit(step_finalize, donate_argnums=(2,)))
+        return fns
 
     @staticmethod
     def _fn_id(round_fn: Callable):
@@ -243,7 +245,7 @@ class VectorizedSampler(Sampler):
         d, s = self._round_shape(round_fn, B, params)
         loop_key = self._cache_key(
             "sloop", round_fn, B, (n, record_cap, d, s, defer), {})
-        start, step, finalize, harvest, reset = self._get(
+        start, step, finalize, harvest, reset, step_finalize = self._get(
             "sloop", round_fn, B, n, record_cap, d, s, defer)
         prev_state = self._states.pop(loop_key, None)
         state = start() if prev_state is None else reset(prev_state)
@@ -252,44 +254,51 @@ class VectorizedSampler(Sampler):
         out = None
         while True:
             key, sub = jax.random.split(key)
-            state = step(sub, params, state)
-            rec = None
-            if record_cap:
-                # records are harvested + reset every call: the device
-                # buffer bounds one call, max_records bounds the whole
-                # generation (reference first-m-particles accounting);
-                # the arrays stay device-resident (Sample materializes
-                # only what consumers actually read)
-                rec, state = harvest(state)
-                if record_density_fn is not None:
-                    rec["record_density_fn"] = record_density_fn
             # ONE host transfer per call.  When this call is expected to
-            # finish the generation (the common single-call case), fetch
-            # the finalized buffers directly — count/rounds ride along, so
-            # no separate scalar round-trip.  Otherwise sync just the
-            # scalars; the buffers stay device-resident.  (``prefetch_ok``
-            # gates the deferred-mode case on the finalize KDE being
-            # cheap — see above.)
+            # finish the generation (the common single-call case) the
+            # fused step+finalize program runs as a SINGLE dispatch and
+            # the finalized buffers are fetched directly — count/rounds
+            # ride along, no separate scalar round-trip.  Otherwise sync
+            # just the scalars; the buffers stay device-resident.
+            # (``prefetch_ok`` gates the deferred-mode case on the
+            # finalize KDE being cheap — see above.  Record harvesting
+            # needs the un-fused path: the rec buffers are cleared
+            # between step and finalize.)
             expected = count + B * self.max_rounds_per_call * self._rate_est
-            out = out_dev = None
-            if expected >= n and prefetch_ok:
-                out_dev = finalize(state, params)
-                fetch = [out_dev]
-                if rec is not None:
-                    fetch.append(rec["rec_count"])
-                fetch = fetch_to_host(fetch)
-                out = fetch[0]
+            out = out_dev = rec = None
+            if expected >= n and prefetch_ok and not record_cap:
+                state, out_dev = step_finalize(sub, params, state)
+                out = fetch_to_host(out_dev)
                 count, rounds = int(out["count"]), int(out["rounds"])
-                if rec is not None:
-                    rec["rec_count_host"] = int(fetch[1])
             else:
-                scalars = [state["count"], state["rounds"]]
-                if rec is not None:
-                    scalars.append(rec["rec_count"])
-                scalars = fetch_to_host(scalars)
-                count, rounds = int(scalars[0]), int(scalars[1])
-                if rec is not None:
-                    rec["rec_count_host"] = int(scalars[2])
+                state = step(sub, params, state)
+                if record_cap:
+                    # records are harvested + reset every call: the
+                    # device buffer bounds one call, max_records bounds
+                    # the whole generation (reference first-m-particles
+                    # accounting); the arrays stay device-resident
+                    # (Sample materializes only what consumers read)
+                    rec, state = harvest(state)
+                    if record_density_fn is not None:
+                        rec["record_density_fn"] = record_density_fn
+                if expected >= n and prefetch_ok:
+                    out_dev = finalize(state, params)
+                    fetch = [out_dev]
+                    if rec is not None:
+                        fetch.append(rec["rec_count"])
+                    fetch = fetch_to_host(fetch)
+                    out = fetch[0]
+                    count, rounds = int(out["count"]), int(out["rounds"])
+                    if rec is not None:
+                        rec["rec_count_host"] = int(fetch[1])
+                else:
+                    scalars = [state["count"], state["rounds"]]
+                    if rec is not None:
+                        scalars.append(rec["rec_count"])
+                    scalars = fetch_to_host(scalars)
+                    count, rounds = int(scalars[0]), int(scalars[1])
+                    if rec is not None:
+                        rec["rec_count_host"] = int(scalars[2])
             if rec is not None:
                 sample.append_record_batch(rec)
             call_idx += 1
