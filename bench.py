@@ -37,9 +37,10 @@ is one-off).
 - ``sharded_cpu8_*``       — the same sharded program on an 8-device
   virtual CPU mesh (collective data-plane correctness timing)
 
-Every row times 3 generations individually and reports the MEDIAN, with
-the per-generation list alongside (``*_gen_times_s``) so run-to-run
-spread is visible in the captured JSON.
+Every row times its generations individually (5 on the headline
+primary/north-star rows, 3 elsewhere) and reports the MEDIAN, with the
+per-generation list alongside (``*_gen_times_s``) so run-to-run spread
+is visible in the captured JSON.
 """
 
 from __future__ import annotations
