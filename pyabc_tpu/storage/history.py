@@ -112,7 +112,16 @@ class History:
             db = db[len("sqlite:///"):]
         self.in_memory = db in ("sqlite://", ":memory:", "")
         self.db_path = ":memory:" if self.in_memory else db
-        self._conn = sqlite3.connect(self.db_path)
+        # generous busy timeout so concurrent readers (abc-server, a
+        # monitoring notebook) and the writer never see transient
+        # "database is locked" errors; WAL lets readers proceed while a
+        # generation's durable write is in flight
+        self._conn = sqlite3.connect(self.db_path, timeout=30.0)
+        if not self.in_memory:
+            try:
+                self._conn.execute("PRAGMA journal_mode=WAL")
+            except sqlite3.OperationalError:
+                pass  # read-only FS or unsupported: plain journal is fine
         self._conn.executescript(_SCHEMA)
         self._migrate()
         self._conn.commit()

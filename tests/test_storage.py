@@ -267,3 +267,38 @@ def test_bytes_storage_numpy_dtypes_roundtrip():
         back = from_bytes(tag, blob)
         assert back.dtype == np.asarray(arr).dtype, arr.dtype
         np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+
+
+def test_concurrent_reader_during_run(db_path):
+    """A second History connection (the abc-server scenario) reads
+    mid-run state while the writer is live — WAL + busy timeout make
+    this safe on file-backed DBs."""
+    import threading
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=150, seed=0)
+    abc.new(db_path, observed)
+
+    seen = []
+    stop = threading.Event()
+
+    def reader():
+        h = History(db_path, abc_id=1)
+        while not stop.is_set():
+            try:
+                pops = h.get_all_populations()
+                seen.append(len(pops))
+            except Exception as e:  # any locked error fails the test
+                seen.append(e)
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    abc.run(max_nr_populations=3)
+    stop.set()
+    t.join(timeout=10)
+    assert seen and not any(isinstance(s, Exception) for s in seen), seen[-5:]
+    assert max(s for s in seen) >= 2  # reader observed progress
