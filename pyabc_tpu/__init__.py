@@ -79,6 +79,8 @@ from .random_variables import (
     LowerBoundDecorator,
     ModelPerturbationKernel,
     RVBase,
+    ScipyRV,
+    TabulatedRV,
     TruncatedRV,
 )
 from .sampler import (
@@ -125,7 +127,7 @@ __all__ = [
     "SumStatSpec",
     "Model", "SimpleModel", "IntegratedModel", "ModelResult",
     "RV", "RVBase", "Distribution", "ModelPerturbationKernel",
-    "LowerBoundDecorator", "TruncatedRV",
+    "LowerBoundDecorator", "TruncatedRV", "ScipyRV", "TabulatedRV",
     "Distance", "NoDistance", "AcceptAllDistance", "IdentityFakeDistance",
     "SimpleFunctionDistance", "PNormDistance", "AdaptivePNormDistance",
     "AggregatedDistance", "AdaptiveAggregatedDistance", "ZScoreDistance",

@@ -190,3 +190,46 @@ def test_binom_nbinom_degenerate_p():
     assert float(pt.RV("binom", 10, 1.0).log_pdf(jnp.asarray(9.0))) == -np.inf
     assert float(pt.RV("nbinom", 5, 1.0).log_pdf(
         jnp.asarray(0.0))) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_tabulated_rv_device_native(key):
+    """TabulatedRV: device-native approximation of any continuous
+    scipy.stats distribution — accurate tables, jit-safe everywhere
+    (no host callbacks), picklable."""
+    from pyabc_tpu.random_variables import TabulatedRV
+
+    rv = pt.TabulatedRV("skewnorm", 3.0)
+    ref = ss.skewnorm(3.0)
+    x = np.asarray(ref.rvs(size=200, random_state=5), dtype=np.float32)
+    inside = (x > rv._grid[0]) & (x < rv._grid[-1])
+    assert np.allclose(np.asarray(rv.log_pdf(jnp.asarray(x)))[inside],
+                       ref.logpdf(x)[inside], atol=2e-3, rtol=1e-3)
+    assert np.allclose(np.asarray(rv.cdf(jnp.asarray(x))),
+                       ref.cdf(x), atol=2e-3)
+    # sampling distribution matches (KS-style quantile check)
+    draws = np.asarray(jax.jit(lambda k: rv.sample(k, (40000,)))(key))
+    for p in (0.1, 0.25, 0.5, 0.75, 0.9):
+        assert abs(np.quantile(draws, p) - ref.ppf(p)) < 0.03
+    # picklable without tables in the payload path issues
+    import pickle
+    rv2 = pickle.loads(pickle.dumps(rv))
+    assert float(rv2.log_pdf(jnp.asarray(0.5))) == pytest.approx(
+        float(rv.log_pdf(jnp.asarray(0.5))), abs=1e-6)
+    # discrete rejected with a clear error
+    with pytest.raises(ValueError, match="continuous"):
+        TabulatedRV("poisson", 3.0)
+
+
+def test_tabulated_rv_e2e_abcsmc(db_path):
+    """A TabulatedRV prior drives a full run — the device-native path
+    for arbitrary scipy.stats priors on callback-less backends."""
+    def model(key, theta):
+        return {"y": theta[:, 0]
+                + 0.1 * jax.random.normal(key, (theta.shape[0],))}
+
+    prior = pt.Distribution(a=pt.TabulatedRV("gumbel_r", 0.0, 0.5))
+    abc = pt.ABCSMC(model, prior, population_size=200, seed=3)
+    abc.new(db_path, {"y": 0.8})
+    h = abc.run(max_nr_populations=3)
+    df, w = h.get_distribution()
+    assert abs(float(df["a"].to_numpy() @ w) - 0.8) < 0.4
