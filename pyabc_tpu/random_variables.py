@@ -415,24 +415,41 @@ class ScipyRV(RVBase):
         self.kwargs = kwargs
         self._frozen = dist(*args, **kwargs)
         self.discrete = not hasattr(self._frozen.dist, "pdf")
+        # probe NOW, at construction (always outside any jit trace):
+        # the probe itself runs a tiny compiled program, which must not
+        # happen while an ambient trace (e.g. shard_map's) is active
+        self._check_backend()
 
     @classmethod
     def _check_backend(cls):
         """Fail FAST with a clear message on backends without host-callback
         support (notably the axon TPU relay), instead of an opaque
-        UNIMPLEMENTED from deep inside the compiled round."""
+        UNIMPLEMENTED from deep inside the compiled round.  Runs once per
+        process at RV construction — construction is always eager, so the
+        probe's compiled execution never nests inside an ambient trace."""
         if cls._callbacks_supported is None:
             try:
                 import numpy as _np
-                # the probe must SEND an operand: callback-less-capable
-                # backends (the axon relay) fail on host send, and an
-                # input-free probe would not exercise that path
-                out = jax.jit(lambda v: jax.pure_callback(
+                # two subtleties: the probe must SEND an operand
+                # (callback-less backends like the axon relay fail only
+                # on host SEND — an input-free probe passes), and it is
+                # often reached DURING TRACING of a round, where a
+                # plain jit call would inline into the ambient trace and
+                # return a tracer — so lower+compile explicitly and run
+                # the executable on concrete host values
+                probe = jax.jit(lambda v: jax.pure_callback(
                     lambda a: _np.float32(a + 1.0),
-                    jax.ShapeDtypeStruct((), jnp.float32), v))(
-                        jnp.float32(1.0))
-                cls._callbacks_supported = float(out) == 2.0
-            except Exception:
+                    jax.ShapeDtypeStruct((), jnp.float32), v))
+                compiled = probe.lower(
+                    jax.ShapeDtypeStruct((), jnp.float32)).compile()
+                out = compiled(_np.float32(1.0))
+                cls._callbacks_supported = (
+                    float(_np.asarray(out)) == 2.0)
+            except Exception as probe_err:
+                import logging
+                logging.getLogger("ABC").warning(
+                    "host-callback probe failed: %s: %s",
+                    type(probe_err).__name__, probe_err)
                 cls._callbacks_supported = False
         if not cls._callbacks_supported:
             raise RuntimeError(
@@ -696,6 +713,19 @@ def RV(name: Union[str, RVBase], *args, **kwargs) -> RVBase:
             f"unknown RV '{name}': not a native family "
             f"({sorted(_SCIPY_NAME_MAP)}) nor a scipy.stats distribution"
         ) from None
+    except RuntimeError as backend_err:
+        # callback-less backend (the axon relay): fall back to the
+        # device-native tabulated approximation for continuous families
+        try:
+            rv = TabulatedRV(name, *args, **kwargs)
+        except ValueError:
+            raise backend_err from None  # discrete: no tabulated path
+        import logging
+        logging.getLogger("ABC").warning(
+            "RV(%r): no host-callback support on this backend; using "
+            "the device-native TabulatedRV approximation "
+            "(docs/performance.md §11)", name)
+        return rv
 
 
 class Distribution:
