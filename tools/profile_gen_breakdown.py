@@ -51,10 +51,12 @@ def main():
     orig = VectorizedSampler._build_stateful
 
     def patched(self, *a, **kw):
-        start, step, finalize, harvest, reset = orig(self, *a, **kw)
+        (start, step, finalize, harvest, reset,
+         step_finalize) = orig(self, *a, **kw)
         return (_wrap("start", start), _wrap("step", step),
                 _wrap("finalize", finalize), _wrap("harvest", harvest),
-                _wrap("reset_nosync", reset, sync=False))
+                _wrap("reset_nosync", reset, sync=False),
+                _wrap("step_finalize", step_finalize))
 
     VectorizedSampler._build_stateful = patched
 
