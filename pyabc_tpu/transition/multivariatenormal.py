@@ -139,9 +139,17 @@ class MultivariateNormalTransition(Transition):
         if g_needed > _COMPRESS_MAX_G:
             # the grid cannot resolve the bandwidth: fall back to exact
             return None
-        g = 1 << max(int(np.ceil(np.log2(max(g_needed, 256)))), 0)
-        if self._grid_g is not None and g <= self._grid_g <= 4 * g:
-            g = self._grid_g
+        # floor of 8192: starting small and growing later recompiles the
+        # round program (~2-4 s remote) the first time the posterior
+        # contracts; 8192 covers the typical range/bandwidth ratio from
+        # generation one, and grid padding costs ~nothing
+        g = 1 << max(int(np.ceil(np.log2(max(g_needed, 8192)))), 0)
+        # monotone non-decreasing per instance: every distinct G compiles
+        # a fresh round program (~2-4 s through the remote compiler), and
+        # extra grid padding is nearly free — so grow when needed, never
+        # shrink
+        if self._grid_g is not None:
+            g = max(g, self._grid_g)
         self._grid_g = g
         dx = rng / g
         idx = np.clip(((x - lo) / dx).astype(np.int64), 0, g - 1)
