@@ -255,7 +255,7 @@ def bench_ab_vec_vs_sharded():
     for abc in abcs.values():  # compile + warmup
         abc.run(max_nr_populations=1 + warm)
     times = {k: [] for k in abcs}
-    for _ in range(2):  # interleaved timed blocks
+    for _ in range(3):  # interleaved timed blocks
         for name, abc in abcs.items():
             t_before = abc.history.max_t
             abc.run(max_nr_populations=3)
