@@ -90,18 +90,18 @@ def _timed_generations(abc, pop, warmup, timed=3):
     The per-generation list rides along so the spread is visible in the
     captured JSON.
     """
-    import pandas as pd
-
     # ONE run() call for warmup + timed generations: a second run() call
     # would bill its startup (DB re-fit of the transitions) to the first
-    # timed generation.  Per-generation durations come from the stored
-    # population_end_time stamps.
+    # timed generation.  Per-generation durations come from the
+    # orchestrator's append-to-append wall-clock marks (same split as the
+    # DB-timestamp diffs used through round 4, but also valid when
+    # durable writes are batched), with the per-generation TRANSFER
+    # split alongside (VERDICT r4 next #5: wire-byte regressions must be
+    # machine-visible).
     abc.run(max_nr_populations=warmup + timed)
     pops = abc.history.get_all_populations().sort_values("t")
-    ends = pd.to_datetime(pops.population_end_time)
-    dur = ends.diff().dt.total_seconds()
-    sel = np.asarray(pops.t) >= warmup
-    times = dur[sel].tolist()
+    ts = [t for t in sorted(abc.generation_wall_clock) if t >= warmup]
+    times = [abc.generation_wall_clock[t] for t in ts]
     if not times:
         raise RuntimeError("no timed generations completed "
                            "(run stopped during warmup)")
@@ -109,9 +109,19 @@ def _timed_generations(abc, pop, warmup, timed=3):
     # model-evaluation throughput rides along so regressions in the
     # evaluation pipeline are machine-visible even when the acceptance
     # rate drifts (VERDICT r3 #7)
-    evals = np.asarray(pops.samples)[sel]
-    evals_per_sec = float(np.median(evals / np.asarray(times)))
-    return pop / med, med, [round(t, 2) for t in times], evals_per_sec
+    evals = np.asarray(pops.samples)[np.asarray(pops.t) >= warmup]
+    evals_per_sec = float(np.median(evals[:len(times)] / np.asarray(times)))
+    tr = [abc.generation_transfer.get(t, {}) for t in ts]
+    transfer = {
+        "d2h_mb_per_gen": round(float(np.median(
+            [x.get("d2h_bytes", 0) for x in tr])) / 1e6, 3),
+        "transfer_s_per_gen": round(float(np.median(
+            [x.get("d2h_s", 0.0) for x in tr])), 3),
+        "h2d_mb_per_gen": round(float(np.median(
+            [x.get("h2d_bytes", 0) for x in tr])) / 1e6, 3),
+    }
+    return (pop / med, med, [round(t, 2) for t in times], evals_per_sec,
+            transfer)
 
 
 def bench_primary():
@@ -126,9 +136,9 @@ def bench_primary():
         sampler=pt.VectorizedSampler(max_batch_size=1 << 20),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, _, times, evals_ps = _timed_generations(
+    rate, _, times, evals_ps, transfer = _timed_generations(
         abc, POP, WARMUP_GENERATIONS, TIMED_GENERATIONS)
-    return rate, times, evals_ps
+    return rate, times, evals_ps, transfer
 
 
 def bench_northstar():
@@ -147,15 +157,23 @@ def bench_northstar():
         # per-call sync constant (measured ~0.6 s/gen over 8 rounds/call)
         sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
                                      max_rounds_per_call=16),
+        # at 1e6 particles/gen a production run would not persist 4 MB of
+        # per-particle sum-stats per generation; with the documented
+        # stores_sum_stats=False mode (reference history.py:139 parity)
+        # the stats block also leaves the d2h wire — nothing on the host
+        # consumes it (plain PNorm + constant eps).  The posterior gate
+        # (tools/verify_northstar_posterior.py) runs this exact config.
+        stores_sum_stats=False,
         seed=0)
     abc.new("sqlite://", observed)
     # warmup = calibration + prior gen + one full KDE generation (compiles)
-    rate, s_per_gen, times, evals_ps = _timed_generations(
+    rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
         abc, NORTHSTAR_POP, 2, TIMED_GENERATIONS)
     return {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
             "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2),
             "northstar_pop1e6_gen_times_s": times,
-            "northstar_pop1e6_evals_per_sec": round(evals_ps, 1)}
+            "northstar_pop1e6_evals_per_sec": round(evals_ps, 1),
+            **{f"northstar_pop1e6_{k}": v for k, v in transfer.items()}}
 
 
 def bench_kde_1e6():
@@ -213,15 +231,17 @@ def _bench_problem(make_problem, pop, prefix):
                                      max_batch_size=1 << 19),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, s_per_gen, times, evals_ps = _timed_generations(abc, pop, 2, 3)
+    rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
+        abc, pop, 2, 3)
     return {f"{prefix}_accepted_per_sec": round(rate, 1),
             f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 2),
             f"{prefix}_gen_times_s": times,
-            f"{prefix}_evals_per_sec": round(evals_ps, 1)}
+            f"{prefix}_evals_per_sec": round(evals_ps, 1),
+            **{f"{prefix}_{k}": v for k, v in transfer.items()}}
 
 
-SUB_BENCHES = ("kde_1e6", "northstar", "lotka_volterra", "sir",
-               "petab_ode", "sharded_mesh1", "ab_vec_sharded",
+SUB_BENCHES = ("kde_1e6", "northstar", "posterior_gate", "lotka_volterra",
+               "sir", "petab_ode", "sharded_mesh1", "ab_vec_sharded",
                "sharded_cpu8")
 
 
@@ -294,13 +314,14 @@ def bench_sharded(pop: int, prefix: str) -> dict:
                                   max_batch_size=1 << 20),
         seed=0)
     abc.new("sqlite://", observed)
-    rate, s_per_gen, times, evals_ps = _timed_generations(
+    rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
         abc, pop, WARMUP_GENERATIONS, 3)
     return {f"{prefix}_accepted_per_sec": round(rate, 1),
             f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 3),
             f"{prefix}_gen_times_s": times,
             f"{prefix}_evals_per_sec": round(evals_ps, 1),
-            f"{prefix}_n_devices": len(jax.devices())}
+            f"{prefix}_n_devices": len(jax.devices()),
+            **{f"{prefix}_{k}": v for k, v in transfer.items()}}
 
 
 def _run_sub(name: str) -> dict:
@@ -308,6 +329,14 @@ def _run_sub(name: str) -> dict:
         return bench_kde_1e6()
     if name == "northstar":
         return bench_northstar()
+    if name == "posterior_gate":
+        # the 1e6 adaptive posterior-exactness gate (BASELINE.md
+        # "Correctness at scale", now repeatable): perf work cannot
+        # silently trade statistical bias
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from verify_northstar_posterior import run_gate
+        return run_gate()
     if name == "lotka_volterra":
         return _bench_problem(_lv_problem, LV_POP, f"lv_pop{LV_POP // 1000}k")
     if name == "sir":
@@ -329,9 +358,10 @@ def main():
     _enable_compilation_cache()
 
     _log("bench: primary (pop16384 gaussian mixture)")
-    rate, primary_times, primary_evals_ps = bench_primary()
+    rate, primary_times, primary_evals_ps, primary_tr = bench_primary()
     extra["primary_gen_times_s"] = primary_times
     extra["primary_evals_per_sec"] = round(primary_evals_ps, 1)
+    extra.update({f"primary_{k}": v for k, v in primary_tr.items()})
 
     # each sub-bench runs in its OWN process: a TPU-runtime crash in one
     # (e.g. a watchdog kill) must not poison the others or the primary line
@@ -434,12 +464,13 @@ def bench_petab_ode():
                                      max_batch_size=1 << 18),
         seed=0)
     abc.new("sqlite://", importer.get_observed())
-    rate, s_per_gen, times, evals_ps = _timed_generations(
+    rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
         abc, PETAB_POP, 2, 3)
     return {"petab_ode_pop100k_accepted_per_sec": round(rate, 1),
             "petab_ode_pop100k_wallclock_s_per_gen": round(s_per_gen, 2),
             "petab_ode_pop100k_gen_times_s": times,
-            "petab_ode_pop100k_evals_per_sec": round(evals_ps, 1)}
+            "petab_ode_pop100k_evals_per_sec": round(evals_ps, 1),
+            **{f"petab_ode_pop100k_{k}": v for k, v in transfer.items()}}
 
 
 def _lv_problem():

@@ -94,8 +94,17 @@ def fetch_to_host(tree):
     (SPMD control flow), so the collective is well-ordered.  Replicated
     global arrays (counters, scalars) read the local replica without any
     collective.
+
+    Every call is charged to the process-global d2h counters
+    (utils/transfer.py) so wire-byte regressions are machine-visible in
+    the bench JSON.  The recorded seconds include any wait for the
+    producing computation (device_get blocks until the value is ready),
+    so per-generation deltas — not per-call times — are the meaningful
+    split.
     """
     import jax
+
+    from ..utils import transfer
 
     def get(leaf):
         if getattr(leaf, "is_fully_addressable", True):
@@ -106,7 +115,9 @@ def fetch_to_host(tree):
         return np.asarray(multihost_utils.process_allgather(leaf,
                                                             tiled=True))
     import jax.tree_util as tu
-    return jax.device_get(tu.tree_map(get, tree))
+    with transfer.timed_d2h() as timer:
+        out = jax.device_get(tu.tree_map(get, tree))
+    return timer.commit(out)
 
 
 _NAN_MASK_CACHE: dict = {}
@@ -203,11 +214,16 @@ class Sample:
         """Ingest one on-device generation batch (sampler/device_loop.py):
         a single host transfer of the compacted accepted buffers (+ records).
 
-        ``device_view`` optionally carries the same batch's un-fetched
-        device arrays; they are kept on :attr:`device_population` so the
+        ``out`` is the WIRE payload — already host-fetched by the caller,
+        with the float columns max-normalized and narrowed to f16 (and
+        possibly no ``stats`` block at all); this method multiplies the
+        power-of-two scales back in and widens to f32.
+
+        ``device_view`` carries the same batch's un-fetched f32 device
+        arrays; they are kept on :attr:`device_population` so the
         orchestrator can build the next generation's transition support
-        ON device (smc.py `_device_support`) instead of re-uploading ~MBs
-        of host-padded support through the relay.
+        ON device (smc.py `_device_supports`) instead of re-uploading
+        ~MBs of host-padded support through the relay.
         """
         if device_view is not None and all(
                 getattr(v, "is_fully_addressable", True)
@@ -216,20 +232,38 @@ class Sample:
                 k: device_view[k]
                 for k in ("m", "theta", "log_weight", "stats")}
             self.device_population["count"] = device_view["count"]
-        out = fetch_to_host(out)  # ONE bulk d2h transfer, not one per key
         self.nr_evaluations += int(n_evals)
         count = int(out["count"])
         self.raw_accepted += count
-        take = min(count, out["m"].shape[0])
+        if "m_bits" in out:
+            # M <= 2 bit-packed model column (device_loop wire_m_bits);
+            # unpackbits may carry up to 7 zero-pad tail bits
+            out = dict(out)
+            out["m"] = np.unpackbits(np.asarray(out["m_bits"]))
+        take = min(count, out["theta"].shape[0])
+
+        def widen(k):
+            v = np.asarray(out[k][:take], dtype=np.float32)
+            scale = out.get(f"{k}_scale")  # per-column [d] or scalar
+            return (v * np.asarray(scale, dtype=np.float32)
+                    if scale is not None else v)
+
         if take:
-            self._acc.append({
+            batch = {
                 # the device loop narrows m to int8 for the fetch
                 "m": np.asarray(out["m"][:take]).astype(np.int32),
-                "theta": np.asarray(out["theta"][:take]),
-                "distance": np.asarray(out["distance"][:take]),
-                "log_weight": np.asarray(out["log_weight"][:take]),
-                "stats": np.asarray(out["stats"][:take]),
-            })
+                "theta": widen("theta"),
+                "distance": widen("distance"),
+                "log_weight": widen("log_weight"),
+            }
+            if "stats" in out:
+                batch["stats"] = widen("stats")
+            # else: stats were deliberately left off the wire (no host
+            # consumer exists — adaptive distances force fetch_stats=True
+            # upstream, and device consumers read device_population);
+            # attaching a device slice here would bill a fresh
+            # exact-shape kernel every generation for data nobody reads
+            self._acc.append(batch)
         if self.record_rejected and "rec_count" in out:
             rc = min(int(out["rec_count"]),
                      self.max_records - self._n_recorded)
@@ -325,7 +359,10 @@ class Sample:
         theta = self._concat(self._acc, "theta")[:n]
         dist = self._concat(self._acc, "distance")[:n]
         logw = self._concat(self._acc, "log_weight")[:n]
-        stats = self._concat(self._acc, "stats")[:n]
+        # stats may be absent entirely (no-host-consumer wire mode under
+        # a multi-host mesh, where no addressable device view exists)
+        stats = (self._concat(self._acc, "stats")[:n]
+                 if all("stats" in a for a in self._acc) else None)
         # normalize in log space for f32 safety; arrays stay numpy — the
         # population is control-plane state (fits, quantiles, DB writes)
         # and must not cost device dispatches
@@ -337,14 +374,15 @@ class Sample:
         return Population(
             m=m, theta=theta,
             weight=(w / s).astype(np.float32), distance=dist,
-            sum_stats={"__flat__": stats},
+            sum_stats={"__flat__": stats} if stats is not None else {},
         )
 
     def get_all_stats(self) -> np.ndarray:
         """All recorded candidate stats ``[R, S]`` (incl. rejected)."""
         if not self._rec:
-            return self._concat(self._acc, "stats") if self._acc else \
-                np.zeros((0, 0), np.float32)
+            if self._acc and all("stats" in a for a in self._acc):
+                return self._concat(self._acc, "stats")
+            return np.zeros((0, 0), np.float32)
         return self._concat(self._rec, "stats")
 
     _RECORD_KEYS = ("m", "theta", "stats", "distance", "accepted",
@@ -461,6 +499,12 @@ class Sampler:
     def __init__(self):
         self.nr_evaluations_ = 0
         self.record_rejected = False
+        #: whether the [n, s] sum-stats block must ride the d2h wire; the
+        #: orchestrator clears it when NO host consumer exists (History
+        #: with stores_sum_stats=False and a non-adaptive distance) — at
+        #: the 1e6 north star the block is ~a quarter of the whole
+        #: generation's relay budget
+        self.fetch_stats = True
         #: set (with record_rejected) by TemperatureBase.configure_sampler:
         #: records must carry real per-candidate proposal densities.
         #: Rounds still skip the KDE (deferred mode); the densities are

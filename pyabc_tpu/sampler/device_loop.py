@@ -26,9 +26,41 @@ from jax import lax
 Array = jnp.ndarray
 
 
+def _wire_scale(v, valid):
+    """PER-COLUMN power-of-two scales of the finite max magnitudes.
+
+    Columns ride the wire as ``f16(v / scale)`` with the f32 scales
+    alongside: dividing by an exact power of two is lossless, each
+    column's scaled max lands in (0.5, 1] so overflow is impossible for
+    ANY data scale, and a column of tiny values (a 1e-7-scale rate
+    constant) is lifted out of the f16 subnormal range instead of
+    quantizing to multiples of 5.96e-8.  Scales are per COLUMN (axis 0
+    reduction, a [d] vector for 2-D blocks) because parameter/stat
+    columns of one model routinely span many orders of magnitude —
+    a shared scale would crush the small ones.  Residual error is pure
+    f16 rounding: ~2^-11 relative for every value within 2^14 of its
+    own column's max.
+
+    ``valid`` masks the rows ``[0:count]`` actually written this
+    generation: the carry buffers beyond ``count`` hold stale previous-
+    generation values (reset() is a cursor rewind) which must not leak
+    into the scales.
+    """
+    mask = jnp.isfinite(v) & (valid[:, None] if v.ndim == 2 else valid)
+    mx = jnp.max(jnp.where(mask, jnp.abs(v), 0.0), axis=0)
+    e = jnp.where(mx > 0, jnp.ceil(jnp.log2(mx)), 0.0)
+    # clamp to f32 NORMAL exponents: exp2(128) is inf (a column max in
+    # (2^127, 2^128) would zero the wire and widen to NaN) and a
+    # subnormal scale could overflow the division; the clamped extremes
+    # still land every value inside f16's finite range
+    return jnp.exp2(jnp.clip(e, -126.0, 127.0)).astype(jnp.float32)
+
+
 def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
                         max_rounds: int, record_cap: int, d: int, s: int,
-                        weight_correction: Callable = None):
+                        weight_correction: Callable = None,
+                        wire_stats: bool = True,
+                        wire_m_bits: bool = False):
     """Carry-state generation loop for the remote-relay regime: accepted particles ACCUMULATE in device-resident buffers
     across host calls, so the host fetches one scalar (``count``) per call
     and the full buffers exactly ONCE per generation.
@@ -50,8 +82,19 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
       the relay at pop 1e6, so callers must not re-start per generation)
     - ``step(key, params, state) -> state`` — up to ``max_rounds`` rounds;
       donates ``state`` so buffers update in place
-    - ``finalize(state, params) -> out`` — accepted buffers + counts for
-      the one full host fetch per generation
+    - ``finalize(state, params) -> (wire, view)`` — ``wire`` is the
+      narrow-dtype fetch payload: int8/bit-packed model column and
+      float16 float columns, each max-normalized by an exact power-of-
+      two scale shipped alongside (``_wire_scale``), so ANY data scale
+      survives the wire with plain f16 rounding (~5e-4 relative — ABC
+      tolerances dwarf it); ``view``
+      is the same data as f32 device-resident slices, consumed ON
+      device (next-gen KDE supports, distance recomputes) and as the
+      exact fallback.  ``wire_stats=False`` drops the ``[n, s]`` stats
+      block from the wire entirely — the orchestrator sets it when no
+      host consumer exists (non-adaptive distance + History with
+      ``stores_sum_stats=False``), reclaiming its share of the ~6-8
+      MB/s relay budget
     - ``harvest_rec(state) -> (rec, state)`` — per-call record fetch with
       cursor reset (see its docstring)
     - ``reset(state) -> state`` — O(1) cursor rewind reusing the live
@@ -158,21 +201,52 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
 
     def finalize(state, params):
         keys = ("m", "theta", "distance", "log_weight", "stats")
-        out = {k: state[k][:n_target] for k in keys}
-        # the model column rides the ~6 MB/s relay as int8 (25 % of the
-        # i32 bytes); the ingest widens it back.  M is bounded far below
-        # 127 (model-selection problems have a handful of models).
-        out["m"] = out["m"].astype(jnp.int8)
+        view = {k: state[k][:n_target] for k in keys}
         if weight_correction is not None:
-            log_denom = weight_correction(out["m"], out["theta"], params)
+            log_denom = weight_correction(view["m"], view["theta"], params)
             # unfilled rows carry -inf partial weights; leave them alone
             # (-inf − -inf would be NaN if the density underflowed too)
-            lw = out["log_weight"]
-            out["log_weight"] = jnp.where(
+            lw = view["log_weight"]
+            view["log_weight"] = jnp.where(
                 jnp.isfinite(lw), lw - log_denom, lw)
-        out["count"] = state["count"]
-        out["rounds"] = state["rounds"]
-        return out
+        view["count"] = state["count"]
+        # wire format: int8/bit-packed model column and max-normalized
+        # f16 float columns — halves the bytes on the ~6-8 MB/s relay,
+        # which IS the generation budget at pop 1e6 (BASELINE.md).  The
+        # ingest widens back to f32; exactness-sensitive consumers read
+        # the f32 ``view`` on device.
+        wire_cols = ("theta", "distance") + (
+            ("stats",) if wire_stats else ())
+        if wire_m_bits:
+            # M <= 2: the model column is one bit per particle; packbits
+            # cuts its wire share 8x (1 MB -> 128 KB at the 1e6 north
+            # star).  jnp.packbits zero-pads the tail byte.
+            wire = {"m_bits": jnp.packbits(view["m"].astype(jnp.uint8))}
+        else:
+            wire = {"m": view["m"].astype(jnp.int8)}
+        # rows beyond this generation's count are STALE carry-buffer
+        # contents (reset() is a cursor rewind) — they must not feed the
+        # scale/shift reductions; partial generations (max_eval break)
+        # legitimately finalize with count < n_target
+        valid = jnp.arange(n_target) < state["count"]
+        for k in wire_cols:
+            v = view[k]
+            s = _wire_scale(v, valid)
+            wire[k] = (v / s).astype(jnp.float16)
+            wire[f"{k}_scale"] = s
+        # weight normalization is shift-invariant, so ship log weights
+        # relative to the batch max: the DOMINANT weights then sit near 0
+        # where f16 is essentially exact, and the quantization error of a
+        # weight scales with its own irrelevance
+        lw = view["log_weight"]
+        lw_shift = jnp.max(jnp.where(jnp.isfinite(lw) & valid, lw,
+                                     -jnp.inf))
+        wire["log_weight"] = (
+            lw - jnp.where(jnp.isfinite(lw_shift), lw_shift, 0.0)
+        ).astype(jnp.float16)
+        wire["count"] = state["count"]
+        wire["rounds"] = state["rounds"]
+        return wire, view
 
     def reset(state):
         new_state = dict(state)
@@ -189,7 +263,8 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
         a relay round-trip that dominates small-population generations).
         Callers use it when they would prefetch finalize anyway."""
         state = step(key, params, state)
-        return state, finalize(state, params)
+        wire, view = finalize(state, params)
+        return state, wire, view
 
     def harvest_rec(state):
         """(per-call record harvest, state with fresh record buffers).

@@ -73,7 +73,8 @@ class VectorizedSampler(Sampler):
 
     def _build_stateful(self, round_fn: Callable, B: int, n_target: int,
                         record_cap: int, d: int, s: int,
-                        defer: bool = False):
+                        defer: bool = False, wire_stats: bool = True,
+                        wire_m_bits: bool = False):
         if defer:
             # rounds skip the proposal-density KDE (the hot op); finalize
             # subtracts it once over the accepted buffer instead
@@ -84,7 +85,8 @@ class VectorizedSampler(Sampler):
             weight_fn = None
         fns = build_stateful_loop(
             raw, B, n_target, self.max_rounds_per_call, record_cap, d, s,
-            weight_correction=weight_fn)
+            weight_correction=weight_fn, wire_stats=wire_stats,
+            wire_m_bits=wire_m_bits)
         start, step, finalize, harvest, reset, step_finalize = fns
         if self._jit:
             # donate the carry so the cap-sized buffers update in place
@@ -177,6 +179,11 @@ class VectorizedSampler(Sampler):
         # pin them on device ONCE — otherwise every step/finalize call
         # re-uploads the ~MBs of transition support (measured 0.43 s/call
         # at the 1e6 north star through the relay)
+        from ..utils import transfer
+        transfer.record_h2d(sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(params)
+            if isinstance(leaf, np.ndarray)))
         params = jax.device_put(params)
         if all_accepted:
             # calibration: exact-size rounds (reference all_accepted path,
@@ -243,10 +250,17 @@ class VectorizedSampler(Sampler):
         prefetch_ok = (not defer or self._deferred_finalize_pairs(
             params, n) <= self.MAX_PREFETCH_PAIRS)
         d, s = self._round_shape(round_fn, B, params)
+        wire_stats = bool(self.fetch_stats)
+        # two-model problems ship the model column bit-packed (8x fewer
+        # bytes on the relay d2h link)
+        wire_m_bits = getattr(getattr(round_fn, "__self__", None),
+                              "M", 127) <= 2
         loop_key = self._cache_key(
-            "sloop", round_fn, B, (n, record_cap, d, s, defer), {})
+            "sloop", round_fn, B,
+            (n, record_cap, d, s, defer, wire_stats, wire_m_bits), {})
         start, step, finalize, harvest, reset, step_finalize = self._get(
-            "sloop", round_fn, B, n, record_cap, d, s, defer)
+            "sloop", round_fn, B, n, record_cap, d, s, defer, wire_stats,
+            wire_m_bits)
         prev_state = self._states.pop(loop_key, None)
         state = start() if prev_state is None else reset(prev_state)
         call_idx = 0
@@ -267,8 +281,8 @@ class VectorizedSampler(Sampler):
             expected = count + B * self.max_rounds_per_call * self._rate_est
             out = out_dev = rec = None
             if expected >= n and prefetch_ok and not record_cap:
-                state, out_dev = step_finalize(sub, params, state)
-                out = fetch_to_host(out_dev)
+                state, wire_dev, out_dev = step_finalize(sub, params, state)
+                out = fetch_to_host(wire_dev)
                 count, rounds = int(out["count"]), int(out["rounds"])
             else:
                 state = step(sub, params, state)
@@ -282,8 +296,8 @@ class VectorizedSampler(Sampler):
                     if record_density_fn is not None:
                         rec["record_density_fn"] = record_density_fn
                 if expected >= n and prefetch_ok:
-                    out_dev = finalize(state, params)
-                    fetch = [out_dev]
+                    wire_dev, out_dev = finalize(state, params)
+                    fetch = [wire_dev]
                     if rec is not None:
                         fetch.append(rec["rec_count"])
                     fetch = fetch_to_host(fetch)
@@ -319,8 +333,8 @@ class VectorizedSampler(Sampler):
                 break
             out = out_dev = None  # mis-predicted prefetch: discard
         if out is None:
-            out_dev = finalize(state, params)
-            out = fetch_to_host(out_dev)
+            wire_dev, out_dev = finalize(state, params)
+            out = fetch_to_host(wire_dev)
         # keep the carry buffers alive for the next generation's reset;
         # bound the cache so states orphaned by a batch-ladder change
         # don't pin device memory

@@ -105,9 +105,22 @@ class History:
 
     ``db`` may be a path, ``"sqlite://"`` (in-memory, for benchmarking —
     reference smc.py:272-277) or ``"sqlite:///path"``.
+
+    ``stores_sum_stats`` (reference history.py:120,139,154,681): when
+    False, per-particle summary statistics are not persisted — and, going
+    one step further than the reference (which still computes and ships
+    them to the master before dropping them), the orchestrator then tells
+    the sampler to keep the ``[n, s]`` stats block OFF the d2h wire
+    entirely when no other host consumer exists (smc.py run()), which at
+    the 1e6-particle north star is ~a quarter of the generation's
+    transfer budget.  Stats-dependent reads (:meth:`get_sum_stats`,
+    weighted-stats queries, resume of an *adaptive-distance* run) then
+    return empty, as in the reference.
     """
 
-    def __init__(self, db: str, abc_id: Optional[int] = None):
+    def __init__(self, db: str, abc_id: Optional[int] = None,
+                 stores_sum_stats: bool = True):
+        self.stores_sum_stats = bool(stores_sum_stats)
         if db.startswith("sqlite:///"):
             db = db[len("sqlite:///"):]
         self.in_memory = db in ("sqlite://", ":memory:", "")
@@ -203,7 +216,10 @@ class History:
         theta = np.asarray(population.theta)
         w = np.asarray(population.weight)
         d = np.asarray(population.distance)
-        stats = population.sum_stats.get("__flat__")
+        stats = (population.sum_stats.get("__flat__")
+                 if self.stores_sum_stats else None)
+        # np.asarray on a device-resident block is the transfer — when the
+        # flag is off it must never run
         stats = np.asarray(stats) if stats is not None else None
         per_model_names = (param_names
                            and isinstance(param_names[0], (list, tuple)))

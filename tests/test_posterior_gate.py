@@ -1,0 +1,23 @@
+"""CI smoke for the north-star posterior-exactness gate
+(tools/verify_northstar_posterior.py; VERDICT r4 next #6).
+
+The driver-grade gate runs pop 1e6 on the chip inside bench.py; here the
+same code path runs a small population on the CPU mesh so a statistical
+regression in the fast paths (wire narrowing, deferred proposal, device
+supports) is caught by the ordinary test suite.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from verify_northstar_posterior import run_gate  # noqa: E402
+
+
+def test_gate_smoke_small_pop():
+    out = run_gate(pop=20_000, gens=6, seed=0)
+    assert out["posterior_gate_ok"], out
+    # epsilon must actually have annealed (the gate exercises refits)
+    assert out["posterior_gate_final_eps"] < 0.1, out

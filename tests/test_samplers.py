@@ -146,8 +146,11 @@ def test_deferred_weights_match_eager_kernel(db_path):
     # UniformAcceptor: acc weight 1 -> weight ∝ exp(log_prior - log_denom)
     expected = np.exp(log_prior - log_denom - (log_prior - log_denom).max())
     expected = expected / expected.sum()
+    # stored weights crossed the max-shifted f16 log-weight wire
+    # (sampler/device_loop.py finalize): dominant weights are near-exact,
+    # small ones carry up to ~|log w/w_max|·2^-11 relative error
     np.testing.assert_allclose(np.asarray(pop.weight), expected,
-                               rtol=2e-4, atol=1e-8)
+                               rtol=5e-3, atol=1e-8)
 
 
 def test_nr_samples_per_parameter_weights():

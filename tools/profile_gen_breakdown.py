@@ -60,10 +60,17 @@ def main():
 
     VectorizedSampler._build_stateful = patched
 
-    # host-side pieces
+    # host-side pieces.  The d2h transfer lives in fetch_to_host (the
+    # ingest itself only widens host arrays since the f16-wire change);
+    # patch BOTH the defining module and the vectorized module's
+    # from-import binding, or the wrapper never runs.
     import pyabc_tpu.sampler.base as sbase
+    import pyabc_tpu.sampler.vectorized as vec_mod
+    wrapped_fetch = _wrap("d2h_fetch", sbase.fetch_to_host, sync=False)
+    sbase.fetch_to_host = wrapped_fetch
+    vec_mod.fetch_to_host = wrapped_fetch
     sbase.Sample.append_device_batch = _wrap(
-        "ingest_fetch", sbase.Sample.append_device_batch, sync=False)
+        "ingest_widen", sbase.Sample.append_device_batch, sync=False)
     orig_dput = jax.device_put
     jax.device_put = _wrap("device_put", orig_dput, sync=False)
     import pyabc_tpu.storage.history as hist_mod
@@ -160,6 +167,11 @@ def main():
     for name, ts in TIMES.items():
         print(f"{name:14s} n={len(ts):3d} total={sum(ts):7.2f}s "
               f"last5={[round(t, 3) for t in ts[-5:]]}")
+    for t in sorted(abc.generation_transfer):
+        tr = abc.generation_transfer[t]
+        print(f"gen {t}: wall={abc.generation_wall_clock.get(t, 0):.2f}s "
+              f"d2h={tr['d2h_bytes'] / 1e6:.2f}MB/{tr['d2h_s']:.2f}s "
+              f"({tr['d2h_calls']} calls) h2d={tr['h2d_bytes'] / 1e6:.2f}MB")
     # transition state
     for m, tr in enumerate(abc.transitions):
         comp = getattr(tr, "_compressed", None)

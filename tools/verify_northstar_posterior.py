@@ -1,0 +1,90 @@
+"""Posterior-exactness gate for the 1e6-particle north-star fast paths.
+
+Round 4 established (BASELINE.md "Correctness at scale") that an
+11-generation ADAPTIVE 1e6-particle run through every fast path —
+grid-compressed pdf support, carry-buffer reuse, device-gathered
+transition supports, f16/bit-packed wire, deferred-proposal prefetch —
+reproduces the analytic model posterior of the two-Gaussians problem to
+four digits.  This script makes that check a repeatable pass/fail gate
+(VERDICT r4 next #6) so perf work can never silently trade statistical
+bias: it prints ONE JSON line and exits non-zero on failure.
+
+    python tools/verify_northstar_posterior.py [--pop N] [--gens G]
+
+Reference ground truth: the analytic model-B posterior of
+``two_competing_gaussians_multiple_population``
+(reference test/base/test_samplers.py:186-203); tolerance 2e-3 absolute
+on the model probability (the Monte-Carlo noise floor at 1e6 particles
+is ~4e-4, so a pass genuinely certifies the 4-digit claim while not
+flaking on seed weather), 3e-3 on the posterior mean of mu (true 1.0).
+
+The default pop can be lowered for CI smoke (tests run pop 20k on CPU);
+the driver-grade gate is pop 1e6 on the chip, recorded in the bench
+extra as ``posterior_gate_ok``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run_gate(pop: int = 1_000_000, gens: int = 11,
+             seed: int = 0) -> dict:
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    models, priors, distance, observed, posterior_fn = \
+        make_two_gaussians_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=pop,
+        eps=pt.MedianEpsilon(),  # anneals: exercises refit every gen
+        sampler=pt.VectorizedSampler(
+            max_batch_size=1 << 19, max_rounds_per_call=16),
+        # the bench's north-star wire mode: stats off the wire entirely
+        stores_sum_stats=False,
+        seed=seed)
+    abc.new("sqlite://", observed)
+    abc.run(max_nr_populations=gens)
+    t = abc.history.max_t
+    probs = abc.history.get_model_probabilities(t)
+    p_b = float(probs.get(1, 0.0))
+    p_true = float(posterior_fn(1.0))
+    df, w = abc.history.get_distribution(m=1, t=t)
+    mu = float(np.sum(np.asarray(df["mu"]) * w)) if len(df) else float("nan")
+    # Monte-Carlo floor: std(p_B) ~ 0.7/sqrt(pop) at the observed ESS
+    # fraction, so 2.5e-3 at pop 1e6 is ~3.5 sigma — a pass certifies the
+    # 4-digit claim without flaking on seed weather.  Smaller smoke pops
+    # scale the tolerance with 1/sqrt(pop).
+    tol_p = max(2.5e-3, 2.5 / pop ** 0.5)
+    tol_mu = max(3e-3, 3.0 / pop ** 0.5)
+    ok = abs(p_b - p_true) < tol_p and abs(mu - 1.0) < tol_mu
+    return {
+        "posterior_gate_ok": bool(ok),
+        "posterior_gate_p_model_b": round(p_b, 5),
+        "posterior_gate_p_analytic": round(p_true, 5),
+        "posterior_gate_mu": round(mu, 5),
+        "posterior_gate_pop": pop,
+        "posterior_gate_gens": int(t + 1),
+        "posterior_gate_final_eps": round(
+            float(abc.history.get_all_populations().epsilon.iloc[-1]), 6),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pop", type=int, default=1_000_000)
+    ap.add_argument("--gens", type=int, default=11)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    out = run_gate(args.pop, args.gens, args.seed)
+    print(json.dumps(out))
+    sys.exit(0 if out["posterior_gate_ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
