@@ -511,23 +511,35 @@ class ScipyRV(RVBase):
                 "kwargs": {k: float(v) for k, v in self.kwargs.items()}}
 
 
+#: widest discrete support TabulatedRV will tabulate (f32 table = 4 MB)
+_TABULATED_MAX_DISCRETE_SUPPORT = 1 << 20
+
+
 class TabulatedRV(RVBase):
-    """DEVICE-NATIVE approximation of any scipy.stats *continuous*
-    distribution via dense quantile / log-pdf tables.
+    """DEVICE-NATIVE approximation of any scipy.stats distribution via
+    dense quantile / log-pdf tables (continuous) or an explicit pmf table
+    with cumsum-inverse sampling (discrete).
 
     :class:`ScipyRV` is exact but needs host-callback support, which the
-    axon TPU relay lacks.  This wrapper builds, ONCE on the host, a
-    ``table_size``-point inverse-CDF table over the central
-    ``1 − 2·tail_mass`` probability mass plus a log-pdf grid; sampling
-    and density evaluation are then pure device interpolations — they
-    compile into the fused round like any native family.
+    axon TPU relay lacks.  This wrapper builds, ONCE on the host:
+
+    - *continuous*: a ``table_size``-point inverse-CDF table over the
+      central ``1 − 2·tail_mass`` probability mass plus a log-pdf grid;
+      sampling and density evaluation are pure device interpolations.
+    - *discrete* (reference accepts any scipy.stats name anywhere,
+      pyabc/random_variables.py:147-169): the pmf over the integer
+      support between the ``tail_mass`` and ``1 − tail_mass`` quantiles
+      (exactly the full support for bounded families like ``hypergeom``),
+      renormalized; sampling is inverse-CDF over the cumulative table,
+      log-pmf is a table gather at ``round(x)`` — both compile into the
+      fused round like any native family.
 
     Approximation: support truncated to the [tail_mass, 1 − tail_mass]
-    quantile range (density renormalized accordingly) and
-    piecewise-linear interpolation between table points — with the
-    default 4096 points and 1e-6 tails the error is far below ABC's
-    Monte-Carlo noise.  For exact semantics on a callback-capable
-    backend use ``ScipyRV``.
+    quantile range (density renormalized accordingly); continuous tables
+    additionally interpolate piecewise-linearly — with the default 4096
+    points and 1e-6 tails the error is far below ABC's Monte-Carlo
+    noise, and discrete tables are EXACT up to the truncated tail mass.
+    For exact semantics on a callback-capable backend use ``ScipyRV``.
     """
 
     def __init__(self, name: str, *args, table_size: int = 4096,
@@ -539,12 +551,16 @@ class TabulatedRV(RVBase):
         if dist is None or not hasattr(dist, "rvs"):
             raise ValueError(f"'{name}' is not a scipy.stats distribution")
         frozen = dist(*args, **kwargs)
-        if not hasattr(frozen.dist, "pdf"):
-            raise ValueError(
-                "TabulatedRV supports continuous distributions only "
-                f"('{name}' is discrete)")
         self.name, self.args, self.kwargs = name, args, kwargs
         self.table_size, self.tail_mass = int(table_size), float(tail_mass)
+        self._discrete = not hasattr(frozen.dist, "pdf")
+        if self._discrete:
+            self._build_discrete(frozen, np)
+        else:
+            self._build_continuous(frozen, np)
+
+    def _build_continuous(self, frozen, np):
+        tail_mass, table_size = self.tail_mass, self.table_size
         q = np.linspace(tail_mass, 1.0 - tail_mass, table_size)
         x_of_q = np.asarray(frozen.ppf(q), dtype=np.float64)
         grid = np.linspace(x_of_q[0], x_of_q[-1], table_size)
@@ -558,12 +574,65 @@ class TabulatedRV(RVBase):
         self._logpdf = jnp.asarray(
             np.where(np.isfinite(logpdf), logpdf, -1e30), jnp.float32)
 
+    def _build_discrete(self, frozen, np):
+        tail = self.tail_mass
+        # prefer the EXACT support for bounded families (hypergeom,
+        # randint, binom, ...): the table is then exact, no truncation at
+        # all; unbounded tails (poisson, skellam, ...) truncate at the
+        # tail_mass quantiles
+        a, b = (float(v) for v in frozen.support())
+        k_lo = a if np.isfinite(a) else float(np.asarray(frozen.ppf(tail)))
+        k_hi = b if np.isfinite(b) else float(
+            np.asarray(frozen.ppf(1.0 - tail)))
+        if not (np.isfinite(k_lo) and np.isfinite(k_hi)):
+            raise ValueError(
+                f"'{self.name}': could not bound the discrete support "
+                f"(quantiles at tail_mass={tail} are non-finite)")
+        if int(k_hi - k_lo) + 1 > _TABULATED_MAX_DISCRETE_SUPPORT:
+            # an exact-but-huge bounded support falls back to the
+            # quantile-truncated core before giving up
+            k_lo = float(np.asarray(frozen.ppf(tail)))
+            k_hi = float(np.asarray(frozen.ppf(1.0 - tail)))
+        width = int(k_hi - k_lo) + 1
+        if width > _TABULATED_MAX_DISCRETE_SUPPORT:
+            raise ValueError(
+                f"'{self.name}': discrete support of {width} points "
+                f"exceeds the tabulation bound "
+                f"({_TABULATED_MAX_DISCRETE_SUPPORT}); raise tail_mass "
+                "or use ScipyRV on a callback-capable backend")
+        ks = np.arange(width, dtype=np.float64) + k_lo
+        with np.errstate(all="ignore"):
+            logpmf = np.asarray(frozen.logpmf(ks), dtype=np.float64)
+        logpmf = np.where(np.isfinite(logpmf), logpmf, -np.inf)
+        pmf = np.exp(logpmf)
+        total = pmf.sum()
+        if not (total > 0):
+            raise ValueError(
+                f"'{self.name}': pmf mass over the tabulated support is 0")
+        self._k_lo = float(k_lo)
+        self._k_hi = float(k_hi)
+        self._log_pmf = jnp.asarray(
+            np.where(np.isfinite(logpmf), logpmf - np.log(total), -1e30),
+            jnp.float32)
+        # cumulative table in f64-on-host for a clean inverse CDF; the
+        # device comparison is f32, fine at ABC's Monte-Carlo noise
+        self._cum = jnp.asarray(np.cumsum(pmf / total), jnp.float32)
+
+    @property
+    def discrete(self) -> bool:
+        return self._discrete
+
     def __reduce__(self):
         return (_rebuild_tabulated,
                 (self.name, self.args, self.table_size, self.tail_mass,
                  self.kwargs))
 
     def sample(self, key, shape=()):
+        if self._discrete:
+            u = jax.random.uniform(key, shape)
+            idx = jnp.searchsorted(self._cum, u, side="left")
+            return self._k_lo + jnp.clip(
+                idx, 0, self._cum.shape[0] - 1).astype(jnp.float32)
         u = jax.random.uniform(
             key, shape, minval=self.tail_mass,
             maxval=1.0 - self.tail_mass)
@@ -571,12 +640,25 @@ class TabulatedRV(RVBase):
 
     def log_pdf(self, x):
         x = jnp.asarray(x, jnp.float32)
+        if self._discrete:
+            k = jnp.round(x)
+            idx = jnp.clip(k - self._k_lo, 0,
+                           self._log_pmf.shape[0] - 1).astype(jnp.int32)
+            val = self._log_pmf[idx]
+            ok = (k >= self._k_lo) & (k <= self._k_hi) & (val > -1e29)
+            return jnp.where(ok, val, -jnp.inf)
         inside = (x >= self._grid[0]) & (x <= self._grid[-1])
         val = jnp.interp(x, self._grid, self._logpdf)
         return jnp.where(inside & (val > -1e29), val, -jnp.inf)
 
     def cdf(self, x):
         x = jnp.asarray(x, jnp.float32)
+        if self._discrete:
+            idx = jnp.floor(x - self._k_lo).astype(jnp.int32)
+            safe = jnp.clip(idx, 0, self._cum.shape[0] - 1)
+            val = self._cum[safe]
+            return jnp.where(idx < 0, 0.0,
+                             jnp.where(idx >= self._cum.shape[0], 1.0, val))
         raw = jnp.interp(x, self._x_of_q, self._q,
                          left=0.0, right=1.0)
         return jnp.clip(raw, 0.0, 1.0)
@@ -715,11 +797,17 @@ def RV(name: Union[str, RVBase], *args, **kwargs) -> RVBase:
         ) from None
     except RuntimeError as backend_err:
         # callback-less backend (the axon relay): fall back to the
-        # device-native tabulated approximation for continuous families
+        # device-native tabulated approximation — quantile/log-pdf
+        # tables for continuous families, pmf table + cumsum-inverse
+        # sampling for discrete ones
         try:
             rv = TabulatedRV(name, *args, **kwargs)
-        except ValueError:
-            raise backend_err from None  # discrete: no tabulated path
+        except ValueError as tab_err:
+            # untabulatable: keep BOTH remedies visible (the tabulation
+            # error often has the cheaper fix, e.g. raising tail_mass)
+            raise RuntimeError(
+                f"{backend_err}  The TabulatedRV fallback also failed: "
+                f"{tab_err}") from tab_err
         import logging
         logging.getLogger("ABC").warning(
             "RV(%r): no host-callback support on this backend; using "
