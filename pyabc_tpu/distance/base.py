@@ -80,6 +80,13 @@ class Distance:
         """Per-generation adaptation; return True iff params changed."""
         return False
 
+    def params_time_invariant(self) -> bool:
+        """True iff ``get_params(t)`` is the same pytree for every t of
+        the current run.  Consumers that bake params into a compiled
+        program spanning multiple generations (the fused
+        multi-generation engine, smc.py) must check this."""
+        return True
+
     # ---- dynamic params + pure compute ----------------------------------
 
     def get_params(self, t: int):

@@ -123,6 +123,11 @@ class PNormDistance(Distance):
         tt = max(ts) if ts else min(self.weights)
         return self.weights[tt]
 
+    def params_time_invariant(self) -> bool:
+        # time-indexed {t: {key: w}} weight schedules change get_params
+        # across generations even without adaptivity
+        return len(self.weights) <= 1
+
     def get_params(self, t: int):
         w = self._weights_for(t)
         f = self.factors if self.factors is not None else np.ones_like(w)
@@ -248,6 +253,9 @@ class AggregatedDistance(Distance):
         super().configure_sampler(sampler)
         for d in self.distances:
             d.configure_sampler(sampler)
+
+    def params_time_invariant(self) -> bool:
+        return all(d.params_time_invariant() for d in self.distances)
 
     def update(self, t, get_all_stats=None) -> bool:
         changed = False

@@ -120,6 +120,28 @@ def fetch_to_host(tree):
     return timer.commit(out)
 
 
+def widen_wire(out: dict, take: int) -> dict:
+    """THE wire decoder (host side of ``device_loop.narrow_wire``):
+    bit-unpack the model column, multiply the per-column power-of-two
+    scales back in, widen f16 to f32, truncate to ``take`` rows.
+    Returns numpy ``m``/``theta``/``distance``/``log_weight``
+    (/``stats`` when it rode the wire)."""
+    if "m_bits" in out:
+        # unpackbits may carry up to 7 zero-pad tail bits
+        m = np.unpackbits(np.asarray(out["m_bits"]))[:take]
+    else:
+        m = np.asarray(out["m"][:take])
+    batch = {"m": m.astype(np.int32)}
+    for k in ("theta", "distance", "log_weight", "stats"):
+        if k not in out:
+            continue
+        v = np.asarray(out[k][:take], dtype=np.float32)
+        scale = out.get(f"{k}_scale")  # per-column [d] or scalar
+        batch[k] = (v * np.asarray(scale, dtype=np.float32)
+                    if scale is not None else v)
+    return batch
+
+
 _NAN_MASK_CACHE: dict = {}
 
 
@@ -230,40 +252,20 @@ class Sample:
                 for v in device_view.values()):
             self.device_population = {
                 k: device_view[k]
-                for k in ("m", "theta", "log_weight", "stats")}
+                for k in ("m", "theta", "log_weight", "stats",
+                          "distance")}
             self.device_population["count"] = device_view["count"]
         self.nr_evaluations += int(n_evals)
         count = int(out["count"])
         self.raw_accepted += count
-        if "m_bits" in out:
-            # M <= 2 bit-packed model column (device_loop wire_m_bits);
-            # unpackbits may carry up to 7 zero-pad tail bits
-            out = dict(out)
-            out["m"] = np.unpackbits(np.asarray(out["m_bits"]))
         take = min(count, out["theta"].shape[0])
-
-        def widen(k):
-            v = np.asarray(out[k][:take], dtype=np.float32)
-            scale = out.get(f"{k}_scale")  # per-column [d] or scalar
-            return (v * np.asarray(scale, dtype=np.float32)
-                    if scale is not None else v)
-
         if take:
-            batch = {
-                # the device loop narrows m to int8 for the fetch
-                "m": np.asarray(out["m"][:take]).astype(np.int32),
-                "theta": widen("theta"),
-                "distance": widen("distance"),
-                "log_weight": widen("log_weight"),
-            }
-            if "stats" in out:
-                batch["stats"] = widen("stats")
-            # else: stats were deliberately left off the wire (no host
+            # stats may be deliberately missing from the wire (no host
             # consumer exists — adaptive distances force fetch_stats=True
             # upstream, and device consumers read device_population);
             # attaching a device slice here would bill a fresh
             # exact-shape kernel every generation for data nobody reads
-            self._acc.append(batch)
+            self._acc.append(widen_wire(out, take))
         if self.record_rejected and "rec_count" in out:
             rc = min(int(out["rec_count"]),
                      self.max_records - self._n_recorded)

@@ -56,6 +56,40 @@ def _wire_scale(v, valid):
     return jnp.exp2(jnp.clip(e, -126.0, 127.0)).astype(jnp.float32)
 
 
+def narrow_wire(view: dict, valid, wire_stats: bool, wire_m_bits: bool
+                ) -> dict:
+    """THE wire encoder: narrow one generation's f32 population columns
+    (``m``/``theta``/``distance``/``log_weight``[/``stats``]) to the
+    d2h payload.  Single source of truth for the format — the stateful
+    loop's finalize and the fused multi-generation scan both call this,
+    and ``sampler.base.widen_wire`` is the matching decoder.
+
+    ``valid`` masks the rows actually written this generation (stale
+    carry rows must not feed the scale/shift reductions).
+    """
+    if wire_m_bits:
+        # M <= 2: one bit per particle; packbits cuts the column's wire
+        # share 8x (jnp.packbits zero-pads the tail byte)
+        wire = {"m_bits": jnp.packbits(view["m"].astype(jnp.uint8))}
+    else:
+        wire = {"m": view["m"].astype(jnp.int8)}
+    for k in ("theta", "distance") + (("stats",) if wire_stats else ()):
+        v = view[k]
+        s = _wire_scale(v, valid)
+        wire[k] = (v / s).astype(jnp.float16)
+        wire[f"{k}_scale"] = s
+    # weight normalization is shift-invariant, so ship log weights
+    # relative to the batch max: the DOMINANT weights then sit near 0
+    # where f16 is essentially exact, and the quantization error of a
+    # weight scales with its own irrelevance
+    lw = view["log_weight"]
+    lw_shift = jnp.max(jnp.where(jnp.isfinite(lw) & valid, lw, -jnp.inf))
+    wire["log_weight"] = (
+        lw - jnp.where(jnp.isfinite(lw_shift), lw_shift, 0.0)
+    ).astype(jnp.float16)
+    return wire
+
+
 def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
                         max_rounds: int, record_cap: int, d: int, s: int,
                         weight_correction: Callable = None,
@@ -210,40 +244,17 @@ def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
             view["log_weight"] = jnp.where(
                 jnp.isfinite(lw), lw - log_denom, lw)
         view["count"] = state["count"]
-        # wire format: int8/bit-packed model column and max-normalized
-        # f16 float columns — halves the bytes on the ~6-8 MB/s relay,
-        # which IS the generation budget at pop 1e6 (BASELINE.md).  The
-        # ingest widens back to f32; exactness-sensitive consumers read
-        # the f32 ``view`` on device.
-        wire_cols = ("theta", "distance") + (
-            ("stats",) if wire_stats else ())
-        if wire_m_bits:
-            # M <= 2: the model column is one bit per particle; packbits
-            # cuts its wire share 8x (1 MB -> 128 KB at the 1e6 north
-            # star).  jnp.packbits zero-pads the tail byte.
-            wire = {"m_bits": jnp.packbits(view["m"].astype(jnp.uint8))}
-        else:
-            wire = {"m": view["m"].astype(jnp.int8)}
-        # rows beyond this generation's count are STALE carry-buffer
-        # contents (reset() is a cursor rewind) — they must not feed the
-        # scale/shift reductions; partial generations (max_eval break)
-        # legitimately finalize with count < n_target
+        # wire format (narrow_wire): int8/bit-packed model column and
+        # max-normalized f16 float columns — halves the bytes on the
+        # ~6-8 MB/s relay, which IS the generation budget at pop 1e6
+        # (BASELINE.md).  The ingest widens back to f32;
+        # exactness-sensitive consumers read the f32 ``view`` on device.
+        # Rows beyond this generation's count are STALE carry-buffer
+        # contents (reset() is a cursor rewind) and are masked out of
+        # the scale/shift reductions; partial generations (max_eval
+        # break) legitimately finalize with count < n_target.
         valid = jnp.arange(n_target) < state["count"]
-        for k in wire_cols:
-            v = view[k]
-            s = _wire_scale(v, valid)
-            wire[k] = (v / s).astype(jnp.float16)
-            wire[f"{k}_scale"] = s
-        # weight normalization is shift-invariant, so ship log weights
-        # relative to the batch max: the DOMINANT weights then sit near 0
-        # where f16 is essentially exact, and the quantization error of a
-        # weight scales with its own irrelevance
-        lw = view["log_weight"]
-        lw_shift = jnp.max(jnp.where(jnp.isfinite(lw) & valid, lw,
-                                     -jnp.inf))
-        wire["log_weight"] = (
-            lw - jnp.where(jnp.isfinite(lw_shift), lw_shift, 0.0)
-        ).astype(jnp.float16)
+        wire = narrow_wire(view, valid, wire_stats, wire_m_bits)
         wire["count"] = state["count"]
         wire["rounds"] = state["rounds"]
         return wire, view

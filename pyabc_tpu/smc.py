@@ -98,6 +98,7 @@ class ABCSMC:
                  max_nr_recorded_particles: int = 1 << 21,
                  show_progress: bool = False,
                  stores_sum_stats: bool = True,
+                 fuse_generations: int = 1,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -139,6 +140,13 @@ class ABCSMC:
         #: per-particle sum-stats from the DB — and from the d2h wire
         #: when nothing else on the host consumes them (see run())
         self.stores_sum_stats = bool(stores_sum_stats)
+        #: run up to this many generations per device dispatch when the
+        #: configuration's adaptation chain is fully device-computable
+        #: (sampler/fused.py); 1 = always sequential.  Durable History
+        #: writes then happen every block, one per generation as usual.
+        self.fuse_generations = int(fuse_generations)
+        self._fused_cache: Dict[tuple, Callable] = {}
+        self._fused_carry = None
         self.key = jax.random.PRNGKey(seed)
         #: per-generation wall-clock seconds, keyed by t — measured
         #: append-to-append like the DB-timestamp diffs, but available
@@ -227,6 +235,10 @@ class ABCSMC:
         return self.history
 
     def _bind(self):
+        # a reused ABCSMC must never seed a NEW run's first fused block
+        # from the previous run's population
+        self._fused_carry = None
+        self._fused_cache.clear()
         self.spec = SumStatSpec.from_example(self.x_0)
         self._obs_flat = self.spec.flatten_single(self.x_0)
         self.distance_function.bind(self.spec, self.x_0)
@@ -364,6 +376,224 @@ class ABCSMC:
         for m, p in series.items():
             probs[int(m)] = float(p)
         return probs
+
+    # ------------------------------------------------------------------
+    # fused multi-generation blocks (sampler/fused.py)
+    # ------------------------------------------------------------------
+
+    def _fused_eligible(self) -> bool:
+        """The whole propose→accept→refit→new-eps chain is
+        device-computable: run ``fuse_generations`` generations per
+        dispatch.  Anything outside the known-safe component set falls
+        back to the sequential loop."""
+        from .epsilon.epsilon import ConstantEpsilon, QuantileEpsilon
+        from .sampler.sharded import ShardedSampler
+        from .sampler.vectorized import VectorizedSampler
+        if self.fuse_generations < 2:
+            return False
+        s = self.sampler
+        if not isinstance(s, VectorizedSampler) \
+                or isinstance(s, ShardedSampler):
+            return False
+        if s.record_rejected:
+            return False
+        if type(self.acceptor) is not UniformAcceptor \
+                or self.acceptor.use_complete_history:
+            return False
+        if not isinstance(self.eps, (ConstantEpsilon, QuantileEpsilon)):
+            return False
+        if isinstance(self.distance_function, StochasticKernel) \
+                or self._distance_is_adaptive() \
+                or not self.distance_function.params_time_invariant():
+            return False
+        if type(self.population_strategy) is not ConstantPopulationSize:
+            return False
+        if getattr(self.population_strategy,
+                   "nr_samples_per_parameter", 1) != 1:
+            return False
+        if not all(type(tr) is MultivariateNormalTransition
+                   for tr in self.transitions):
+            return False
+        return True
+
+    def _run_fused_block(self, t: int, t_max, total_sims: int,
+                         max_total_nr_simulations):
+        """Execute one fused K-generation block starting at ``t``.
+
+        Returns ``(written, sims_added, stop_reason)`` — ``written``
+        generations were durably appended to the History (0 means the
+        caller must take the sequential path for ``t``).
+        """
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from .epsilon.epsilon import ConstantEpsilon
+        from .sampler.base import fetch_to_host
+        from .sampler.fused import build_fused_generations
+        from .utils import transfer as _transfer
+
+        carry = self._fused_carry
+        self._fused_carry = None
+        if carry is None:
+            return 0, 0, None
+        K = self.fuse_generations
+        n = self.population_strategy(t)
+        samp = self.sampler
+        if carry["theta"].shape[0] != n:
+            return 0, 0, None  # population size changed: sequential
+        B = samp._round_to_valid_batch(
+            n / max(samp._rate_est, 1e-6) * samp.safety_factor)
+        d, s_width = self.dim, self.spec.total_size
+        wire_stats = bool(samp.fetch_stats)
+        wire_m_bits = self.M <= 2
+        if isinstance(self.eps, ConstantEpsilon):
+            eps_mode, alpha, mult, weighted = "constant", 0.5, 1.0, True
+        else:
+            eps_mode = "quantile"
+            alpha = self.eps.alpha
+            mult = self.eps.quantile_multiplier
+            weighted = self.eps.weighted
+        cache_key = ("fused", self._kernel._uid, B, n, K, d, s_width,
+                     eps_mode, alpha, mult, weighted, wire_stats,
+                     wire_m_bits)
+        fn = self._fused_cache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(build_fused_generations(
+                kernel=self._kernel,
+                bandwidth_selectors=[tr.bandwidth_selector
+                                     for tr in self.transitions],
+                scalings=[tr.scaling for tr in self.transitions],
+                dims=[p.dim for p in self.parameter_priors],
+                n_target=n, B=B, max_rounds=16, K=K, d=d, s=s_width,
+                eps_mode=eps_mode, eps_alpha=alpha, eps_multiplier=mult,
+                eps_weighted=weighted,
+                distance_params=jax.device_put(
+                    self.distance_function.get_params(t)),
+                wire_stats=wire_stats, wire_m_bits=wire_m_bits))
+            self._fused_cache[cache_key] = fn
+            while len(self._fused_cache) > 4:
+                self._fused_cache.pop(next(iter(self._fused_cache)))
+
+        t0_block = _time.perf_counter()
+        tr0_block = _transfer.snapshot()
+        carry_in = {
+            "m": carry["m"], "theta": carry["theta"],
+            "log_weight": carry["log_weight"],
+            "distance": carry["distance"], "count": carry["count"],
+            "eps": jnp.float32(self.eps(t) if eps_mode == "constant"
+                               else 0.0),
+        }
+        carry_out, wires = fn(carry_in, self._split())
+        wires = fetch_to_host(wires)  # ONE transaction for all K gens
+
+        # widen the stacked wire through the SHARED decoder (one call
+        # per generation on that generation's slice of the stack)
+        from .sampler.base import widen_wire
+        counts = np.asarray(wires["count"])
+        rounds = np.asarray(wires["rounds"])
+        eps_vals = np.asarray(wires["eps"], dtype=np.float64)
+        scalar_keys = ("count", "rounds", "eps")
+        per_gen = [widen_wire({key: v[k] for key, v in wires.items()
+                               if key not in scalar_keys}, n)
+                   for k in range(K)]
+        m_all = [g["m"] for g in per_gen]
+        theta_all = [g["theta"] for g in per_gen]
+        dist_all = [g["distance"] for g in per_gen]
+        lw_all = [g["log_weight"] for g in per_gen]
+        stats_all = ([g["stats"] for g in per_gen]
+                     if "stats" in per_gen[0] else None)
+
+        # every executed generation's evaluations count against the
+        # simulation budget — including any the ingest below discards
+        # (undershoot tails ran on the device regardless)
+        sims_added = int(rounds.sum()) * B
+        written = 0
+        stop_reason = None
+        for k in range(K):
+            t_k = t + k
+            if t_k >= t_max:
+                break
+            count_k = int(counts[k])
+            if count_k < n:
+                logger.info(
+                    "fused block undershot at t=%d (%d/%d accepted): "
+                    "falling back to the sequential path", t_k, count_k, n)
+                break
+            evals_k = int(rounds[k]) * B
+            lw = lw_all[k].astype(np.float64)
+            lw = lw - lw.max()
+            w = np.exp(lw)
+            w_sum = w.sum()
+            if not (np.isfinite(w_sum) and w_sum > 0):
+                logger.warning("fused block produced degenerate weights "
+                               "at t=%d: sequential fallback", t_k)
+                break
+            pop_k = Population(
+                m=m_all[k], theta=theta_all[k],
+                weight=(w / w_sum).astype(np.float32),
+                distance=dist_all[k],
+                sum_stats=({"__flat__": stats_all[k]}
+                           if stats_all is not None else {}),
+            )
+            # constant mode: take the HOST value — the f32 device
+            # round-trip of eps would defeat `eps <= minimum_epsilon`
+            eps_k = (float(self.eps(t_k)) if eps_mode == "constant"
+                     else float(eps_vals[k]))
+            acc_rate = count_k / max(evals_k, 1)
+            logger.info("t: %d, eps: %.8g (fused)", t_k, eps_k)
+            self.history.append_population(
+                t_k, eps_k, pop_k, evals_k,
+                [m.name for m in self.models], self._param_names(),
+                stat_spec=self.spec.shapes)
+            if eps_mode == "quantile":
+                self.eps._look_up[t_k] = eps_k
+            logger.info(
+                "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
+                t_k, acc_rate,
+                float(effective_sample_size(pop_k.weight)), evals_k)
+            written += 1
+            samp._rate_est = max(acc_rate, 1e-6)
+            # stopping criteria, sequential order (run loop below)
+            if eps_k <= self.minimum_epsilon:
+                stop_reason = "Stopping: minimum epsilon reached"
+            elif (self.stop_if_only_single_model_alive
+                    and pop_k.nr_of_models_alive() <= 1 and self.M > 1):
+                stop_reason = "Stopping: single model alive"
+            elif acc_rate < self.min_acceptance_rate:
+                stop_reason = "Stopping: acceptance rate too low"
+            elif (total_sims + int(rounds[:k + 1].sum()) * B
+                    >= max_total_nr_simulations):
+                stop_reason = "Stopping: simulation budget exhausted"
+            if stop_reason:
+                break
+
+        if written:
+            block_dt = _time.perf_counter() - t0_block
+            tr_delta = _transfer.delta(tr0_block)
+            for k in range(written):
+                self.generation_wall_clock[t + k] = block_dt / written
+                self.generation_transfer[t + k] = {
+                    key: v / written for key, v in tr_delta.items()}
+            last_pop = pop_k
+            if stop_reason is None and t + written < t_max:
+                # keep the chain hot: device carry for the next block
+                # (only valid when the block completed all K gens), and
+                # host-side component state for a sequential continuation
+                prep = Sample()
+                if written == K:
+                    self._fused_carry = carry_out
+                    # the exact f32 accepted buffers of the last written
+                    # generation: lets _fit_transitions gather supports
+                    # ON device (f32, no re-upload) exactly like the
+                    # sequential loop's Sample.device_population
+                    prep.device_population = dict(carry_out)
+                else:
+                    prep.device_population = None
+                self._prepare_next_iteration(
+                    t + written, prep, last_pop,
+                    samp._rate_est)
+        return written, sims_added, stop_reason
 
     def _proposal_log_pdf(self, probs: np.ndarray, m: np.ndarray,
                           theta: np.ndarray) -> np.ndarray:
@@ -552,6 +782,7 @@ class ABCSMC:
         # timestamp diffs the bench used through round 4)
         gen_mark = _time.perf_counter()
         tr_mark = _transfer.snapshot()
+        fused_ok = self._fused_eligible()
         while t < t_max:
             # operator clean-stop (abc-distributed-manager stop): exit
             # between generations, like the reference's Redis STOP message
@@ -560,6 +791,23 @@ class ABCSMC:
             if stop_requested():
                 logger.info("Stopping: operator stop requested")
                 break
+            # enter a fused block only when ALL K generations fit before
+            # t_max — the compiled program always executes K, so a tail
+            # block would burn device work on discarded generations
+            if fused_ok and self._fused_carry is not None \
+                    and t + self.fuse_generations <= t_max:
+                written, sims, stop_reason = self._run_fused_block(
+                    t, t_max, total_sims, max_total_nr_simulations)
+                total_sims += sims
+                if written:
+                    t += written
+                    gen_mark = _time.perf_counter()
+                    tr_mark = _transfer.snapshot()
+                    if stop_reason is not None:
+                        logger.info(stop_reason)
+                        break
+                    continue
+                # no generation written: sequential path for this t
             current_eps = float(self.eps(t))
 
             n = self.population_strategy(t)
@@ -602,6 +850,12 @@ class ABCSMC:
             gen_mark = now
             self.generation_transfer[t] = _transfer.delta(tr_mark)
             tr_mark = _transfer.snapshot()
+            if fused_ok:
+                # accepted buffers of THIS generation stay device-resident
+                # as the next fused block's carry
+                dp = getattr(sample, "device_population", None)
+                self._fused_carry = (
+                    dp if dp is not None and "distance" in dp else None)
             logger.info(
                 "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
                 t, acceptance_rate, ess, sample.nr_evaluations)

@@ -58,6 +58,24 @@ def smart_cov(theta: Array, w: Array) -> Array:
     return xp.where(bad, diag_fallback, cov)
 
 
+def regularized_kde_cov(theta: Array, w: Array, bandwidth_selector,
+                        scaling: float) -> Array:
+    """The KDE covariance recipe shared by the host fit (``_fit``) and
+    the fused on-device refit (sampler/fused.py): ``smart_cov ×
+    bandwidth² × scaling`` plus a trace-scaled diagonal jitter.  Keeping
+    it in one place is what keeps the fused engine's
+    sequential-equivalence contract honest.  ``w`` must be normalized;
+    masked-out rows carry w = 0 and drop out of every moment.
+    """
+    xp = np if isinstance(theta, np.ndarray) else jnp
+    dim = theta.shape[-1]
+    n_eff = effective_sample_size(w)
+    bw = bandwidth_selector(n_eff, dim)
+    cov = smart_cov(theta, w) * (bw**2) * scaling
+    return cov + 1e-8 * xp.eye(dim, dtype=cov.dtype) * xp.maximum(
+        xp.trace(cov) / dim, 1e-8)
+
+
 def silverman_rule_of_thumb(n_eff, dim) -> Array:
     """Silverman bandwidth factor (reference transition/multivariatenormal.py:14-27)."""
     return (4.0 / (n_eff * (dim + 2.0))) ** (1.0 / (dim + 4.0))
@@ -89,11 +107,8 @@ class MultivariateNormalTransition(Transition):
     def _fit(self, theta: Array, w: Array):
         xp = np if isinstance(theta, np.ndarray) else jnp
         dim = theta.shape[-1]
-        n_eff = effective_sample_size(w)
-        bw = self.bandwidth_selector(n_eff, dim)
-        cov = smart_cov(theta, w) * (bw**2) * self.scaling
-        cov = cov + 1e-8 * xp.eye(dim, dtype=cov.dtype) * xp.maximum(
-            xp.trace(cov) / dim, 1e-8)
+        cov = regularized_kde_cov(theta, w, self.bandwidth_selector,
+                                  self.scaling)
         self._chol = xp.linalg.cholesky(cov)
         self._log_norm = (
             -0.5 * dim * xp.log(2 * xp.pi)
