@@ -414,6 +414,15 @@ class ABCSMC:
         if not all(type(tr) is MultivariateNormalTransition
                    for tr in self.transitions):
             return False
+        # the fused refit has no pdf-grid compression: each generation's
+        # deferred proposal correction costs n x (M x n) KDE pairs on the
+        # FULL support.  Past ~3e10 pairs that term alone exceeds the
+        # dispatch savings fusion exists for (at pop 1e6 it would be
+        # ~2e12 pairs ~ 10 s/gen) — the sequential path with its
+        # grid-compressed host fit wins there.
+        n = self.population_strategy(0)
+        if float(n) * n * self.M > float(1 << 35):
+            return False
         return True
 
     def _run_fused_block(self, t: int, t_max, total_sims: int,

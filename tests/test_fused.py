@@ -127,6 +127,11 @@ def test_fused_eligibility_gating():
                      fuse_generations=3, seed=0)
     abc6.new("sqlite://", observed5)
     assert abc6._fused_eligible() is True
+    # huge populations: the fused refit has no pdf-grid compression, so
+    # the per-generation full-support KDE correction would dwarf the
+    # dispatch savings — sequential path wins
+    abc7, _ = _abc(fuse=3, pop=1_000_000, eps=pt.ConstantEpsilon(0.2))
+    assert abc7._fused_eligible() is False
 
 
 def test_fused_resume(tmp_path):
