@@ -244,6 +244,12 @@ def _bench_problem(make_problem, pop, prefix):
         # compile to the timed generation
         sampler=pt.VectorizedSampler(min_batch_size=1 << 19,
                                      max_batch_size=1 << 19),
+        # production posture at pop 1e5 with ~16-wide stats: the
+        # adaptive-distance refit reads the device-resident RECORD
+        # stream, so with per-particle DB stats off (documented
+        # stores_sum_stats mode) the accepted-stats block — ~2/3 of
+        # this row's wire — never crosses the relay
+        stores_sum_stats=False,
         seed=0)
     abc.new("sqlite://", observed)
     rate, s_per_gen, times, evals_ps, transfer = _timed_generations(

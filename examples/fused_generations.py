@@ -1,0 +1,66 @@
+"""Fused multi-generation blocks: K generations per device dispatch.
+
+For configurations whose per-generation adaptation is fully
+device-computable — Gaussian-KDE transition refit, constant or
+weighted-quantile epsilon, uniform acceptance, non-adaptive distance —
+``ABCSMC(fuse_generations=K)`` chains K whole generations (propose →
+accept → refit → new epsilon) into ONE compiled program
+(pyabc_tpu/sampler/fused.py) and fetches K compact populations in one
+transfer.  On dispatch-bound hardware (a remote TPU, small
+populations) this removes the per-generation round-trip floor: the
+benchmark's pop-16384 model-selection config went from 0.19 to
+0.038 s/generation.  The History is unchanged — one durable row per
+generation, written per block — and anything outside the supported
+component set silently falls back to the sequential loop.
+
+``stores_sum_stats=False`` (reference ``History`` parity flag)
+additionally drops per-particle summary statistics from the database
+AND from the device→host wire when nothing on the host consumes them —
+at large populations that block is most of the transfer budget.
+
+Run: ``python examples/fused_generations.py``
+"""
+
+import os
+import time
+
+import numpy as np
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+
+POP = int(os.environ.get("ABC_EXAMPLE_POP", 4096))
+GENS = int(os.environ.get("ABC_EXAMPLE_GENS", 9))
+
+
+def main():
+    models, priors, distance, observed, posterior_fn = \
+        make_two_gaussians_problem()
+
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=POP,
+        eps=pt.ConstantEpsilon(0.2),
+        sampler=pt.VectorizedSampler(),
+        fuse_generations=3,        # 3 generations per device dispatch
+        stores_sum_stats=False,    # stats off the DB and the wire
+        seed=0)
+    abc.new("sqlite://", observed)
+    assert abc._fused_eligible(), "this config fuses"
+
+    t0 = time.time()
+    history = abc.run(max_nr_populations=GENS)
+    dt = time.time() - t0
+
+    # one History row per generation, exactly as the sequential loop
+    pops = history.get_all_populations()
+    print(f"{history.max_t + 1} generations in {dt:.2f}s "
+          f"({[round(v, 3) for v in abc.generation_wall_clock.values()]}"
+          " s/gen)")
+    p_b = float(history.get_model_probabilities().iloc[-1][1])
+    print(f"P(model B) = {p_b:.3f}  (analytic {posterior_fn(1.0):.3f})")
+    assert len(pops) == history.max_t + 2  # calibration + generations
+
+
+if __name__ == "__main__":
+    main()

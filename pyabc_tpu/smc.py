@@ -769,12 +769,24 @@ class ABCSMC:
         self.sampler.max_records = self.max_nr_recorded_particles
         # the [n, s] accepted-stats block rides the d2h wire only when a
         # host consumer exists: the History blob (stores_sum_stats) or an
-        # adaptive distance refit (which may fall back to accepted stats
-        # when records are off).  Without either, the sampler keeps stats
-        # device-resident — at the 1e6 north star that is ~a quarter of
-        # the per-generation relay budget.
+        # adaptive distance refit that has NO record stream to read from
+        # (when the distance requested rejected-candidate recording, its
+        # refit consumes the device-resident record buffers instead —
+        # Sample.get_all_stats prefers _rec).  Without either, the
+        # sampler keeps stats device-resident — ~a quarter of the
+        # per-generation relay budget at the 1e6 north star, ~two thirds
+        # at stat-heavy configs like Lotka-Volterra.  The record stream
+        # only substitutes when it can actually exist (a non-zero record
+        # budget) and when the device view stays addressable (single
+        # process): multi-host runs keep the wire so the post-refit
+        # distance re-evaluation has host stats to fall back on.
+        records_cover_refit = (
+            self.sampler.record_rejected
+            and self.max_nr_recorded_particles > 0
+            and jax.process_count() == 1)
         self.sampler.fetch_stats = (
-            self.history.stores_sum_stats or self._distance_is_adaptive())
+            self.history.stores_sum_stats
+            or (self._distance_is_adaptive() and not records_cover_refit))
         # reference smc.py:537/907: the per-generation progress bar is the
         # sampler's to render (it knows n_accepted as batches harvest)
         self.sampler.show_progress = self.show_progress

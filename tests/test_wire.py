@@ -127,17 +127,59 @@ def test_stores_sum_stats_false_drops_stats_everywhere(tmp_path):
     assert abc2.history.max_t == t_done + 1
 
 
-def test_adaptive_distance_forces_stats_fetch():
-    """An adaptive distance is a host-side stats consumer: fetch_stats
-    must stay True even when the History drops them."""
+def test_adaptive_distance_stats_fetch_rules():
+    """Adaptive distances and the stats wire: a refit that reads the
+    device-resident RECORD stream (AdaptivePNormDistance requests
+    rejected recording) needs no host copy of the accepted stats; an
+    adaptive distance without records is a host consumer and forces the
+    fetch."""
     models, priors, _, observed, _ = make_two_gaussians_problem()
+    # records requested -> refit runs on device records, stats off wire
     abc = pt.ABCSMC(models, priors, pt.AdaptivePNormDistance(),
                     population_size=200,
                     sampler=pt.VectorizedSampler(), seed=3,
                     stores_sum_stats=False)
     abc.new("sqlite://", observed)
-    abc.run(max_nr_populations=2)
-    assert abc.sampler.fetch_stats is True
+    abc.run(max_nr_populations=3)
+    assert abc.sampler.record_rejected is True
+    assert abc.sampler.fetch_stats is False
+    # the refit actually happened: adaptive weights deviate from 1
+    w = np.asarray(abc.distance_function.get_params(abc.history.max_t
+                                                    + 1)["w"])
+    assert w.shape[0] >= 1 and np.all(np.isfinite(w))
+    # eps annealed on the reweighted distances
+    eps = abc.history.get_all_populations()
+    eps = eps[eps.t >= 0].epsilon.to_numpy()
+    assert np.all(np.diff(eps) < 0)
+
+    # adaptive WITHOUT a record stream (custom update override from
+    # user code) -> host consumer, fetch stays on
+    class CustomAdaptive(pt.PNormDistance):
+        def update(self, t, get_all_stats=None):
+            if get_all_stats is not None:
+                stats = get_all_stats()  # {key: [N, ...]} dict
+                total = sum(np.asarray(v).size for v in stats.values())
+                assert total > 0  # would be empty if starved
+            return False
+
+    abc2 = pt.ABCSMC(models, priors, CustomAdaptive(p=2),
+                     population_size=200,
+                     sampler=pt.VectorizedSampler(), seed=3,
+                     stores_sum_stats=False)
+    abc2.new("sqlite://", observed)
+    abc2.run(max_nr_populations=2)
+    assert abc2.sampler.fetch_stats is True
+
+    # a zero record budget means the record stream can never substitute
+    # for host stats — the fetch must stay on or the refit starves
+    abc3 = pt.ABCSMC(models, priors, pt.AdaptivePNormDistance(),
+                     population_size=200,
+                     sampler=pt.VectorizedSampler(), seed=3,
+                     stores_sum_stats=False,
+                     max_nr_recorded_particles=0)
+    abc3.new("sqlite://", observed)
+    abc3.run(max_nr_populations=2)
+    assert abc3.sampler.fetch_stats is True
 
 
 def test_transfer_counters_and_generation_metrics():
