@@ -315,11 +315,15 @@ def bench_ab_vec_vs_sharded():
                                         for t in times["sharded"]]}
 
 
-def bench_sharded(pop: int, prefix: str) -> dict:
+def bench_sharded(pop: int, prefix: str, fuse: int = 1,
+                  warmup: int = WARMUP_GENERATIONS, timed: int = 3) -> dict:
     """ShardedSampler on whatever mesh the current platform exposes —
     mesh=1 on the real chip (shard_map overhead vs VectorizedSampler must
-    be ~0), 8 virtual devices when run under the CPU-mesh env (collective
-    data-plane timing; see main()'s env override for 'sharded_cpu8')."""
+    be ~0; the mesh1 row runs the fused engine like the primary row, the
+    shard_mapped round inside the scan), 8 virtual devices when run under
+    the CPU-mesh env (collective data-plane timing, per-generation
+    dispatch kept so the collective path is what's measured; see main()'s
+    env override for 'sharded_cpu8')."""
     import jax
 
     import pyabc_tpu as pt
@@ -333,6 +337,7 @@ def bench_sharded(pop: int, prefix: str) -> dict:
         eps=pt.ConstantEpsilon(0.2),
         sampler=pt.ShardedSampler(mesh=make_mesh(),
                                   max_batch_size=1 << 20),
+        fuse_generations=fuse,
         seed=0)
     abc.new("sqlite://", observed)
     # the cpu8 row is a correctness-plane figure computed on the host
@@ -343,7 +348,7 @@ def bench_sharded(pop: int, prefix: str) -> dict:
     # in the captured JSON.  Expected clean-host variance is ~10-20 %.
     load_before = os.getloadavg()[0] if hasattr(os, "getloadavg") else -1.0
     rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
-        abc, pop, WARMUP_GENERATIONS, 3)
+        abc, pop, warmup, timed)
     return {f"{prefix}_accepted_per_sec": round(rate, 1),
             f"{prefix}_wallclock_s_per_gen": round(s_per_gen, 3),
             f"{prefix}_gen_times_s": times,
@@ -374,7 +379,10 @@ def _run_sub(name: str) -> dict:
     if name == "petab_ode":
         return bench_petab_ode()
     if name == "sharded_mesh1":
-        return bench_sharded(POP, "sharded_mesh1")
+        # fused like the primary row: warmup 9 covers the sequential
+        # gen-0 compile + the first 8-gen block
+        return bench_sharded(POP, "sharded_mesh1", fuse=8, warmup=9,
+                             timed=8)
     if name == "ab_vec_sharded":
         return bench_ab_vec_vs_sharded()
     if name == "sharded_cpu8":

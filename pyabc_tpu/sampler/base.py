@@ -498,7 +498,14 @@ class Sample:
 class Sampler:
     """Abstract sampler (parity: pyabc/sampler/base.py:171-233)."""
 
+    import itertools as _itertools
+    _uid_counter = _itertools.count()
+
     def __init__(self):
+        #: stable identity for compiled-program caches that bake in
+        #: sampler state (mesh, axis) — id() of a freed sampler can be
+        #: reused and would serve stale compiled closures
+        self._uid = next(Sampler._uid_counter)
         self.nr_evaluations_ = 0
         self.record_rejected = False
         #: whether the [n, s] sum-stats block must ride the d2h wire; the

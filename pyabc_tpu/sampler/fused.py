@@ -107,7 +107,8 @@ def build_fused_generations(
         eps_weighted: bool,
         distance_params,
         wire_stats: bool,
-        wire_m_bits: bool):
+        wire_m_bits: bool,
+        raw_round: Callable):
     """Compile-ready ``fused(carry, key) -> (carry, wires)`` for K
     generations.  ``carry`` = the previous generation's accepted
     population on device: dict(m[i32 n], theta[f32 n,d], log_weight
@@ -117,6 +118,13 @@ def build_fused_generations(
     the same f16/per-column-scale/bit-packed format as
     ``device_loop.finalize`` plus per-generation ``eps``/``count``/
     ``rounds`` scalars.
+
+    ``raw_round(key, params) -> RoundResult`` is the SAMPLER's round
+    builder for the kernel's deferred generation round at batch ``B``
+    (``sampler._raw_round(kernel.generation_round, B,
+    with_proposal=False)``): for a ``ShardedSampler`` that is the
+    shard_mapped round, so the whole fused scan SPMDs over the mesh
+    exactly like the per-generation loop.
     """
     from .device_loop import narrow_wire
 
@@ -177,8 +185,7 @@ def build_fused_generations(
         def body(st):
             key, b, count, rounds = st
             key, sub = jax.random.split(key)
-            rr = kernel.generation_round(sub, params, B,
-                                         with_proposal=False)
+            rr = raw_round(sub, params)
             acc = rr.accepted
             pos = count + jnp.cumsum(acc.astype(jnp.int32)) - 1
             idx = jnp.where(acc & (pos < cap), pos, cap)

@@ -392,8 +392,12 @@ class ABCSMC:
         if self.fuse_generations < 2:
             return False
         s = self.sampler
-        if not isinstance(s, VectorizedSampler) \
-                or isinstance(s, ShardedSampler):
+        if not isinstance(s, VectorizedSampler):
+            return False
+        if isinstance(s, ShardedSampler) and jax.process_count() > 1:
+            # the block's single fetch would need cross-host assembly of
+            # every wire entry; the per-generation loop already handles
+            # that path — keep it
             return False
         if s.record_rejected:
             return False
@@ -463,13 +467,22 @@ class ABCSMC:
             alpha = self.eps.alpha
             mult = self.eps.quantile_multiplier
             weighted = self.eps.weighted
-        cache_key = ("fused", self._kernel._uid, B, n, K, d, s_width,
-                     eps_mode, alpha, mult, weighted, wire_stats,
-                     wire_m_bits)
+        # samp._uid: the compiled fn closes over the sampler's round
+        # builder (for ShardedSampler that bakes in mesh + axis), so a
+        # swapped sampler must never be served a stale program
+        cache_key = ("fused", self._kernel._uid, samp._uid, B,
+                     n, K, d, s_width, eps_mode, alpha, mult, weighted,
+                     wire_stats, wire_m_bits)
         fn = self._fused_cache.get(cache_key)
         if fn is None:
             fn = jax.jit(build_fused_generations(
                 kernel=self._kernel,
+                # the sampler's round builder: a ShardedSampler hands
+                # back the shard_mapped round, so the fused scan SPMDs
+                # over the mesh like the per-generation loop
+                raw_round=samp._raw_round(
+                    self._kernel.generation_round, B,
+                    with_proposal=False),
                 bandwidth_selectors=[tr.bandwidth_selector
                                      for tr in self.transitions],
                 scalings=[tr.scaling for tr in self.transitions],

@@ -94,13 +94,14 @@ def test_fused_eligibility_gating():
     assert abc2._fused_eligible() is False
     abc2.run(max_nr_populations=3)  # still runs, sequentially
     assert abc2.history.max_t == 2
-    # sharded sampler: excluded
+    # sharded sampler on a single-process mesh: eligible (the
+    # shard_mapped round runs inside the fused scan)
     abc3 = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
                      population_size=200,
                      sampler=pt.ShardedSampler(),
                      fuse_generations=3, seed=0)
     abc3.new("sqlite://", observed)
-    assert abc3._fused_eligible() is False
+    assert abc3._fused_eligible() is True
     # list epsilon: not device-computable -> sequential
     abc4, _ = _abc(fuse=3, eps=pt.ListEpsilon([0.5, 0.3, 0.2, 0.1, 0.05]))
     assert abc4._fused_eligible() is False
@@ -132,6 +133,25 @@ def test_fused_eligibility_gating():
     # dispatch savings — sequential path wins
     abc7, _ = _abc(fuse=3, pop=1_000_000, eps=pt.ConstantEpsilon(0.2))
     assert abc7._fused_eligible() is False
+
+
+def test_fused_sharded_mesh():
+    """Fused blocks over a ShardedSampler: the shard_mapped round runs
+    inside the scan on the virtual 8-device mesh — same History shape
+    and posterior as the single-device fused path."""
+    models, priors, distance, observed, posterior_fn = \
+        make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=400,
+                    eps=pt.ConstantEpsilon(0.2),
+                    sampler=pt.ShardedSampler(),
+                    fuse_generations=3, seed=0)
+    abc.new("sqlite://", observed)
+    h = abc.run(max_nr_populations=7)
+    assert list(h.get_all_populations().t) == [-1, 0, 1, 2, 3, 4, 5, 6]
+    counts = h.get_nr_particles_per_population()
+    assert all(counts[t] == 400 for t in range(7))
+    p = float(h.get_model_probabilities().iloc[-1][1])
+    assert abs(p - posterior_fn(1.0)) < 0.12
 
 
 def test_fused_resume(tmp_path):
