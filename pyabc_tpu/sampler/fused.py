@@ -45,13 +45,68 @@ from jax import lax
 Array = jnp.ndarray
 
 
+#: device pdf-grid size for 1-D supports at scale (vs the host fit's
+#: adaptive pow2 grid with an 8192 floor): 2^14 cells over the support
+#: range gives ~100+ cells per bandwidth at any annealing stage (range
+#: and bandwidth contract TOGETHER — both scale with the posterior
+#: width), comfortably beyond the host path's 64 cells/bw target
+_DEVICE_GRID = 1 << 14
+
+
+def _compress_support_device(sup, w, ok, chol):
+    """Device analog of ``MultivariateNormalTransition._compress_support``
+    (zeroth/first-moment grid compression of a 1-D pdf support):
+    per-cell (mass, weighted centroid) over a ``_DEVICE_GRID``-cell grid
+    spanning the masked support range.  Centering each cell's Gaussian
+    at the centroid cancels the first-order error term, so log-density
+    error is second order in (cell width / bandwidth) — see the host
+    method's derivation.
+
+    Returns ``(c_support, c_log_w, resolved)``.  ``resolved`` is the
+    device analog of the host fit's bandwidth-resolution guard
+    (multivariatenormal.py ``g_needed > _COMPRESS_MAX_G`` → exact
+    fallback): False when the grid has fewer than 32 cells per
+    bandwidth (an outlier-stretched range can decouple range from
+    bandwidth) — the caller must then evaluate the EXACT support.
+    A dead model (no ok rows) yields finite centers with -1e30 masses,
+    matching the full-support path's ~zero density, never NaN.
+    """
+    x = sup[:, 0]
+    lo = jnp.min(jnp.where(ok, x, jnp.inf))
+    hi = jnp.max(jnp.where(ok, x, -jnp.inf))
+    # dead model: pin a finite dummy range so grid centers stay finite
+    # (their masses are all -1e30, so they contribute ~exp(-1e30))
+    dead = ~jnp.isfinite(lo) | ~jnp.isfinite(hi)
+    lo = jnp.where(dead, 0.0, lo)
+    hi = jnp.where(dead, 1.0, hi)
+    rng = jnp.maximum(hi - lo, 1e-30)
+    g = _DEVICE_GRID
+    dx = rng / g
+    idx = jnp.clip(((x - lo) / dx).astype(jnp.int32), 0, g - 1)
+    wm = jnp.where(ok, w, 0.0)
+    mass = jax.ops.segment_sum(wm, idx, num_segments=g)
+    first = jax.ops.segment_sum(wm * x, idx, num_segments=g)
+    centers = lo + (jnp.arange(g) + 0.5) * dx
+    centroid = jnp.where(mass > 0, first / jnp.maximum(mass, 1e-38),
+                         centers)
+    log_mass = jnp.where(mass > 0,
+                         jnp.log(jnp.maximum(mass, 1e-38)), -1e30)
+    h = chol[0, 0]
+    resolved = dead | (rng <= (g / 32.0) * h)
+    return (centroid[:, None].astype(jnp.float32),
+            log_mass.astype(jnp.float32), resolved)
+
+
 def _refit_model(theta, log_w, valid, m_col, j, dim_j, n_target,
                  bandwidth_selector, scaling):
     """Device refit of model j's MVN-KDE from the carry population.
 
     Returns the params dict ``MultivariateNormalTransition.get_params``
-    would produce (support/log_w/chol/log_norm), padded to ``n_target``
-    rows (pad rows carry -1e30 log weight, as ``_device_supports``).
+    would produce (support/log_w/chol/log_norm, plus the grid-compressed
+    ``c_support``/``c_log_w`` pdf support for large 1-D models — the
+    same static-pytree dispatch the host fit uses), padded to
+    ``n_target`` rows (pad rows carry -1e30 log weight, as
+    ``_device_supports``).
     """
     from ..transition.multivariatenormal import regularized_kde_cov
 
@@ -74,8 +129,18 @@ def _refit_model(theta, log_w, valid, m_col, j, dim_j, n_target,
     chol = jnp.linalg.cholesky(cov)
     log_norm = (-0.5 * dim_j * jnp.log(2 * jnp.pi)
                 - jnp.sum(jnp.log(jnp.diag(chol))))
-    return {"support": sup, "log_w": jnp.where(ok, lw, -1e30),
-            "chol": chol, "log_norm": log_norm}
+    params = {"support": sup, "log_w": jnp.where(ok, lw, -1e30),
+              "chol": chol, "log_norm": log_norm}
+    resolved = jnp.bool_(True)
+    from ..transition.multivariatenormal import _COMPRESS_MIN_N
+    if dim_j == 1 and n_target >= _COMPRESS_MIN_N:
+        # large 1-D support: the deferred proposal correction evaluates
+        # the pdf against ~2^14 grid cells instead of n_target rows
+        # (rvs stays exact on the full support, like the host fit);
+        # ``resolved`` gates the correction's runtime exact fallback
+        params["c_support"], params["c_log_w"], resolved = \
+            _compress_support_device(sup, w, ok, chol)
+    return params, resolved
 
 
 def _weighted_quantile_device(x, w, valid, alpha):
@@ -160,10 +225,14 @@ def build_fused_generations(
                      * eps_multiplier)
 
         # per-model KDE refit (device analog of _fit_transitions)
-        trans = tuple(
+        refits = [
             _refit_model(theta0, lw0, valid0, m0, j, dims[j], n_target,
                          bandwidth_selectors[j], scalings[j])
-            for j in range(M))
+            for j in range(M)]
+        trans = tuple(p for p, _ in refits)
+        grids_resolved = refits[0][1]
+        for _, r in refits[1:]:
+            grids_resolved &= r
         params = {"distance": distance_params,
                   "acceptor": {"eps": eps_t},
                   "model_log_probs": model_log_probs,
@@ -204,13 +273,32 @@ def build_fused_generations(
         _, bufs, count1, rounds1 = lax.while_loop(
             cond, body, (gen_key, bufs, jnp.int32(0), jnp.int32(0)))
 
-        # deferred proposal-density correction over the accepted buffer
+        # deferred proposal-density correction over the accepted buffer.
+        # When every compressed grid resolves its bandwidth the ~2^14
+        # cells stand in for the full support; otherwise (outlier-
+        # stretched range) the EXACT support is evaluated — the
+        # eligibility pair-budget keeps that branch affordable, and
+        # lax.cond executes only the chosen side
         m1 = bufs["m"][:n_target]
         theta1 = bufs["theta"][:n_target]
         dist1 = bufs["distance"][:n_target]
         stats1 = bufs["stats"][:n_target]
         lw1 = bufs["log_weight"][:n_target]
-        log_denom = kernel.proposal_log_density(m1, theta1, params)
+        has_grids = any("c_support" in p for p in trans)
+        if has_grids:
+            trans_exact = tuple(
+                {k: v for k, v in p.items()
+                 if k not in ("c_support", "c_log_w")} for p in trans)
+            params_exact = {**params, "transition": trans_exact}
+            log_denom = lax.cond(
+                grids_resolved,
+                lambda args: kernel.proposal_log_density(
+                    args[0], args[1], params),
+                lambda args: kernel.proposal_log_density(
+                    args[0], args[1], params_exact),
+                (m1, theta1))
+        else:
+            log_denom = kernel.proposal_log_density(m1, theta1, params)
         lw1 = jnp.where(jnp.isfinite(lw1), lw1 - log_denom, lw1)
 
         new_carry = {"m": m1, "theta": theta1, "log_weight": lw1,

@@ -418,14 +418,27 @@ class ABCSMC:
         if not all(type(tr) is MultivariateNormalTransition
                    for tr in self.transitions):
             return False
-        # the fused refit has no pdf-grid compression: each generation's
-        # deferred proposal correction costs n x (M x n) KDE pairs on the
-        # FULL support.  Past ~3e10 pairs that term alone exceeds the
-        # dispatch savings fusion exists for (at pop 1e6 it would be
-        # ~2e12 pairs ~ 10 s/gen) — the sequential path with its
-        # grid-compressed host fit wins there.
+        # fusion pays off in the DISPATCH-FLOORED regime (small-to-mid
+        # populations where a generation is one relay round-trip);
+        # measured same-session at pop 1e6 the fused block is ~25 %
+        # SLOWER than the per-generation loop (full-support gathers per
+        # refit, no early-stop rate adaptation, worse per-byte relay
+        # throughput on block-sized transactions) — transfer dominates
+        # there and fusion has no headroom.  Cap at 2^17 particles.
         n = self.population_strategy(0)
-        if float(n) * n * self.M > float(1 << 35):
+        if n > (1 << 17):
+            return False
+        # and bound the per-generation deferred proposal correction: n
+        # queries x the pdf-support rows of every model (large 1-D
+        # models compress to a ~2^14 device grid,
+        # fused._compress_support_device; others keep full n rows)
+        from .sampler.fused import _DEVICE_GRID
+        from .transition.multivariatenormal import _COMPRESS_MIN_N
+        rows = sum(
+            (_DEVICE_GRID if (p.dim == 1 and n >= _COMPRESS_MIN_N)
+             else n)
+            for p in self.parameter_priors)
+        if float(n) * rows > float(1 << 35):
             return False
         return True
 
