@@ -24,8 +24,12 @@ is one-off).
 (each guarded — a failed sub-bench reports null, never kills the line):
 
 - ``northstar_pop1e6_*``   — config #2 at 1e6 particles/generation
-  (BASELINE.md north-star target), incl. the 1e6-query × 1e6-support
-  streamed-KDE log-pdf (SURVEY.md §7 hard part) measured standalone
+  (BASELINE.md north-star target; stores_sum_stats=False production
+  posture), incl. the 1e6-query × 1e6-support streamed-KDE log-pdf
+  (SURVEY.md §7 hard part) measured standalone
+- ``posterior_gate_*``     — the repeatable 1e6 adaptive posterior-
+  exactness gate (tools/verify_northstar_posterior.py): perf work
+  cannot silently trade statistical bias
 - ``lv_pop100k_*``         — config #3, Lotka-Volterra SDE, pop 1e5
 - ``sir_pop100k_*``        — config #4, SIR tau-leap (pop 1e5 on the
   single chip this bench runs on; the 1e6 pod-sharded variant is the
@@ -37,10 +41,13 @@ is one-off).
 - ``sharded_cpu8_*``       — the same sharded program on an 8-device
   virtual CPU mesh (collective data-plane correctness timing)
 
-Every row times its generations individually (5 on the headline
+Every row times its generations individually (5-8 on the headline
 primary/north-star rows, 3 elsewhere) and reports the MEDIAN, with the
 per-generation list alongside (``*_gen_times_s``) so run-to-run spread
-is visible in the captured JSON.
+is visible in the captured JSON.  Every row also carries its transfer
+split (``*_d2h_mb_per_gen`` / ``*_transfer_s_per_gen`` /
+``*_h2d_mb_per_gen``) so wire-byte regressions are machine-visible —
+see docs/performance.md for the d2h_s caveat on compute-bound rows.
 """
 
 from __future__ import annotations
