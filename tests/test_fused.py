@@ -232,6 +232,68 @@ def test_fused_resume(tmp_path):
     assert all(counts[t] == 300 for t in range(t_done + 5))
 
 
+@pytest.mark.parametrize("cfg", [
+    # (n_models, eps_kind, pop, fuse, stores_sum_stats)
+    (1, "constant", 300, 3, True),
+    (1, "median", 300, 3, False),
+    (2, "constant", 500, 1, False),
+    (2, "median", 500, 4, True),
+    (3, "constant", 300, 3, False),
+    (3, "median", 300, 2, True),
+])
+def test_config_sweep_invariants(cfg):
+    """Seeded config sweep across model counts x epsilon kinds x fused/
+    sequential x stats-on/off-wire: every combination must produce a
+    complete History with normalized weights, full populations, finite
+    thetas, and model probabilities summing to 1."""
+    import jax
+
+    from pyabc_tpu.model import SimpleModel
+    from pyabc_tpu.random_variables import RV, Distribution
+
+    n_models, eps_kind, pop, fuse, stores = cfg
+
+    def make(shift):
+        def fn(key, theta):
+            return {"y": theta[:, 0] + shift
+                    + 0.3 * jax.random.normal(key, theta.shape[:1])}
+        return fn
+
+    models = [SimpleModel(make(0.2 * j), name=f"m{j}")
+              for j in range(n_models)]
+    priors = [Distribution(mu=RV("uniform", -1.0 + 0.1 * j, 2.0))
+              for j in range(n_models)]
+    eps = (pt.ConstantEpsilon(0.3) if eps_kind == "constant"
+           else pt.MedianEpsilon())
+    abc = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
+                    population_size=pop, eps=eps,
+                    sampler=pt.VectorizedSampler(),
+                    fuse_generations=fuse, stores_sum_stats=stores,
+                    seed=7)
+    abc.new("sqlite://", {"y": 0.5})
+    # enough generations that a fused block actually fits AFTER the
+    # sequential t=0 seeds the device carry (block entry needs
+    # t + fuse <= t_max)
+    gens = fuse + 2
+    h = abc.run(max_nr_populations=gens)
+    assert h.max_t == gens - 1
+    counts = h.get_nr_particles_per_population()
+    assert all(counts[t] == pop for t in range(gens))
+    t_last = gens - 1
+    probs = h.get_model_probabilities(t_last)
+    assert np.isclose(float(np.asarray(probs).sum()), 1.0, atol=1e-4)
+    for m in range(n_models):
+        df, w = h.get_distribution(m=m, t=t_last)
+        if len(df) == 0:
+            continue
+        assert np.all(np.isfinite(df["mu"].to_numpy()))
+        assert np.isclose(w.sum(), 1.0, atol=1e-5)
+    if eps_kind == "median":
+        epses = h.get_all_populations()
+        epses = epses[epses.t >= 1].epsilon.to_numpy()
+        assert np.all(np.diff(epses) < 0)
+
+
 def test_new_resets_fused_carry():
     """A reused ABCSMC object must not seed a NEW run's first fused
     block from the previous run's population."""
@@ -267,6 +329,31 @@ def test_fused_tail_runs_sequentially():
     assert list(h.get_all_populations().t) == [-1, 0, 1, 2, 3]
     counts = h.get_nr_particles_per_population()
     assert all(counts[t] == 400 for t in range(4))
+
+
+def test_fused_undershoot_falls_back_to_sequential(caplog):
+    """A fused block whose 16-round budget cannot reach n accepted
+    (tight epsilon + pinned tiny batch) must truncate and hand the
+    generation to the sequential path — the run still completes every
+    generation with full populations."""
+    import logging
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=2000,
+                    eps=pt.ConstantEpsilon(0.05),
+                    sampler=pt.VectorizedSampler(min_batch_size=256,
+                                                 max_batch_size=256),
+                    fuse_generations=2, seed=0)
+    abc.new("sqlite://", observed)
+    with caplog.at_level(logging.INFO, logger="ABC"):
+        h = abc.run(max_nr_populations=3)
+    assert h.max_t == 2
+    counts = h.get_nr_particles_per_population()
+    assert all(counts[t] == 2000 for t in range(3))
+    # the fallback actually triggered (not silently skipped): either the
+    # block undershot or never had the rounds to finish
+    assert any("undershot" in r.message for r in caplog.records), \
+        [r.message for r in caplog.records][-10:]
 
 
 def test_fused_simulation_budget_stop():
