@@ -1,7 +1,13 @@
 """Benchmark: accepted-particles/sec on the Gaussian-mixture ABC-SMC config.
 
-Prints ONE JSON line:
+Prints TWO JSON lines of the shape
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+first the FULL record (every sub-bench field incl. per-generation time
+lists), then a COMPACT record carrying only the scalar headline fields
+(primary_* / northstar_* / posterior_gate_*).  The compact line comes
+LAST so a tail-window log capture that truncates from the front still
+ends with one complete, parseable record — the round-5 capture lost its
+north-star fields because the single full line outgrew the tail window.
 
 Primary metric (unchanged since round 1 for comparability): BASELINE.json
 config #2 (two-Gaussian model selection) at population 16384 with a FIXED
@@ -26,7 +32,11 @@ is one-off).
 - ``northstar_pop1e6_*``   — config #2 at 1e6 particles/generation
   (BASELINE.md north-star target; stores_sum_stats=False production
   posture), incl. the 1e6-query × 1e6-support streamed-KDE log-pdf
-  (SURVEY.md §7 hard part) measured standalone
+  (SURVEY.md §7 hard part) measured standalone.  Runs the OVERLAPPED
+  streaming ingest (pyabc_tpu/wire/, the ingest_mode="auto" default at
+  this population), with a sequential-ingest control row
+  (``northstar_seq_pop1e6_*``) in the same capture so the overlap win
+  is measured inside one relay-weather sample
 - ``posterior_gate_*``     — the repeatable 1e6 adaptive posterior-
   exactness gate (tools/verify_northstar_posterior.py): perf work
   cannot silently trade statistical bias
@@ -46,8 +56,12 @@ primary/north-star rows, 3 elsewhere) and reports the MEDIAN, with the
 per-generation list alongside (``*_gen_times_s``) so run-to-run spread
 is visible in the captured JSON.  Every row also carries its transfer
 split (``*_d2h_mb_per_gen`` / ``*_transfer_s_per_gen`` /
-``*_h2d_mb_per_gen``) so wire-byte regressions are machine-visible —
-see docs/performance.md for the d2h_s caveat on compute-bound rows.
+``*_overlap_s_per_gen`` / ``*_d2h_mb_per_s`` / ``*_h2d_mb_per_gen``) so
+wire-byte regressions are machine-visible.  ``transfer_s_per_gen`` is
+the NON-overlapped wall share (d2h seconds minus the slice the wire/
+streaming ingest hid behind compute); on sequential-ingest rows
+overlap is 0 and the field means what it always did — see
+docs/performance.md for the d2h_s caveat on compute-bound rows.
 """
 
 from __future__ import annotations
@@ -122,8 +136,18 @@ def _timed_generations(abc, pop, warmup, timed=3):
     transfer = {
         "d2h_mb_per_gen": round(float(np.median(
             [x.get("d2h_bytes", 0) for x in tr])) / 1e6, 3),
+        # NON-OVERLAPPED wall share of the wire: d2h seconds minus the
+        # portion the streaming ingest hid behind compute (wire/).  On
+        # the pre-wire sequential path overlap_s is 0 and this equals
+        # the old d2h_s median, so the field stays comparable across
+        # rounds
         "transfer_s_per_gen": round(float(np.median(
-            [x.get("d2h_s", 0.0) for x in tr])), 3),
+            [max(0.0, x.get("d2h_s", 0.0) - x.get("overlap_s", 0.0))
+             for x in tr])), 3),
+        "overlap_s_per_gen": round(float(np.median(
+            [x.get("overlap_s", 0.0) for x in tr])), 3),
+        "d2h_mb_per_s": round(float(np.median(
+            [x.get("d2h_mb_per_s", 0.0) for x in tr])), 3),
         "h2d_mb_per_gen": round(float(np.median(
             [x.get("h2d_bytes", 0) for x in tr])) / 1e6, 3),
     }
@@ -189,13 +213,43 @@ def bench_northstar():
     # also carries the one-off _device_supports gather compile (round-5
     # drift analysis — BASELINE.md), so the timed window starts at t=3
     # where gen times are flat (max/min ~1.16 measured over t=3..11)
+    # pop 1e6 >= ABCSMC.OVERLAP_MIN_POP, so ingest_mode="auto" routes the
+    # overlapped streaming-ingest pipeline (pyabc_tpu/wire/) — this row
+    # IS the overlap-default north star
     rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
         abc, NORTHSTAR_POP, 3, TIMED_GENERATIONS)
-    return {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
-            "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2),
-            "northstar_pop1e6_gen_times_s": times,
-            "northstar_pop1e6_evals_per_sec": round(evals_ps, 1),
-            **{f"northstar_pop1e6_{k}": v for k, v in transfer.items()}}
+    out = {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
+           "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2),
+           "northstar_pop1e6_gen_times_s": times,
+           "northstar_pop1e6_evals_per_sec": round(evals_ps, 1),
+           **{f"northstar_pop1e6_{k}": v for k, v in transfer.items()}}
+    # sequential-ingest control row in the SAME capture: the overlap win
+    # (transfer_s_per_gen ratio) must be visible within one JSON line,
+    # not across runs where relay weather (±30-40 %) drowns it.  Shorter
+    # window (2 warmup + 3 timed): the compile cache is already hot from
+    # the overlapped run above.
+    try:
+        abc_seq = pt.ABCSMC(
+            models, priors, distance,
+            population_size=NORTHSTAR_POP,
+            eps=pt.ConstantEpsilon(0.2),
+            sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                         max_rounds_per_call=16),
+            stores_sum_stats=False,
+            ingest_mode="sequential",
+            seed=0)
+        abc_seq.new("sqlite://", observed)
+        s_rate, s_spg, s_times, s_evals, s_tr = _timed_generations(
+            abc_seq, NORTHSTAR_POP, 2, 3)
+        out.update({
+            "northstar_seq_pop1e6_accepted_per_sec": round(s_rate, 1),
+            "northstar_seq_pop1e6_wallclock_s_per_gen": round(s_spg, 2),
+            "northstar_seq_pop1e6_gen_times_s": s_times,
+            **{f"northstar_seq_pop1e6_{k}": v for k, v in s_tr.items()}})
+    except Exception as err:  # never lose the overlapped row
+        out["northstar_seq_pop1e6_error"] = (
+            f"{type(err).__name__}: {err}"[:300])
+    return out
 
 
 def bench_kde_1e6():
@@ -446,13 +500,24 @@ def main():
         with open(path) as f:
             baseline = json.load(f)["accepted_particles_per_sec"]
 
-    print(json.dumps({
+    header = {
         "metric": "accepted_particles_per_sec_gaussian_mixture_pop16384",
         "value": round(rate, 1),
         "unit": "particles/s",
         "vs_baseline": round(rate / baseline, 2),
-        "extra": extra,
-    }))
+    }
+    # full line first (humans, logs) ...
+    print(json.dumps({**header, "extra": extra}))
+    # ... then the COMPACT line LAST, so a tail-window capture that only
+    # sees the end of stdout still parses a complete record (the round-5
+    # full line outgrew the driver's tail window and the capture lost the
+    # north-star fields).  Scalars only — the per-generation lists are
+    # what made the full line huge — restricted to the headline prefixes.
+    compact = {k: v for k, v in sorted(extra.items())
+               if k.startswith(("primary_", "northstar_",
+                                "posterior_gate_"))
+               and not isinstance(v, (list, dict))}
+    print(json.dumps({**header, "extra": compact}))
 
 
 PETAB_POP = 100_000

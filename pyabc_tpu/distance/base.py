@@ -83,9 +83,20 @@ class Distance:
     def params_time_invariant(self) -> bool:
         """True iff ``get_params(t)`` is the same pytree for every t of
         the current run.  Consumers that bake params into a compiled
-        program spanning multiple generations (the fused
-        multi-generation engine, smc.py) must check this."""
-        return True
+        program spanning multiple generations (the fused engine and the
+        overlapped ingest pipeline, smc.py) must check this.
+
+        Conservative by construction, mirroring the
+        ``_distance_is_adaptive`` heuristic: a USER subclass that
+        overrides ``get_params`` may return anything per t, so it only
+        counts as invariant when it explicitly says so; library classes
+        (``pyabc_tpu.*``) declare their invariance — adaptive flavors
+        override this to report their actual schedule."""
+        gp = type(self).get_params
+        if gp is Distance.get_params:
+            return True
+        return (getattr(gp, "__module__", "")
+                or "").startswith("pyabc_tpu.")
 
     # ---- dynamic params + pure compute ----------------------------------
 

@@ -125,8 +125,10 @@ class PNormDistance(Distance):
 
     def params_time_invariant(self) -> bool:
         # time-indexed {t: {key: w}} weight schedules change get_params
-        # across generations even without adaptivity
-        return len(self.weights) <= 1
+        # across generations even without adaptivity; the super() call
+        # keeps the conservative base heuristic for USER subclasses that
+        # override get_params on top of this class
+        return len(self.weights) <= 1 and super().params_time_invariant()
 
     def get_params(self, t: int):
         w = self._weights_for(t)
@@ -198,6 +200,12 @@ class AdaptivePNormDistance(PNormDistance):
         self._fit(t, self.spec.flatten(get_all_stats()))
         return True
 
+    def params_time_invariant(self) -> bool:
+        # adaptive refits rewrite the weight schedule every generation
+        # (even when only the calibration entry exists at check time);
+        # with adaptation off this is a plain time-indexed PNorm
+        return (not self.adaptive) and super().params_time_invariant()
+
     def _fit(self, t: int, data: Array):
         """Refit weights on-device, store host-side (distance.py:268-330)."""
         scale = np.asarray(_apply_scale(
@@ -255,7 +263,12 @@ class AggregatedDistance(Distance):
             d.configure_sampler(sampler)
 
     def params_time_invariant(self) -> bool:
-        return all(d.params_time_invariant() for d in self.distances)
+        # invariant iff every sub-distance is, no per-t weight schedule
+        # is installed, and get_params has not been re-overridden by a
+        # user subclass (conservative base heuristic)
+        return (all(d.params_time_invariant() for d in self.distances)
+                and len(self.weights) <= 1
+                and Distance.params_time_invariant(self))
 
     def update(self, t, get_all_stats=None) -> bool:
         changed = False
@@ -317,6 +330,10 @@ class AdaptiveAggregatedDistance(AggregatedDistance):
             self._fit(t, self.spec.flatten(get_all_stats()))
             changed = True
         return changed
+
+    def params_time_invariant(self) -> bool:
+        # the sub-distance weights refit every generation when adaptive
+        return (not self.adaptive) and super().params_time_invariant()
 
     def _fit(self, t: int, data: Array):
         obs = self._x0_flat

@@ -172,6 +172,7 @@ class VectorizedSampler(Sampler):
 
     def sample_until_n_accepted(self, n, round_fn, key, params,
                                 max_eval=np.inf, all_accepted=False,
+                                defer_wire_fetch=False,
                                 **kwargs) -> Sample:
         sample = Sample(record_rejected=self.record_rejected,
                         max_records=self.max_records)
@@ -263,6 +264,13 @@ class VectorizedSampler(Sampler):
             wire_m_bits)
         prev_state = self._states.pop(loop_key, None)
         state = start() if prev_state is None else reset(prev_state)
+        # defer_wire_fetch: leave the big wire payload device-resident
+        # (only the count/rounds scalars sync) so a streaming-ingest
+        # engine (wire/) can overlap the fetch with the next
+        # generation's compute.  Record harvesting needs host ingestion
+        # anyway, so the deferral is disabled there.
+        defer_wire = bool(defer_wire_fetch) and not record_cap
+        pending = None
         call_idx = 0
         count = rounds = 0
         out = None
@@ -282,8 +290,14 @@ class VectorizedSampler(Sampler):
             out = out_dev = rec = None
             if expected >= n and prefetch_ok and not record_cap:
                 state, wire_dev, out_dev = step_finalize(sub, params, state)
-                out = fetch_to_host(wire_dev)
-                count, rounds = int(out["count"]), int(out["rounds"])
+                if defer_wire:
+                    scalars = fetch_to_host([wire_dev["count"],
+                                             wire_dev["rounds"]])
+                    count, rounds = int(scalars[0]), int(scalars[1])
+                    pending = (wire_dev, out_dev)
+                else:
+                    out = fetch_to_host(wire_dev)
+                    count, rounds = int(out["count"]), int(out["rounds"])
             else:
                 state = step(sub, params, state)
                 if record_cap:
@@ -331,17 +345,25 @@ class VectorizedSampler(Sampler):
                 logger.warning("max_eval=%s reached with %d/%d accepted",
                                max_eval, count, n)
                 break
-            out = out_dev = None  # mis-predicted prefetch: discard
-        if out is None:
+            out = out_dev = pending = None  # mis-predicted prefetch: discard
+        if out is None and pending is None:
             wire_dev, out_dev = finalize(state, params)
-            out = fetch_to_host(wire_dev)
+            if defer_wire:
+                pending = (wire_dev, out_dev)
+            else:
+                out = fetch_to_host(wire_dev)
         # keep the carry buffers alive for the next generation's reset;
         # bound the cache so states orphaned by a batch-ladder change
         # don't pin device memory
         self._states[loop_key] = state
         while len(self._states) > 4:
             self._states.pop(next(iter(self._states)))
-        sample.append_device_batch(out, rounds * B, device_view=out_dev)
+        if pending is not None:
+            wire_dev, out_dev = pending
+            sample.append_pending_wire(wire_dev, rounds * B, count,
+                                       device_view=out_dev)
+        else:
+            sample.append_device_batch(out, rounds * B, device_view=out_dev)
         if bar is not None:
             bar.finish()
         self.nr_evaluations_ = sample.nr_evaluations

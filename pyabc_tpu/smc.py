@@ -99,6 +99,8 @@ class ABCSMC:
                  show_progress: bool = False,
                  stores_sum_stats: bool = True,
                  fuse_generations: int = 1,
+                 ingest_mode: str = "auto",
+                 ingest_depth: int = 2,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -147,6 +149,22 @@ class ABCSMC:
         self.fuse_generations = int(fuse_generations)
         self._fused_cache: Dict[tuple, Callable] = {}
         self._fused_carry = None
+        if ingest_mode not in ("auto", "overlap", "sequential"):
+            raise ValueError(
+                "ingest_mode must be 'auto', 'overlap' or 'sequential' "
+                f"(got {ingest_mode!r})")
+        #: d2h ingest pipelining (pyabc_tpu/wire/): "overlap" streams
+        #: each generation's fetch + decode through a background engine
+        #: while the next generation computes on device; "sequential"
+        #: keeps the pre-wire blocking loop byte-identically; "auto"
+        #: overlaps exactly when the adaptation chain is
+        #: device-computable AND the population is large enough to be
+        #: transfer-bound (>= OVERLAP_MIN_POP)
+        self.ingest_mode = ingest_mode
+        #: bounded backpressure depth of the streaming engine — at most
+        #: this many generation blocks in flight, so host memory stays
+        #: O(depth x pop); 0 runs the same pipeline synchronously inline
+        self.ingest_depth = int(ingest_depth)
         self.key = jax.random.PRNGKey(seed)
         #: per-generation wall-clock seconds, keyed by t — measured
         #: append-to-append like the DB-timestamp diffs, but available
@@ -381,16 +399,17 @@ class ABCSMC:
     # fused multi-generation blocks (sampler/fused.py)
     # ------------------------------------------------------------------
 
-    def _fused_eligible(self) -> bool:
-        """The whole propose→accept→refit→new-eps chain is
-        device-computable: run ``fuse_generations`` generations per
-        dispatch.  Anything outside the known-safe component set falls
+    def _device_chain_eligible(self) -> bool:
+        """The whole propose→accept→refit→new-eps chain of this
+        configuration is device-computable (sampler/fused.py) — the
+        shared precondition of the fused multi-generation engine AND the
+        overlapped streaming-ingest pipeline (wire/), both of which run
+        generations from a device-resident carry with no host adaptation
+        in between.  Anything outside the known-safe component set falls
         back to the sequential loop."""
         from .epsilon.epsilon import ConstantEpsilon, QuantileEpsilon
         from .sampler.sharded import ShardedSampler
         from .sampler.vectorized import VectorizedSampler
-        if self.fuse_generations < 2:
-            return False
         s = self.sampler
         if not isinstance(s, VectorizedSampler):
             return False
@@ -418,22 +437,13 @@ class ABCSMC:
         if not all(type(tr) is MultivariateNormalTransition
                    for tr in self.transitions):
             return False
-        # fusion pays off in the DISPATCH-FLOORED regime (small-to-mid
-        # populations where a generation is one relay round-trip);
-        # measured same-session at pop 1e6 the fused block is ~25 %
-        # SLOWER than the per-generation loop (full-support gathers per
-        # refit, no early-stop rate adaptation, worse per-byte relay
-        # throughput on block-sized transactions) — transfer dominates
-        # there and fusion has no headroom.  Cap at 2^17 particles.
-        n = self.population_strategy(0)
-        if n > (1 << 17):
-            return False
-        # and bound the per-generation deferred proposal correction: n
+        # bound the per-generation deferred proposal correction: n
         # queries x the pdf-support rows of every model (large 1-D
         # models compress to a ~2^14 device grid,
         # fused._compress_support_device; others keep full n rows)
         from .sampler.fused import _DEVICE_GRID
         from .transition.multivariatenormal import _COMPRESS_MIN_N
+        n = self.population_strategy(0)
         rows = sum(
             (_DEVICE_GRID if (p.dim == 1 and n >= _COMPRESS_MIN_N)
              else n)
@@ -442,50 +452,87 @@ class ABCSMC:
             return False
         return True
 
-    def _run_fused_block(self, t: int, t_max, total_sims: int,
-                         max_total_nr_simulations):
-        """Execute one fused K-generation block starting at ``t``.
+    def _fused_eligible(self) -> bool:
+        """Run ``fuse_generations`` generations per dispatch?  Requires
+        the device-computable chain, and pays off only in the
+        DISPATCH-FLOORED regime (small-to-mid populations where a
+        generation is one relay round-trip); measured same-session at
+        pop 1e6 the fused block is ~25 % SLOWER than the per-generation
+        loop (full-support gathers per refit, no early-stop rate
+        adaptation, worse per-byte relay throughput on block-sized
+        transactions) — transfer dominates there and fusion has no
+        headroom.  Cap at 2^17 particles; above it the overlapped
+        ingest pipeline (wire/) is the scaling lever instead."""
+        if self.fuse_generations < 2:
+            return False
+        if self.population_strategy(0) > (1 << 17):
+            return False
+        return self._device_chain_eligible()
 
-        Returns ``(written, sims_added, stop_reason)`` — ``written``
-        generations were durably appended to the History (0 means the
-        caller must take the sequential path for ``t``).
-        """
-        import time as _time
+    #: "auto" ingest overlaps only at transfer-bound population sizes;
+    #: below this the fetch is sub-millisecond and pipelining would only
+    #: add thread hops (and the fused engine already owns that regime)
+    OVERLAP_MIN_POP = 1 << 17
 
-        import jax.numpy as jnp
+    def _overlap_enabled(self) -> bool:
+        """Route ``run()`` through the overlapped streaming-ingest
+        pipeline?  "sequential" never — the classic loop is byte-
+        identical to the pre-wire path.  "overlap" whenever the device
+        chain is eligible (warns + falls back otherwise).  "auto"
+        additionally requires a transfer-bound population size."""
+        if self.ingest_mode == "sequential":
+            return False
+        if not self._device_chain_eligible():
+            if self.ingest_mode == "overlap":
+                logger.warning(
+                    "ingest_mode='overlap' requested but the component "
+                    "chain is not device-computable; using the "
+                    "sequential ingest path")
+            return False
+        if self.ingest_mode == "overlap":
+            return True
+        return self.population_strategy(0) >= self.OVERLAP_MIN_POP
 
+    def _eps_device_config(self):
+        """(mode, alpha, multiplier, weighted) for the device-side eps
+        schedule of a generation block."""
         from .epsilon.epsilon import ConstantEpsilon
-        from .sampler.base import fetch_to_host
-        from .sampler.fused import build_fused_generations
-        from .utils import transfer as _transfer
+        if isinstance(self.eps, ConstantEpsilon):
+            return "constant", 0.5, 1.0, True
+        return ("quantile", self.eps.alpha, self.eps.quantile_multiplier,
+                self.eps.weighted)
 
-        carry = self._fused_carry
-        self._fused_carry = None
-        if carry is None:
-            return 0, 0, None
-        K = self.fuse_generations
-        n = self.population_strategy(t)
+    def _block_max_rounds(self, n: int, B: int) -> int:
+        """Per-generation round cap of a device block, derived from the
+        caller's ``min_acceptance_rate`` budget: past
+        ``ceil(n / (min_rate * B))`` evaluations the sequential loop
+        would have stopped anyway, so rounds beyond that only burn
+        device time on a generation the ingest will discard.  Capped at
+        the historical 16 (and the sequential default when no rate floor
+        is set)."""
+        if self.min_acceptance_rate > 0:
+            return int(np.clip(
+                np.ceil(n / (self.min_acceptance_rate * B)), 1, 16))
+        return 16
+
+    def _get_block_fn(self, t: int, n: int, B: int, K: int):
+        """Build (or serve cached) the jitted K-generation device block
+        for the current configuration — shared by ``_run_fused_block``
+        and the overlapped pipeline (which uses K=1 blocks at
+        transfer-bound sizes)."""
+        from .sampler.fused import build_fused_generations
         samp = self.sampler
-        if carry["theta"].shape[0] != n:
-            return 0, 0, None  # population size changed: sequential
-        B = samp._round_to_valid_batch(
-            n / max(samp._rate_est, 1e-6) * samp.safety_factor)
         d, s_width = self.dim, self.spec.total_size
         wire_stats = bool(samp.fetch_stats)
         wire_m_bits = self.M <= 2
-        if isinstance(self.eps, ConstantEpsilon):
-            eps_mode, alpha, mult, weighted = "constant", 0.5, 1.0, True
-        else:
-            eps_mode = "quantile"
-            alpha = self.eps.alpha
-            mult = self.eps.quantile_multiplier
-            weighted = self.eps.weighted
+        eps_mode, alpha, mult, weighted = self._eps_device_config()
+        max_rounds = self._block_max_rounds(n, B)
         # samp._uid: the compiled fn closes over the sampler's round
         # builder (for ShardedSampler that bakes in mesh + axis), so a
         # swapped sampler must never be served a stale program
         cache_key = ("fused", self._kernel._uid, samp._uid, B,
                      n, K, d, s_width, eps_mode, alpha, mult, weighted,
-                     wire_stats, wire_m_bits)
+                     wire_stats, wire_m_bits, max_rounds)
         fn = self._fused_cache.get(cache_key)
         if fn is None:
             fn = jax.jit(build_fused_generations(
@@ -500,7 +547,8 @@ class ABCSMC:
                                      for tr in self.transitions],
                 scalings=[tr.scaling for tr in self.transitions],
                 dims=[p.dim for p in self.parameter_priors],
-                n_target=n, B=B, max_rounds=16, K=K, d=d, s=s_width,
+                n_target=n, B=B, max_rounds=max_rounds, K=K, d=d,
+                s=s_width,
                 eps_mode=eps_mode, eps_alpha=alpha, eps_multiplier=mult,
                 eps_weighted=weighted,
                 distance_params=jax.device_put(
@@ -509,6 +557,37 @@ class ABCSMC:
             self._fused_cache[cache_key] = fn
             while len(self._fused_cache) > 4:
                 self._fused_cache.pop(next(iter(self._fused_cache)))
+        return fn
+
+    def _run_fused_block(self, t: int, t_max, total_sims: int,
+                         max_total_nr_simulations):
+        """Execute one fused K-generation block starting at ``t``.
+
+        Returns ``(written, sims_added, stop_reason)`` — ``written``
+        generations were durably appended to the History (0 means the
+        caller must take the sequential path for ``t``).
+        """
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from .sampler.base import fetch_to_host
+        from .utils import transfer as _transfer
+        from .wire.ingest import batch_to_population, split_block_wire
+
+        carry = self._fused_carry
+        self._fused_carry = None
+        if carry is None:
+            return 0, 0, None
+        K = self.fuse_generations
+        n = self.population_strategy(t)
+        samp = self.sampler
+        if carry["theta"].shape[0] != n:
+            return 0, 0, None  # population size changed: sequential
+        B = samp._round_to_valid_batch(
+            n / max(samp._rate_est, 1e-6) * samp.safety_factor)
+        eps_mode = self._eps_device_config()[0]
+        fn = self._get_block_fn(t, n, B, K)
 
         t0_block = _time.perf_counter()
         tr0_block = _transfer.snapshot()
@@ -520,29 +599,18 @@ class ABCSMC:
                                else 0.0),
         }
         carry_out, wires = fn(carry_in, self._split())
-        wires = fetch_to_host(wires)  # ONE transaction for all K gens
-
-        # widen the stacked wire through the SHARED decoder (one call
-        # per generation on that generation's slice of the stack)
-        from .sampler.base import widen_wire
-        counts = np.asarray(wires["count"])
-        rounds = np.asarray(wires["rounds"])
-        eps_vals = np.asarray(wires["eps"], dtype=np.float64)
-        scalar_keys = ("count", "rounds", "eps")
-        per_gen = [widen_wire({key: v[k] for key, v in wires.items()
-                               if key not in scalar_keys}, n)
-                   for k in range(K)]
-        m_all = [g["m"] for g in per_gen]
-        theta_all = [g["theta"] for g in per_gen]
-        dist_all = [g["distance"] for g in per_gen]
-        lw_all = [g["log_weight"] for g in per_gen]
-        stats_all = ([g["stats"] for g in per_gen]
-                     if "stats" in per_gen[0] else None)
+        # ONE transaction for all K gens, split + widened through the
+        # SHARED wire decoder (wire/ingest.py)
+        per_gen, counts, rounds, eps_vals = split_block_wire(
+            fetch_to_host(wires), K, n)
 
         # every executed generation's evaluations count against the
         # simulation budget — including any the ingest below discards
-        # (undershoot tails ran on the device regardless)
+        # (undershoot tails ran on the device regardless); mirror them
+        # onto the sampler's counter so fused runs don't undercount vs
+        # the History totals
         sims_added = int(rounds.sum()) * B
+        samp.nr_evaluations_ += sims_added
         written = 0
         stop_reason = None
         for k in range(K):
@@ -556,21 +624,11 @@ class ABCSMC:
                     "falling back to the sequential path", t_k, count_k, n)
                 break
             evals_k = int(rounds[k]) * B
-            lw = lw_all[k].astype(np.float64)
-            lw = lw - lw.max()
-            w = np.exp(lw)
-            w_sum = w.sum()
-            if not (np.isfinite(w_sum) and w_sum > 0):
+            pop_k = batch_to_population(per_gen[k])
+            if pop_k is None:
                 logger.warning("fused block produced degenerate weights "
                                "at t=%d: sequential fallback", t_k)
                 break
-            pop_k = Population(
-                m=m_all[k], theta=theta_all[k],
-                weight=(w / w_sum).astype(np.float32),
-                distance=dist_all[k],
-                sum_stats=({"__flat__": stats_all[k]}
-                           if stats_all is not None else {}),
-            )
             # constant mode: take the HOST value — the f32 device
             # round-trip of eps would defeat `eps <= minimum_epsilon`
             eps_k = (float(self.eps(t_k)) if eps_mode == "constant"
@@ -629,6 +687,311 @@ class ABCSMC:
                     t + written, prep, last_pop,
                     samp._rate_est)
         return written, sims_added, stop_reason
+
+    # ------------------------------------------------------------------
+    # overlapped streaming-ingest pipeline (pyabc_tpu/wire/)
+    # ------------------------------------------------------------------
+
+    def _run_pipelined(self, t0: int, t_max, max_total_nr_simulations):
+        """The overlapped generation loop (wire/ tentpole).
+
+        Device blocks (K fused generations; K=1 at transfer-bound sizes)
+        are dispatched ahead of the ingest frontier: block i+1's compute
+        is enqueued as soon as block i's accepted buffers are
+        snapshotted — its carry is device-resident, no host data is
+        needed — while a :class:`StreamingIngest` worker drains block
+        i's d2h fetch + wire decode concurrently.  History appends and
+        stopping criteria run HERE on the caller thread, in strict
+        generation order, as each block is harvested (the sqlite
+        connection is thread-affine, and the criteria must see
+        generations in order).
+
+        Stopping criteria therefore lag the dispatch frontier by up to
+        ``ingest_depth`` blocks.  When a stop (or an undershoot /
+        degenerate-weight fallback) is detected behind speculative
+        blocks, those blocks are abandoned: their device work is sunk,
+        their wires are dropped unread, their simulations are NOT
+        counted, and nothing of them reaches the History — the durable
+        record stays exactly what the sequential criteria order admits.
+
+        ``ingest_depth == 0`` runs the SAME pipeline with the engine in
+        synchronous inline mode — identical call sequence, zero threads
+        — which is the equivalence the exactness tests pin.  A worker
+        error latches the engine and re-raises on the next harvest /
+        submit, so a broken wire surfaces within one generation.
+        """
+        import time as _time
+        from collections import deque
+
+        from .sampler.base import fetch_to_host
+        from .utils import transfer as _transfer
+        from .wire import StreamingIngest
+        from .wire.ingest import (batch_to_population, split_block_wire,
+                                  split_single_wire)
+
+        samp = self.sampler
+        eps_mode = self._eps_device_config()[0]
+        fused_K = self.fuse_generations if self._fused_eligible() else 1
+        ingest = StreamingIngest(depth=self.ingest_depth)
+        inflight = deque()
+        st = {
+            "t": t0,            # ingest frontier: next gen to append
+            "t_disp": t0,       # dispatch frontier
+            "total_sims": 0,
+            "carry": self._fused_carry,  # latest dispatched device carry
+            "stop": None,
+            "last_pop": None,   # Population of the last appended gen
+            "last_dp": None,    # device view of the last appended gen
+            "prepared_t": t0,   # host component state is fitted up to here
+            # acceptance-rate estimate used for DISPATCH batch sizing.
+            # Deliberately frozen between sequential generations (not
+            # updated at harvest): harvest timing depends on the ingest
+            # depth, and a depth-dependent B would make the dispatched
+            # programs — and therefore the run's results — depend on the
+            # pipelining, breaking depth-0 == depth-2 exactness
+            "rate_disp": samp._rate_est,
+            "gen_mark": _time.perf_counter(),
+            "tr_mark": _transfer.snapshot(),
+        }
+        self._fused_carry = None
+
+        def rewind_to_frontier():
+            """Abandon speculative blocks behind a stop/fallback."""
+            while inflight:
+                blk = inflight.pop()
+                if blk["ticket"] is not None:
+                    blk["ticket"].abandon()
+            st["carry"] = None
+            st["t_disp"] = st["t"]
+
+        def dispatch_block() -> bool:
+            carry, t_d = st["carry"], st["t_disp"]
+            n = self.population_strategy(t_d)
+            if carry["theta"].shape[0] != n:
+                st["carry"] = None  # population size changed: sequential
+                return False
+            K = (fused_K if (fused_K > 1 and t_d + fused_K <= t_max)
+                 else 1)
+            if t_d + K > t_max:
+                return False
+            B = samp._round_to_valid_batch(
+                n / max(st["rate_disp"], 1e-6) * samp.safety_factor)
+            fn = self._get_block_fn(t_d, n, B, K)
+            carry_in = {
+                "m": carry["m"], "theta": carry["theta"],
+                "log_weight": carry["log_weight"],
+                "distance": carry["distance"], "count": carry["count"],
+                "eps": jnp.float32(self.eps(t_d)
+                                   if eps_mode == "constant" else 0.0),
+            }
+            carry_out, wires = fn(carry_in, self._split())
+            ticket = ingest.submit(
+                lambda: split_block_wire(fetch_to_host(wires), K, n),
+                label=f"block@t={t_d}")
+            inflight.append({"kind": "block", "ticket": ticket,
+                             "t0": t_d, "K": K, "B": B, "n": n,
+                             "carry_out": carry_out})
+            st["carry"] = carry_out
+            st["t_disp"] = t_d + K
+            return True
+
+        def sequential_gen() -> bool:
+            """One classic host-adapted generation with the wire fetch
+            deferred into the ingest engine; (re)builds the device carry
+            so the block pipeline can resume.  Returns False on a
+            stop."""
+            t = st["t"]
+            if t > st["prepared_t"]:
+                # host component state (transition fits, eps schedule)
+                # was skipped while generations flowed through device
+                # blocks — rebuild it from the last ingested population,
+                # exactly like the fused path's continuation
+                prep = Sample()
+                prep.device_population = st["last_dp"]
+                self._prepare_next_iteration(
+                    t, prep, st["last_pop"], samp._rate_est)
+                st["prepared_t"] = t
+            current_eps = float(self.eps(t))
+            n = self.population_strategy(t)
+            max_eval = (n / self.min_acceptance_rate
+                        if self.min_acceptance_rate > 0 else np.inf)
+            params = {
+                "distance": self.distance_function.get_params(t),
+                "acceptor": self.acceptor.get_params(t, self.eps),
+            }
+            if t == 0:
+                round_fn = self._kernel.prior_round
+            else:
+                round_fn = self._kernel.generation_round
+                probs = self._model_probabilities(t - 1)
+                with np.errstate(divide="ignore"):
+                    params["model_log_probs"] = np.log(
+                        np.maximum(probs, 1e-300)).astype(np.float32)
+                params["transition"] = self._trans_params
+            logger.info("t: %d, eps: %.8g", t, current_eps)
+            sample = samp.sample_until_n_accepted(
+                n, round_fn, self._split(), params, max_eval=max_eval,
+                defer_wire_fetch=True)
+            if sample.n_accepted < n:
+                logger.info(
+                    "Stopping: acceptance rate fell below "
+                    "min_acceptance_rate (%d/%d accepted)",
+                    sample.n_accepted, n)
+                st["stop"] = ""  # already logged, classic wording
+                return False
+            st["total_sims"] += sample.nr_evaluations
+            st["rate_disp"] = samp._rate_est
+            dp = sample.device_population
+            st["carry"] = (dp if dp is not None and "distance" in dp
+                           else None)
+            entry = {"kind": "seq", "ticket": None, "t0": t, "K": 1,
+                     "n": n, "evals": sample.nr_evaluations,
+                     "eps": current_eps,
+                     "acc_rate": sample.acceptance_rate,
+                     "dp": st["carry"]}
+            wire_dev = sample.take_pending_wire()
+            if wire_dev is not None:
+                entry["ticket"] = ingest.submit(
+                    lambda: split_single_wire(fetch_to_host(wire_dev), n),
+                    label=f"gen@t={t}")
+            else:
+                # the sampler ingested host-side already (no deferral
+                # support): carry the ready population through the same
+                # ordered harvest
+                entry["kind"] = "pop"
+                entry["pop"] = sample.get_accepted_population(n)
+            inflight.append(entry)
+            st["t_disp"] = t + 1
+            return True
+
+        def harvest_one():
+            blk = inflight.popleft()
+            base_sims = st["total_sims"]
+            if blk["kind"] == "pop":
+                gens, counts, rounds = None, [blk["n"]], None
+            else:
+                gens, counts, rounds, eps_vals = blk["ticket"].result()
+            if blk["kind"] == "block":
+                # block sims count at harvest (abandoned speculative
+                # blocks never count); mirrored onto the sampler's
+                # counter like the fused path
+                sims = int(rounds.sum()) * blk["B"]
+                st["total_sims"] += sims
+                samp.nr_evaluations_ += sims
+            n, K = blk["n"], blk["K"]
+            written = 0
+            fallback = False
+            for k in range(K):
+                t_k = blk["t0"] + k
+                count_k = int(counts[k])
+                if count_k < n:
+                    logger.info(
+                        "pipelined block undershot at t=%d (%d/%d "
+                        "accepted): sequential fallback", t_k, count_k, n)
+                    fallback = True
+                    break
+                if blk["kind"] == "pop":
+                    pop_k = blk["pop"]
+                else:
+                    pop_k = batch_to_population(gens[k])
+                if pop_k is None:
+                    logger.warning(
+                        "pipelined block produced degenerate weights at "
+                        "t=%d: sequential fallback", t_k)
+                    fallback = True
+                    break
+                if blk["kind"] == "block":
+                    evals_k = int(rounds[k]) * blk["B"]
+                    eps_k = (float(self.eps(t_k))
+                             if eps_mode == "constant"
+                             else float(eps_vals[k]))
+                    acc_rate = count_k / max(evals_k, 1)
+                    logger.info("t: %d, eps: %.8g (pipelined)", t_k,
+                                eps_k)
+                    if eps_mode == "quantile":
+                        self.eps._look_up[t_k] = eps_k
+                else:
+                    evals_k = blk["evals"]
+                    eps_k = blk["eps"]
+                    acc_rate = blk["acc_rate"]
+                self.history.append_population(
+                    t_k, eps_k, pop_k, evals_k,
+                    [m.name for m in self.models], self._param_names(),
+                    stat_spec=self.spec.shapes)
+                logger.info(
+                    "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
+                    t_k, acc_rate,
+                    float(effective_sample_size(pop_k.weight)), evals_k)
+                written += 1
+                st["t"] = t_k + 1
+                st["last_pop"] = pop_k
+                samp._rate_est = max(acc_rate, 1e-6)
+                # stopping criteria, sequential order (classic loop)
+                sims_so_far = (
+                    base_sims + int(rounds[:k + 1].sum()) * blk["B"]
+                    if blk["kind"] == "block" else st["total_sims"])
+                if eps_k <= self.minimum_epsilon:
+                    st["stop"] = "Stopping: minimum epsilon reached"
+                elif (self.stop_if_only_single_model_alive
+                        and pop_k.nr_of_models_alive() <= 1
+                        and self.M > 1):
+                    st["stop"] = "Stopping: single model alive"
+                elif acc_rate < self.min_acceptance_rate:
+                    st["stop"] = "Stopping: acceptance rate too low"
+                elif sims_so_far >= max_total_nr_simulations:
+                    st["stop"] = "Stopping: simulation budget exhausted"
+                if st["stop"]:
+                    break
+            if written:
+                now = _time.perf_counter()
+                block_dt = now - st["gen_mark"]
+                st["gen_mark"] = now
+                tr_delta = _transfer.delta(st["tr_mark"])
+                st["tr_mark"] = _transfer.snapshot()
+                for k in range(written):
+                    self.generation_wall_clock[blk["t0"] + k] = \
+                        block_dt / written
+                    self.generation_transfer[blk["t0"] + k] = {
+                        key: v / written for key, v in tr_delta.items()}
+                if blk["kind"] == "block":
+                    st["last_dp"] = (dict(blk["carry_out"])
+                                     if written == K else None)
+                else:
+                    st["last_dp"] = blk.get("dp")
+            if fallback or st["stop"]:
+                rewind_to_frontier()
+
+        depth_cap = max(self.ingest_depth, 1)
+        try:
+            while st["t"] < t_max and st["stop"] is None:
+                if stop_requested():
+                    # drain in-flight generations (their device work is
+                    # done, the data is real) then exit between
+                    # generations, like the classic loop
+                    while inflight and st["stop"] is None:
+                        harvest_one()
+                    if st["stop"] is None:
+                        st["stop"] = "Stopping: operator stop requested"
+                    break
+                if st["carry"] is None and not inflight:
+                    if not sequential_gen():
+                        break
+                    continue  # carry rebuilt: try the block pipeline
+                while (st["carry"] is not None
+                       and len(inflight) < depth_cap
+                       and st["total_sims"] < max_total_nr_simulations
+                       and dispatch_block()):
+                    pass
+                if inflight:
+                    harvest_one()
+                elif st["carry"] is not None:
+                    break  # dispatch frontier reached t_max: done
+        finally:
+            ingest.close()  # abandons anything still in flight
+        if st["stop"]:
+            logger.info(st["stop"])
+        # keep the device chain hot for a later run() continuation
+        self._fused_carry = st["carry"] if st["stop"] is None else None
 
     def _proposal_log_pdf(self, probs: np.ndarray, m: np.ndarray,
                           theta: np.ndarray) -> np.ndarray:
@@ -829,6 +1192,15 @@ class ABCSMC:
         # timestamp diffs the bench used through round 4)
         gen_mark = _time.perf_counter()
         tr_mark = _transfer.snapshot()
+        if self._overlap_enabled():
+            # overlapped streaming ingest (wire/): gen t+1's device
+            # compute runs while gen t's fetch + decode drain in the
+            # background; the classic loop below stays byte-identical
+            # for ingest_mode="sequential" (and for ineligible configs)
+            self._run_pipelined(t0, t_max, max_total_nr_simulations)
+            self.history.done()
+            return self.history
+
         fused_ok = self._fused_eligible()
         while t < t_max:
             # operator clean-stop (abc-distributed-manager stop): exit

@@ -1,70 +1,18 @@
-"""Host<->device transfer accounting.
+"""Compatibility shim: the canonical transfer ledger lives in the wire
+subsystem (``pyabc_tpu/wire/transfer.py``) since streaming ingest landed
+— the counters are per-stage now (``compute_s``/``fetch_s``/
+``overlap_s`` next to the historical ``d2h_*``/``h2d_*`` keys).  This
+module re-exports it unchanged so existing imports keep working."""
 
-The north-star budget is transfer-bound: the per-generation population
-fetch rides a ~6-8 MB/s relay d2h link, so wire BYTES — not FLOPs — are
-the lever that matters (BASELINE.md round-4 analysis).  This module keeps
-process-global counters that the samplers' single choke points
-(``fetch_to_host`` for d2h, the per-generation ``device_put`` for h2d)
-increment, so regressions in wire bytes are machine-visible in the bench
-JSON (VERDICT r4 next #5) instead of hiding inside wall-clock noise.
-
-The reference has no analog — its sampler transport is pickled
-process/network IO with no byte accounting (e.g.
-pyabc/sampler/redis_eps/sampler.py result pipelines).
-"""
-
-from __future__ import annotations
-
-import threading
-import time
-
-_lock = threading.Lock()
-_state = {"d2h_bytes": 0, "d2h_s": 0.0, "d2h_calls": 0, "h2d_bytes": 0}
-
-
-def _tree_nbytes(tree) -> int:
-    import jax.tree_util as tu
-
-    return sum(getattr(leaf, "nbytes", 0)
-               for leaf in tu.tree_leaves(tree))
-
-
-def record_d2h(nbytes: int, seconds: float):
-    with _lock:
-        _state["d2h_bytes"] += int(nbytes)
-        _state["d2h_s"] += float(seconds)
-        _state["d2h_calls"] += 1
-
-
-def record_h2d(nbytes: int):
-    with _lock:
-        _state["h2d_bytes"] += int(nbytes)
-
-
-def snapshot() -> dict:
-    with _lock:
-        return dict(_state)
-
-
-def delta(before: dict, after: dict = None) -> dict:
-    """Counter difference ``after - before`` (``after`` defaults to now)."""
-    after = after if after is not None else snapshot()
-    return {k: after[k] - before.get(k, 0) for k in _state}
-
-
-class timed_d2h:
-    """Context manager charging one device->host transaction: measures
-    wall time and credits ``nbytes`` (computed by the caller from the
-    fetched tree) to the d2h counters."""
-
-    def __enter__(self):
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self.seconds = time.perf_counter() - self._t0
-        return False
-
-    def commit(self, tree):
-        record_d2h(_tree_nbytes(tree), self.seconds)
-        return tree
+from ..wire.transfer import (  # noqa: F401
+    _lock,
+    _state,
+    _tree_nbytes,
+    delta,
+    record_compute,
+    record_d2h,
+    record_h2d,
+    record_overlap,
+    snapshot,
+    timed_d2h,
+)

@@ -1,0 +1,20 @@
+"""`pyabc_tpu.wire` — the device->host streaming-ingest subsystem.
+
+Three pieces:
+
+- :mod:`~pyabc_tpu.wire.transfer`  — the per-stage byte/seconds ledger
+  (absorbed from ``utils/transfer.py``; ``compute_s``/``fetch_s``/
+  ``overlap_s`` counters, derived ``d2h_mb_per_s``).
+- :mod:`~pyabc_tpu.wire.streaming` — :class:`StreamingIngest`, the
+  bounded-depth background engine that overlaps generation t's fetch +
+  decode with generation t+1's on-device compute.
+- :mod:`~pyabc_tpu.wire.ingest`    — the shared wire decode / population
+  assembly used by every ingest site (fused blocks, the overlapped
+  pipeline, sequential deferred wires).
+
+``ingest`` is imported lazily by its callers (it reaches back into the
+sampler package, which itself depends on ``wire.transfer``).
+"""
+
+from . import transfer  # noqa: F401
+from .streaming import IngestTicket, StreamingIngest, WireError  # noqa: F401

@@ -1,0 +1,78 @@
+"""Wire-payload decode + population assembly, shared by every ingest
+site: the fused K-generation single-transaction fetch, the overlapped
+streaming pipeline, and the sequential fallback with a deferred wire.
+
+These are the host halves of the codec seam (``narrow_wire`` on device,
+``widen_wire`` here) plus the log-space weight normalization every
+History append needs.  Keeping one copy means the overlapped-vs-
+sequential exactness guarantee is structural: both modes decode through
+the same functions in the same order.
+
+Imports from the sampler package are function-local — ``wire`` is a
+leaf package the sampler itself depends on (for the transfer ledger),
+so module-level imports here would cycle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SCALAR_KEYS = ("count", "rounds", "eps")
+
+
+def split_block_wire(wires: dict, K: int, n: int):
+    """Split a fetched K-generation stacked wire into per-generation
+    widened batches plus the scalar lanes.
+
+    Returns ``(gens, counts, rounds, eps_vals)`` where ``gens[k]`` is
+    the widened host batch of generation ``k`` (keys ``m``/``theta``/
+    ``distance``/``log_weight`` and optionally ``stats``, ``n`` rows)
+    and the other three are length-``K`` arrays (``eps_vals`` is None
+    when the wire carries no eps lane).
+    """
+    from ..sampler.base import widen_wire
+
+    counts = np.asarray(wires["count"]).reshape(K)
+    rounds = np.asarray(wires["rounds"]).reshape(K)
+    eps_vals = (np.asarray(wires["eps"], dtype=np.float64).reshape(K)
+                if "eps" in wires else None)
+    gens = [widen_wire({key: v[k] for key, v in wires.items()
+                        if key not in _SCALAR_KEYS}, n)
+            for k in range(K)]
+    return gens, counts, rounds, eps_vals
+
+
+def split_single_wire(out: dict, n: int):
+    """Decode a single-generation deferred wire (the per-generation
+    sampler's finalize payload) into the same shape as
+    :func:`split_block_wire` with ``K == 1``."""
+    from ..sampler.base import widen_wire
+
+    batch = widen_wire({key: v for key, v in out.items()
+                        if key not in _SCALAR_KEYS}, n)
+    counts = np.asarray([out["count"]]).reshape(1)
+    rounds = (np.asarray([out["rounds"]]).reshape(1)
+              if "rounds" in out else None)
+    return [batch], counts, rounds, None
+
+
+def batch_to_population(batch: dict):
+    """Normalize the shift-encoded log weights and build a
+    :class:`~pyabc_tpu.population.Population`; returns ``None`` when the
+    weights are degenerate (all -inf / NaN — callers fall back or fail
+    loudly, matching the pre-wire fused-block behavior)."""
+    from ..population import Population
+
+    lw = np.asarray(batch["log_weight"], dtype=np.float64)
+    lw = lw - lw.max()
+    w = np.exp(lw)
+    w_sum = w.sum()
+    if not (np.isfinite(w_sum) and w_sum > 0):
+        return None
+    return Population(
+        m=batch["m"], theta=batch["theta"],
+        weight=(w / w_sum).astype(np.float32),
+        distance=batch["distance"],
+        sum_stats=({"__flat__": batch["stats"]}
+                   if "stats" in batch else {}),
+    )

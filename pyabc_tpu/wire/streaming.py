@@ -1,0 +1,184 @@
+"""Asynchronous double-buffered device->host streaming ingest.
+
+The blocking shape of the pre-wire loop was
+
+    [compute gen t] -> [fetch gen t] -> [decode/append t] -> [compute t+1]
+
+with the fetch ~90% of north-star wall clock (BASELINE round 5).
+``StreamingIngest`` splits that seam: the orchestrator dispatches gen
+t+1's on-device compute immediately after gen t's accepted-population
+buffers are snapshotted (the device chain needs no host data), and a
+background worker drains gen t's d2h fetch + wire decode concurrently.
+Host-side effects that must stay ordered and thread-affine — sqlite
+``History.append_population`` (the connection is created with
+``check_same_thread=True``, storage/history.py) and stopping-criteria
+evaluation — run on the CALLER thread when the ticket is harvested, in
+strict generation order.
+
+Backpressure is a counting semaphore of size ``depth``: at most
+``depth`` tickets are in flight, so host+device memory for pending
+payloads stays O(depth x pop).  ``depth == 0`` degrades to synchronous
+inline execution on the caller thread — same calls, same order, which is
+what makes the overlapped-vs-inline exactness test meaningful.
+
+Fail fast: the first worker error latches the engine; it re-raises on
+that ticket's harvest AND on every later ``submit()``, so the ABCSMC
+loop surfaces a broken wire within one generation instead of silently
+dropping populations.
+
+Overlap accounting is per ticket: ``work_s`` is the worker-side
+fetch+decode time, ``wait_s`` is how long the caller actually blocked in
+``result()``; the difference (clamped at 0) is credited to the global
+``overlap_s`` counter (wire/transfer.py) — i.e. fetch seconds hidden
+behind compute.  The credit is intentionally approximate in the rare
+case where the caller blocks in ``submit()`` backpressure instead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from . import transfer
+
+
+class WireError(RuntimeError):
+    """A streaming-ingest stage failed; the original exception is
+    chained as ``__cause__``."""
+
+
+class IngestTicket:
+    """Handle for one in-flight fetch+decode unit (one block of
+    generations).  ``result()`` blocks until the worker finishes,
+    credits the overlap ledger once, releases the engine's depth slot,
+    and returns the payload (or re-raises the worker's exception)."""
+
+    __slots__ = ("label", "work_s", "wait_s", "_event", "_value",
+                 "_error", "_engine", "_settled")
+
+    def __init__(self, engine, label: str = ""):
+        self.label = label
+        self.work_s = 0.0
+        self.wait_s = 0.0
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self._engine = engine
+        self._settled = False
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _settle(self):
+        if not self._settled:
+            self._settled = True
+            transfer.record_overlap(max(0.0, self.work_s - self.wait_s))
+            self._engine._release(self)
+
+    def result(self, timeout: float = None):
+        t0 = time.perf_counter()
+        if not self._event.wait(timeout):
+            raise WireError(f"ingest ticket timed out: {self.label}")
+        self.wait_s += time.perf_counter() - t0
+        self._settle()
+        if self._error is not None:
+            raise WireError(
+                f"ingest failed for {self.label}: {self._error!r}"
+            ) from self._error
+        return self._value
+
+    def abandon(self):
+        """Discard a speculative ticket (a stop was detected behind it):
+        wait for the worker (the fetch cannot be un-run), swallow any
+        error, free the depth slot, drop the payload."""
+        self._event.wait()
+        self._settle()
+        self._value = None
+
+
+class StreamingIngest:
+    """Bounded-depth background executor for wire fetch+decode units.
+
+    ``submit(fn, label)`` returns an :class:`IngestTicket`; ``fn`` runs
+    on a worker thread (or inline when ``depth == 0``).  Tickets must be
+    harvested (``result()``) or ``abandon()``-ed; ``close()`` tears the
+    pool down and ``drain()`` abandons everything still in flight.
+    """
+
+    def __init__(self, depth: int = 2):
+        self.depth = int(depth)
+        self._pool = None
+        self._sem = (threading.Semaphore(self.depth)
+                     if self.depth > 0 else None)
+        self._failed = None          # first worker exception (latched)
+        self._outstanding = []
+        self._lock = threading.Lock()
+
+    # -- internals ----------------------------------------------------
+    def _release(self, ticket):
+        with self._lock:
+            if ticket in self._outstanding:
+                self._outstanding.remove(ticket)
+        if self._sem is not None:
+            self._sem.release()
+
+    def _run(self, ticket, fn):
+        t0 = time.perf_counter()
+        try:
+            ticket._value = fn()
+        except BaseException as err:  # latched + re-raised on harvest
+            ticket._error = err
+            with self._lock:
+                if self._failed is None:
+                    self._failed = err
+        finally:
+            ticket.work_s = time.perf_counter() - t0
+            ticket._event.set()
+
+    # -- API ----------------------------------------------------------
+    def submit(self, fn, label: str = "") -> IngestTicket:
+        """Queue ``fn`` (no-arg callable returning the decoded payload).
+        Blocks when ``depth`` tickets are already in flight — that wait
+        is the backpressure bound, measured into the returned ticket's
+        ``wait_s`` so it is never miscredited as overlap."""
+        if self._failed is not None:
+            raise WireError(
+                f"streaming ingest already failed: {self._failed!r}"
+            ) from self._failed
+        ticket = IngestTicket(self, label)
+        if self._sem is not None:
+            t0 = time.perf_counter()
+            self._sem.acquire()
+            ticket.wait_s += time.perf_counter() - t0
+        with self._lock:
+            self._outstanding.append(ticket)
+        if self.depth <= 0:
+            self._run(ticket, fn)       # synchronous inline mode
+        else:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.depth,
+                    thread_name_prefix="wire-ingest")
+            self._pool.submit(self._run, ticket, fn)
+        return ticket
+
+    def drain(self):
+        """Abandon every outstanding ticket (stop/teardown path)."""
+        with self._lock:
+            pending = list(self._outstanding)
+        for ticket in pending:
+            ticket.abandon()
+
+    def close(self):
+        self.drain()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

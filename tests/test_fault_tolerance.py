@@ -216,6 +216,52 @@ def test_calibration_survives_flaky_model(db_path):
     assert np.isfinite(pops[pops.t >= 1].epsilon).all()
 
 
+def test_wire_fetch_failure_surfaces_within_one_generation(db_path,
+                                                           monkeypatch):
+    """Overlapped ingest (pyabc_tpu/wire/): a d2h fetch dying on a
+    background worker must latch the engine and abort the run at the
+    very next harvest — within one generation — instead of hanging or
+    writing rows out of order.  The DB stays loadable and the run
+    completes after a sequential-mode resume (relay brownout recovery)."""
+    import pyabc_tpu.sampler.base as sampler_base
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.wire import WireError
+
+    real_fetch = sampler_base.fetch_to_host
+    calls = {"n": 0}
+
+    def dying_fetch(tree):
+        calls["n"] += 1
+        if calls["n"] >= 2:  # first wire fetch ok, second dies
+            raise ConnectionResetError("relay died")
+        return real_fetch(tree)
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=256,
+                    sampler=pt.VectorizedSampler(), seed=5,
+                    ingest_mode="overlap", ingest_depth=2)
+    abc.new(db_path, observed)
+    monkeypatch.setattr(sampler_base, "fetch_to_host", dying_fetch)
+    with pytest.raises(WireError, match="relay died"):
+        abc.run(max_nr_populations=6)
+    monkeypatch.setattr(sampler_base, "fetch_to_host", real_fetch)
+    # fail-fast bound: at most ingest_depth generations could have been
+    # harvested after the failing fetch was submitted
+    t_failed = abc.history.max_t
+    assert t_failed <= 2
+    # History rows written before the failure are contiguous and intact
+    for t in range(t_failed + 1):
+        pop = abc.history.get_population(t=t)
+        assert np.isclose(np.asarray(pop.weight).sum(), 1.0, atol=1e-5)
+    # elastic recovery: resume the SAME db sequentially to completion
+    abc2 = pt.ABCSMC(models, priors, distance, population_size=256,
+                     sampler=pt.VectorizedSampler(), seed=6,
+                     ingest_mode="sequential")
+    abc2.load(db_path)
+    abc2.run(max_nr_populations=2)
+    assert abc2.history.max_t >= t_failed + 1
+
+
 def test_calibration_aborts_when_model_always_fails(db_path):
     """A model failing on EVERY draw aborts with SamplingError instead of
     hanging in an infinite top-up loop."""

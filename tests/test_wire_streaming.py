@@ -1,0 +1,303 @@
+"""Streaming ingest (pyabc_tpu/wire/): ordering/exactness, backpressure
+depth, overlap accounting, and fail-fast error propagation.
+
+The pipeline (smc.py _run_pipelined) must be a pure LATENCY optimization:
+the ingest depth changes only when work happens, never what is computed.
+These tests pin that contract — depth-2 (overlapped) and depth-0
+(sequential inline ingest) runs of the same configuration produce
+byte-identical History rows — plus the StreamingIngest engine semantics:
+a bounded semaphore that releases slots at HARVEST time (not worker
+completion, so host memory stays O(depth x pop)) and a first-error latch
+that surfaces a broken wire within one generation.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+from pyabc_tpu.wire import StreamingIngest, WireError
+
+
+# ---------------------------------------------------------------------------
+# engine unit tests
+# ---------------------------------------------------------------------------
+
+def test_submit_result_ordering():
+    """Tickets resolve to their own submission's value regardless of
+    worker completion order (slow first job, fast second)."""
+    with StreamingIngest(depth=2) as eng:
+        t1 = eng.submit(lambda: (time.sleep(0.1), "first")[1], label="g0")
+        t2 = eng.submit(lambda: "second", label="g1")
+        # harvest in submission order — the SMC loop's append order
+        assert t1.result(timeout=5.0) == "first"
+        assert t2.result(timeout=5.0) == "second"
+        assert t1.work_s >= 0.1
+
+
+def test_backpressure_blocks_submit_until_harvest():
+    """depth=1: the slot frees at ticket.result() (harvest), NOT when the
+    worker finishes — the caller of the second submit() blocks until a
+    concurrent harvester drains the first ticket."""
+    with StreamingIngest(depth=1) as eng:
+        t1 = eng.submit(lambda: "a", label="g0")
+        time.sleep(0.05)  # worker for t1 has long finished
+        harvested = {}
+
+        def harvest():
+            harvested["v"] = t1.result(timeout=5.0)
+
+        threading.Timer(0.3, harvest).start()
+        start = time.perf_counter()
+        t2 = eng.submit(lambda: "b", label="g1")  # blocks ~0.3s
+        blocked = time.perf_counter() - start
+        assert blocked >= 0.2, f"submit returned after {blocked:.3f}s"
+        assert t2.wait_s >= 0.2  # backpressure charged to the ticket
+        assert harvested["v"] == "a"
+        assert t2.result(timeout=5.0) == "b"
+
+
+def test_depth_two_admits_two_without_blocking():
+    with StreamingIngest(depth=2) as eng:
+        start = time.perf_counter()
+        t1 = eng.submit(lambda: 1, label="g0")
+        t2 = eng.submit(lambda: 2, label="g1")
+        assert time.perf_counter() - start < 0.1
+        assert [t1.result(5.0), t2.result(5.0)] == [1, 2]
+
+
+def test_depth_zero_runs_inline():
+    """depth=0 disables the executor entirely: submit() runs the job on
+    the caller thread and the ticket is already done."""
+    eng = StreamingIngest(depth=0)
+    seen = []
+    t = eng.submit(lambda: seen.append(threading.get_ident()) or 7,
+                   label="g0")
+    assert t.done() and t.result() == 7
+    assert seen == [threading.get_ident()]
+    eng.close()
+
+
+def test_worker_error_latches_engine():
+    """First worker error re-raises as WireError at that ticket's harvest
+    AND poisons every later submit — fail-fast within one generation."""
+    with StreamingIngest(depth=2) as eng:
+        t1 = eng.submit(lambda: 1 / 0, label="g0")
+        with pytest.raises(WireError, match="g0"):
+            t1.result(timeout=5.0)
+        with pytest.raises(WireError):
+            eng.submit(lambda: "never runs", label="g1")
+
+
+def test_abandon_swallows_error_and_frees_slot():
+    """abandon() (speculative-block discard) waits the worker out,
+    swallows its error and releases the slot for the next submit."""
+    with StreamingIngest(depth=1) as eng:
+        t1 = eng.submit(lambda: 1 / 0, label="g0")
+        t1.abandon()
+        eng._failed = None  # rewind_to_frontier clears the latch too
+        t2 = eng.submit(lambda: "ok", label="g1")  # slot is free again
+        assert t2.result(timeout=5.0) == "ok"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: ingest depth must not change results
+# ---------------------------------------------------------------------------
+
+def _history_rows(abc):
+    rows = {}
+    for t in range(abc.history.max_t + 1):
+        pop = abc.history.get_population(t=t)
+        rows[t] = (np.asarray(pop.theta), np.asarray(pop.weight),
+                   np.asarray(pop.m), np.asarray(pop.distance))
+    return rows
+
+
+def _run_overlap(depth, pop=1000, gens=4, **kw):
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    sampler=pt.VectorizedSampler(), seed=3,
+                    ingest_mode="overlap", ingest_depth=depth, **kw)
+    abc.new("sqlite://", observed)
+    abc.run(max_nr_populations=gens)
+    return abc
+
+
+@pytest.mark.slow
+def test_overlapped_vs_sequential_ingest_identical_rows():
+    """The ISSUE's exactness contract at pop=1e3: overlapped (depth=2)
+    and sequential (depth=0 inline) ingest of the SAME pipeline produce
+    byte-identical History rows for every generation."""
+    a = _run_overlap(depth=2)
+    b = _run_overlap(depth=0)
+    assert a.history.max_t == b.history.max_t == 3
+    ra, rb = _history_rows(a), _history_rows(b)
+    for t in ra:
+        for xa, xb in zip(ra[t], rb[t]):
+            np.testing.assert_array_equal(xa, xb)
+    pa = a.history.get_all_populations()
+    pb = b.history.get_all_populations()
+    np.testing.assert_array_equal(pa.epsilon.to_numpy(),
+                                  pb.epsilon.to_numpy())
+
+
+def test_depth_invariance_small():
+    """Fast (non-slow) depth-invariance guard at pop=300 / 3 gens."""
+    a = _run_overlap(depth=2, pop=300, gens=3)
+    b = _run_overlap(depth=0, pop=300, gens=3)
+    ra, rb = _history_rows(a), _history_rows(b)
+    assert ra.keys() == rb.keys()
+    for t in ra:
+        for xa, xb in zip(ra[t], rb[t]):
+            np.testing.assert_array_equal(xa, xb)
+
+
+def test_overlap_with_fused_blocks_depth_invariant():
+    """Pipelined K>1 blocks (fused engine inside the wire pipeline):
+    still byte-identical across ingest depths."""
+    kw = dict(fuse_generations=2, eps=pt.QuantileEpsilon(alpha=0.5))
+    a = _run_overlap(depth=2, pop=300, gens=4, **kw)
+    b = _run_overlap(depth=0, pop=300, gens=4, **kw)
+    ra, rb = _history_rows(a), _history_rows(b)
+    assert ra.keys() == rb.keys()
+    for t in ra:
+        for xa, xb in zip(ra[t], rb[t]):
+            np.testing.assert_array_equal(xa, xb)
+
+
+def test_overlap_posterior_matches_sequential_mode():
+    """Overlapped mode is statistically identical to the classic
+    sequential path (different rate-adaptation trajectory, same target):
+    posterior means agree to sampling error and eps anneals alike."""
+    ov = _run_overlap(depth=2, pop=800, gens=4)
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    seq = pt.ABCSMC(models, priors, distance, population_size=800,
+                    sampler=pt.VectorizedSampler(), seed=3,
+                    ingest_mode="sequential")
+    seq.new("sqlite://", observed)
+    seq.run(max_nr_populations=4)
+
+    def post_mean(abc):
+        pop = abc.history.get_population()
+        th = np.asarray(pop.theta)[:, 0]
+        w = np.asarray(pop.weight)
+        return float((th * w).sum() / w.sum())
+
+    assert abs(post_mean(ov) - post_mean(seq)) < 0.15
+    e_ov = ov.history.get_all_populations().epsilon.to_numpy()[-1]
+    e_sq = seq.history.get_all_populations().epsilon.to_numpy()[-1]
+    assert abs(e_ov - e_sq) / max(e_sq, 1e-9) < 0.5
+
+
+def test_sequential_mode_routes_classic_loop():
+    """ingest_mode='sequential' and the small-pop 'auto' default both
+    take the untouched classic loop — byte-identical histories."""
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+
+    def run(mode):
+        abc = pt.ABCSMC(models, priors, distance, population_size=200,
+                        sampler=pt.VectorizedSampler(), seed=3,
+                        ingest_mode=mode)
+        assert not abc._overlap_enabled()
+        abc.new("sqlite://", observed)
+        abc.run(max_nr_populations=3)
+        return abc
+
+    ra, rb = _history_rows(run("sequential")), _history_rows(run("auto"))
+    assert ra.keys() == rb.keys()
+    for t in ra:
+        for xa, xb in zip(ra[t], rb[t]):
+            np.testing.assert_array_equal(xa, xb)
+
+
+def test_overlap_records_transfer_overlap():
+    """The transfer ledger's new per-stage counters move: compute_s from
+    the pre-timer sync, overlap_s credit from harvests that waited less
+    than the worker worked, and the derived d2h throughput."""
+    from pyabc_tpu.wire import transfer
+    before = transfer.snapshot()
+    _run_overlap(depth=2, pop=300, gens=3)
+    after = transfer.delta(before)
+    assert after["compute_s"] > 0.0
+    assert after["fetch_s"] >= after["d2h_s"] - 1e-9
+    assert after["overlap_s"] >= 0.0
+    assert after["d2h_mb_per_s"] > 0.0
+    # the legacy import path aliases the same ledger
+    from pyabc_tpu.utils import transfer as legacy
+    assert legacy.snapshot() == transfer.snapshot()
+
+
+def test_invalid_ingest_mode_rejected():
+    models, priors, distance, _, _ = make_two_gaussians_problem()
+    with pytest.raises(ValueError, match="ingest_mode"):
+        pt.ABCSMC(models, priors, distance, population_size=100,
+                  ingest_mode="async")
+
+
+# ---------------------------------------------------------------------------
+# injected fetch failure: surfaces within one generation
+# ---------------------------------------------------------------------------
+
+def test_injected_fetch_failure_surfaces(monkeypatch, db_path):
+    """A d2h fetch that dies mid-pipeline must abort the run with a
+    WireError within one generation — not hang, not write partial rows —
+    and leave the DB loadable.
+
+    The patch targets sampler.base.fetch_to_host, which _run_pipelined
+    binds at call time for its wire closures; the VectorizedSampler's own
+    module-level binding is untouched, so device compute + scalar fetches
+    keep working and ONLY the wire path breaks (a relay d2h brownout).
+    """
+    import pyabc_tpu.sampler.base as sampler_base
+
+    real_fetch = sampler_base.fetch_to_host
+    calls = {"n": 0}
+
+    def flaky_fetch(tree):
+        calls["n"] += 1
+        if calls["n"] > 2:  # let calibration through, then cut the wire
+            raise OSError("relay d2h brownout")
+        return real_fetch(tree)
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=300,
+                    sampler=pt.VectorizedSampler(), seed=3,
+                    ingest_mode="overlap", ingest_depth=2)
+    abc.new(db_path, observed)
+    monkeypatch.setattr(sampler_base, "fetch_to_host", flaky_fetch)
+    with pytest.raises(WireError, match="brownout"):
+        abc.run(max_nr_populations=5)
+    monkeypatch.setattr(sampler_base, "fetch_to_host", real_fetch)
+    # bounded damage: at most the generations fully harvested before the
+    # failure are in the DB, and it remains loadable + resumable
+    abc2 = pt.ABCSMC(models, priors, distance, population_size=300,
+                     sampler=pt.VectorizedSampler(), seed=4,
+                     ingest_mode="sequential")
+    abc2.load(db_path)
+    t_before = abc2.history.max_t
+    abc2.run(max_nr_populations=2)
+    assert abc2.history.max_t >= t_before + 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: conservative params_time_invariant
+# ---------------------------------------------------------------------------
+
+def test_params_time_invariant_conservative():
+    """Library distances declare invariance explicitly; a user subclass
+    overriding get_params is assumed time-VARIANT (it may return anything
+    per t) and must keep the fused/pipelined engines off."""
+    assert pt.PNormDistance(p=2).params_time_invariant()
+    assert not pt.AdaptivePNormDistance().params_time_invariant()
+    adp = pt.AdaptivePNormDistance()
+    adp.adaptive = False
+    assert adp.params_time_invariant()
+
+    class UserDistance(pt.PNormDistance):
+        def get_params(self, t):
+            return {"w": np.ones(1) * t}  # silently time-variant
+
+    assert not UserDistance(p=2).params_time_invariant()

@@ -121,6 +121,14 @@ def main():
         seed=0)
     abc.new("sqlite://", observed)
 
+    res["ingest_overlap_enabled"] = abc._overlap_enabled()
+
+    # NOTE: at this population ingest_mode="auto" routes the overlapped
+    # wire pipeline (pyabc_tpu/wire/), where accepted populations travel
+    # as pending wires through StreamingIngest tickets and
+    # Sample.append_device_batch never runs — the marks below then stay
+    # empty and the per-stage split comes from generation_transfer
+    # (compute_s / fetch_s / overlap_s) dumped at the end instead.
     marks = []
     orig_adb = sampler_base.Sample.append_device_batch
 
@@ -178,6 +186,17 @@ def main():
     res["gen2_nonsampling_s"] = round(
         res["gen2_total_s"] - tmarks.get("sample_until_n_accepted_s", 0), 2)
     res["device_get_marks"] = get_marks
+
+    # per-generation wall + transfer/overlap split from the orchestrator's
+    # ledger marks — in overlapped mode this is the authoritative stage
+    # decomposition (compute_s = device wait before the d2h timer,
+    # fetch_s = wire seconds, overlap_s = fetch hidden behind compute)
+    res["generation_wall_clock_s"] = {
+        t: round(v, 3) for t, v in sorted(abc.generation_wall_clock.items())}
+    res["generation_transfer"] = {
+        t: {k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in tr.items()}
+        for t, tr in sorted(abc.generation_transfer.items())}
 
     print(json.dumps(res, indent=1))
 
