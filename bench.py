@@ -62,6 +62,12 @@ the NON-overlapped wall share (d2h seconds minus the slice the wire/
 streaming ingest hid behind compute); on sequential-ingest rows
 overlap is 0 and the field means what it always did — see
 docs/performance.md for the d2h_s caveat on compute-bound rows.
+
+The primary row additionally emits the telemetry view of its run
+(``telemetry_*``, docs/observability.md): the per-generation
+GenerationTimeline rows and the full metrics-registry ``to_dict()`` on
+the FULL line, and the timeline's scalar medians (wall/compute/fetch/
+decode/overlap-fraction) on the compact line.
 """
 
 from __future__ import annotations
@@ -180,7 +186,18 @@ def bench_primary():
     # steady block
     rate, _, times, evals_ps, transfer = _timed_generations(
         abc, POP, 9, 8)
-    return rate, times, evals_ps, transfer
+    # the telemetry view of the same run: per-generation stage rows +
+    # the whole registry (sampler counters + wire ledger).  Medians from
+    # timeline.summary() are scalars, so they survive into the compact
+    # line; the row list and registry dict ride the full line only.
+    from pyabc_tpu.telemetry import REGISTRY
+    telemetry = {
+        "telemetry_timeline_rows": abc.timeline.to_rows(),
+        "telemetry_registry": REGISTRY.to_dict(),
+        **{f"telemetry_{k}": v
+           for k, v in abc.timeline.summary().items()},
+    }
+    return rate, times, evals_ps, transfer, telemetry
 
 
 def bench_northstar():
@@ -456,10 +473,12 @@ def main():
     _enable_compilation_cache()
 
     _log("bench: primary (pop16384 gaussian mixture)")
-    rate, primary_times, primary_evals_ps, primary_tr = bench_primary()
+    (rate, primary_times, primary_evals_ps, primary_tr,
+     primary_telemetry) = bench_primary()
     extra["primary_gen_times_s"] = primary_times
     extra["primary_evals_per_sec"] = round(primary_evals_ps, 1)
     extra.update({f"primary_{k}": v for k, v in primary_tr.items()})
+    extra.update(primary_telemetry)
 
     # each sub-bench runs in its OWN process: a TPU-runtime crash in one
     # (e.g. a watchdog kill) must not poison the others or the primary line
@@ -515,7 +534,7 @@ def main():
     # what made the full line huge — restricted to the headline prefixes.
     compact = {k: v for k, v in sorted(extra.items())
                if k.startswith(("primary_", "northstar_",
-                                "posterior_gate_"))
+                                "posterior_gate_", "telemetry_"))
                and not isinstance(v, (list, dict))}
     print(json.dumps({**header, "extra": compact}))
 

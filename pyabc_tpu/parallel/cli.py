@@ -90,12 +90,46 @@ def info(run_dir):
             state = "alive" if e["alive"] else "STALE"
             click.echo(f"  {e['host']}:{e['pid']} "
                        f"proc={e['process_index']} {state}")
+            m = e.get("metrics") or {}
+            if m:
+                evals = m.get("evaluations", 0)
+                uptime = max(m.get("uptime_s", 0.0), 1e-9)
+                click.echo(
+                    f"    gens={m.get('generations', 0)} "
+                    f"evals={evals} "
+                    f"({evals / uptime:.1f}/s) "
+                    f"acc_rate={m.get('acceptance_rate', 0.0):.4g} "
+                    f"d2h={m.get('d2h_mb', 0.0):.2f}MB"
+                    f"@{m.get('d2h_mb_per_s', 0.0):.2f}MB/s "
+                    f"overlap_s={m.get('overlap_s', 0.0):.2f} "
+                    f"rewinds={m.get('rewinds', 0)}")
         return
     import jax
 
     click.echo(f"process {jax.process_index()}/{jax.process_count()}")
     click.echo(f"local devices: {jax.local_devices()}")
     click.echo(f"global devices: {len(jax.devices())}")
+
+
+@manage.command()
+@click.option("--run-dir", default=None,
+              help="shared run dir — export every worker's heartbeat "
+                   "metrics; omit for this process's own registry")
+def metrics(run_dir):
+    """Prometheus text exposition of the telemetry registry: with
+    --run-dir, one ``pyabc_tpu_worker_*`` sample per worker heartbeat
+    metric (labeled by host/pid); without, this process's own registry —
+    scrape-ready either way."""
+    if run_dir:
+        from . import health
+        from ..telemetry.metrics import render_worker_prometheus
+
+        click.echo(render_worker_prometheus(
+            health.worker_status(run_dir)), nl=False)
+        return
+    from ..telemetry.metrics import REGISTRY
+
+    click.echo(REGISTRY.render_prometheus(), nl=False)
 
 
 @manage.command()

@@ -48,10 +48,18 @@ class Heartbeat:
     """
 
     def __init__(self, directory: str, interval_s: float = 5.0,
-                 process_index: Optional[int] = None):
+                 process_index: Optional[int] = None,
+                 metrics_fn: Optional[callable] = None):
         self.directory = directory
         self.interval_s = interval_s
         self.process_index = process_index
+        #: zero-arg callable returning a flat scalar dict embedded in
+        #: every heartbeat, so ``info`` shows per-host throughput, not
+        #: just liveness; defaults to the telemetry summary
+        if metrics_fn is None:
+            from ..telemetry.metrics import heartbeat_summary
+            metrics_fn = heartbeat_summary
+        self.metrics_fn = metrics_fn
         self.path = os.path.join(
             directory, f"{_HB_PREFIX}{socket.gethostname()}_{os.getpid()}"
                        ".json")
@@ -66,6 +74,10 @@ class Heartbeat:
             "process_index": self.process_index,
             "ts": time.time(),
         }
+        try:
+            payload["metrics"] = self.metrics_fn()
+        except Exception:  # metrics must never kill the liveness signal
+            payload["metrics"] = {}
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)

@@ -110,13 +110,15 @@ def fetch_to_host(tree):
 
     import jax
 
-    from ..utils import transfer
+    from ..telemetry import spans
+    from ..wire import transfer
 
     t0 = _time.perf_counter()
-    try:
-        jax.block_until_ready(tree)
-    except Exception:
-        pass  # non-array leaves / exotic backends: timer split advisory
+    with spans.span("wire.sync"):
+        try:
+            jax.block_until_ready(tree)
+        except Exception:
+            pass  # non-array leaves / exotic backends: split advisory
     transfer.record_compute(_time.perf_counter() - t0)
 
     def get(leaf):
@@ -128,9 +130,11 @@ def fetch_to_host(tree):
         return np.asarray(multihost_utils.process_allgather(leaf,
                                                             tiled=True))
     import jax.tree_util as tu
-    with transfer.timed_d2h() as timer:
+    with spans.span("wire.fetch") as sp, transfer.timed_d2h() as timer:
         out = jax.device_get(tu.tree_map(get, tree))
-    return timer.commit(out)
+    out = timer.commit(out)
+    sp.set(nbytes=transfer._tree_nbytes(out))
+    return out
 
 
 def widen_wire(out: dict, take: int) -> dict:
@@ -138,20 +142,30 @@ def widen_wire(out: dict, take: int) -> dict:
     bit-unpack the model column, multiply the per-column power-of-two
     scales back in, widen f16 to f32, truncate to ``take`` rows.
     Returns numpy ``m``/``theta``/``distance``/``log_weight``
-    (/``stats`` when it rode the wire)."""
-    if "m_bits" in out:
-        # unpackbits may carry up to 7 zero-pad tail bits
-        m = np.unpackbits(np.asarray(out["m_bits"]))[:take]
-    else:
-        m = np.asarray(out["m"][:take])
-    batch = {"m": m.astype(np.int32)}
-    for k in ("theta", "distance", "log_weight", "stats"):
-        if k not in out:
-            continue
-        v = np.asarray(out[k][:take], dtype=np.float32)
-        scale = out.get(f"{k}_scale")  # per-column [d] or scalar
-        batch[k] = (v * np.asarray(scale, dtype=np.float32)
-                    if scale is not None else v)
+    (/``stats`` when it rode the wire).  Charged to the wire ledger's
+    ``decode_s`` — decode is the third stage of the ingest path next to
+    ``compute_s``/``fetch_s``."""
+    import time as _time
+
+    from ..telemetry import spans
+    from ..wire import transfer
+
+    t0 = _time.perf_counter()
+    with spans.span("wire.decode", rows=int(take)):
+        if "m_bits" in out:
+            # unpackbits may carry up to 7 zero-pad tail bits
+            m = np.unpackbits(np.asarray(out["m_bits"]))[:take]
+        else:
+            m = np.asarray(out["m"][:take])
+        batch = {"m": m.astype(np.int32)}
+        for k in ("theta", "distance", "log_weight", "stats"):
+            if k not in out:
+                continue
+            v = np.asarray(out[k][:take], dtype=np.float32)
+            scale = out.get(f"{k}_scale")  # per-column [d] or scalar
+            batch[k] = (v * np.asarray(scale, dtype=np.float32)
+                        if scale is not None else v)
+    transfer.record_decode(_time.perf_counter() - t0)
     return batch
 
 

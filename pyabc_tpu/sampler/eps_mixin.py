@@ -23,7 +23,7 @@ import time
 import jax
 import numpy as np
 
-from .base import Sample
+from .base import Sample, fetch_to_host
 
 logger = logging.getLogger("ABC.Sampler")
 
@@ -92,7 +92,7 @@ class EPSMixin:
 
         def eval_batch(seed: int):
             k = jax.random.fold_in(key, seed)
-            return seed, jax.device_get(round_fn(
+            return seed, fetch_to_host(round_fn(
                 k, params, B, **({"all_accepted": True}
                                  if all_accepted else {})))
 

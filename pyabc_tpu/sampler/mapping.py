@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from .base import Sample, Sampler
+from .base import Sample, Sampler, fetch_to_host
 from .eps_mixin import EPSMixin
 
 logger = logging.getLogger("ABC.Sampler")
@@ -56,13 +56,14 @@ class MappingSampler(Sampler):
             k = jax.random.fold_in(key, seed)
             rr = round_fn(k, params, 1, **(
                 {"all_accepted": True} if all_accepted else {}))
-            return jax.device_get(rr)
+            return fetch_to_host(rr)
 
         seed = 0
         while sample.n_accepted < n:
             seeds = list(range(seed, seed + wave))
             seed += wave
-            # device_get preserves the RoundResult pytree with numpy leaves
+            # fetch_to_host preserves the RoundResult pytree with numpy
+        # leaves and books the transfer on the wire ledger
             for rr in self.map_(eval_one, seeds):
                 sample.append_round(rr)
             if sample.nr_evaluations >= max_eval and sample.n_accepted < n:

@@ -180,7 +180,7 @@ class VectorizedSampler(Sampler):
         # pin them on device ONCE — otherwise every step/finalize call
         # re-uploads the ~MBs of transition support (measured 0.43 s/call
         # at the 1e6 north star through the relay)
-        from ..utils import transfer
+        from ..wire import transfer
         transfer.record_h2d(sum(
             getattr(leaf, "nbytes", 0)
             for leaf in jax.tree_util.tree_leaves(params)

@@ -34,6 +34,8 @@ from .sampler.base import Sample, Sampler
 from .sampler.rounds import RoundKernel
 from .storage.history import PRE_TIME, History
 from .sumstat import SumStatSpec
+from .telemetry import GenerationTimeline, metrics as _metrics, \
+    profile_generation, spans as _spans
 from .transition import MultivariateNormalTransition, Transition
 from .weighted_statistics import effective_sample_size
 
@@ -101,6 +103,7 @@ class ABCSMC:
                  fuse_generations: int = 1,
                  ingest_mode: str = "auto",
                  ingest_depth: int = 2,
+                 trace_path: Optional[str] = None,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -171,9 +174,15 @@ class ABCSMC:
         #: even when durable writes are batched (fused multi-generation
         #: blocks report block/K per generation)
         self.generation_wall_clock: Dict[int, float] = {}
-        #: per-generation transfer-counter deltas (utils/transfer.py):
-        #: d2h_bytes / d2h_s / d2h_calls / h2d_bytes
+        #: per-generation transfer-counter deltas (wire/transfer.py):
+        #: d2h_bytes / d2h_s / d2h_calls / h2d_bytes / decode_s / ...
         self.generation_transfer: Dict[int, dict] = {}
+        #: Chrome-trace JSONL output path for the span tracer; None
+        #: defers to the PYABC_TPU_TRACE environment variable
+        self.trace_path = trace_path
+        #: per-generation stage-duration rows (telemetry/timeline.py),
+        #: fed by every run path at generation boundaries
+        self.timeline = GenerationTimeline()
 
         self._sanity_check()
 
@@ -572,7 +581,7 @@ class ABCSMC:
         import jax.numpy as jnp
 
         from .sampler.base import fetch_to_host
-        from .utils import transfer as _transfer
+        from .wire import transfer as _transfer
         from .wire.ingest import batch_to_population, split_block_wire
 
         carry = self._fused_carry
@@ -598,11 +607,15 @@ class ABCSMC:
             "eps": jnp.float32(self.eps(t) if eps_mode == "constant"
                                else 0.0),
         }
-        carry_out, wires = fn(carry_in, self._split())
+        with profile_generation(t), \
+                _spans.span("fused.dispatch", gen=t, k=K):
+            carry_out, wires = fn(carry_in, self._split())
+        dispatch_s = _time.perf_counter() - t0_block
         # ONE transaction for all K gens, split + widened through the
         # SHARED wire decoder (wire/ingest.py)
-        per_gen, counts, rounds, eps_vals = split_block_wire(
-            fetch_to_host(wires), K, n)
+        with _spans.span("fused.ingest", gen=t, k=K):
+            per_gen, counts, rounds, eps_vals = split_block_wire(
+                fetch_to_host(wires), K, n)
 
         # every executed generation's evaluations count against the
         # simulation budget — including any the ingest below discards
@@ -613,6 +626,8 @@ class ABCSMC:
         samp.nr_evaluations_ += sims_added
         written = 0
         stop_reason = None
+        append_s_total = 0.0
+        gen_meta = []  # (eps, accepted, evals, rounds) per written gen
         for k in range(K):
             t_k = t + k
             if t_k >= t_max:
@@ -635,10 +650,14 @@ class ABCSMC:
                      else float(eps_vals[k]))
             acc_rate = count_k / max(evals_k, 1)
             logger.info("t: %d, eps: %.8g (fused)", t_k, eps_k)
-            self.history.append_population(
-                t_k, eps_k, pop_k, evals_k,
-                [m.name for m in self.models], self._param_names(),
-                stat_spec=self.spec.shapes)
+            append_mark = _time.perf_counter()
+            with _spans.span("gen.append", gen=t_k):
+                self.history.append_population(
+                    t_k, eps_k, pop_k, evals_k,
+                    [m.name for m in self.models], self._param_names(),
+                    stat_spec=self.spec.shapes)
+            append_s_total += _time.perf_counter() - append_mark
+            gen_meta.append((eps_k, count_k, evals_k, int(rounds[k])))
             if eps_mode == "quantile":
                 self.eps._look_up[t_k] = eps_k
             logger.info(
@@ -668,6 +687,21 @@ class ABCSMC:
                 self.generation_wall_clock[t + k] = block_dt / written
                 self.generation_transfer[t + k] = {
                     key: v / written for key, v in tr_delta.items()}
+                eps_k, count_k, evals_k, rounds_k = gen_meta[k]
+                self.timeline.record(
+                    t + k, path="fused", wall_s=block_dt / written,
+                    stages={
+                        "dispatch": dispatch_s / written,
+                        "compute": tr_delta["compute_s"] / written,
+                        "fetch": tr_delta["fetch_s"] / written,
+                        "decode": tr_delta["decode_s"] / written,
+                        "append": append_s_total / written,
+                    },
+                    eps=eps_k, accepted=count_k, total=evals_k,
+                    overlap_s=tr_delta["overlap_s"] / written)
+                _metrics.record_generation(
+                    evals_k, count_k, count_k / max(evals_k, 1),
+                    rounds=rounds_k, wall_s=block_dt / written)
             last_pop = pop_k
             if stop_reason is None and t + written < t_max:
                 # keep the chain hot: device carry for the next block
@@ -724,7 +758,7 @@ class ABCSMC:
         from collections import deque
 
         from .sampler.base import fetch_to_host
-        from .utils import transfer as _transfer
+        from .wire import transfer as _transfer
         from .wire import StreamingIngest
         from .wire.ingest import (batch_to_population, split_block_wire,
                                   split_single_wire)
@@ -757,10 +791,16 @@ class ABCSMC:
 
         def rewind_to_frontier():
             """Abandon speculative blocks behind a stop/fallback."""
+            abandoned = 0
             while inflight:
                 blk = inflight.pop()
                 if blk["ticket"] is not None:
                     blk["ticket"].abandon()
+                abandoned += blk["K"]
+            if abandoned:
+                # speculative-discard waste, machine-visible (ledger
+                # `rewinds` + bench/heartbeat rows)
+                _transfer.record_rewind(abandoned)
             st["carry"] = None
             st["t_disp"] = st["t"]
 
@@ -784,13 +824,18 @@ class ABCSMC:
                 "eps": jnp.float32(self.eps(t_d)
                                    if eps_mode == "constant" else 0.0),
             }
-            carry_out, wires = fn(carry_in, self._split())
-            ticket = ingest.submit(
-                lambda: split_block_wire(fetch_to_host(wires), K, n),
-                label=f"block@t={t_d}")
+            disp_mark = _time.perf_counter()
+            with profile_generation(t_d), \
+                    _spans.span("pipeline.dispatch", gen=t_d, k=K):
+                carry_out, wires = fn(carry_in, self._split())
+                ticket = ingest.submit(
+                    lambda: split_block_wire(fetch_to_host(wires), K, n),
+                    label=f"block@t={t_d}")
             inflight.append({"kind": "block", "ticket": ticket,
                              "t0": t_d, "K": K, "B": B, "n": n,
-                             "carry_out": carry_out})
+                             "carry_out": carry_out,
+                             "dispatch_s": (_time.perf_counter()
+                                            - disp_mark)})
             st["carry"] = carry_out
             st["t_disp"] = t_d + K
             return True
@@ -829,9 +874,12 @@ class ABCSMC:
                         np.maximum(probs, 1e-300)).astype(np.float32)
                 params["transition"] = self._trans_params
             logger.info("t: %d, eps: %.8g", t, current_eps)
-            sample = samp.sample_until_n_accepted(
-                n, round_fn, self._split(), params, max_eval=max_eval,
-                defer_wire_fetch=True)
+            disp_mark = _time.perf_counter()
+            with profile_generation(t), _spans.span("gen.sample", gen=t):
+                sample = samp.sample_until_n_accepted(
+                    n, round_fn, self._split(), params, max_eval=max_eval,
+                    defer_wire_fetch=True)
+            dispatch_s = _time.perf_counter() - disp_mark
             if sample.n_accepted < n:
                 logger.info(
                     "Stopping: acceptance rate fell below "
@@ -848,7 +896,7 @@ class ABCSMC:
                      "n": n, "evals": sample.nr_evaluations,
                      "eps": current_eps,
                      "acc_rate": sample.acceptance_rate,
-                     "dp": st["carry"]}
+                     "dp": st["carry"], "dispatch_s": dispatch_s}
             wire_dev = sample.take_pending_wire()
             if wire_dev is not None:
                 entry["ticket"] = ingest.submit(
@@ -867,10 +915,13 @@ class ABCSMC:
         def harvest_one():
             blk = inflight.popleft()
             base_sims = st["total_sims"]
-            if blk["kind"] == "pop":
-                gens, counts, rounds = None, [blk["n"]], None
-            else:
-                gens, counts, rounds, eps_vals = blk["ticket"].result()
+            with _spans.span("pipeline.harvest", gen=blk["t0"],
+                             k=blk["K"]):
+                if blk["kind"] == "pop":
+                    gens, counts, rounds = None, [blk["n"]], None
+                else:
+                    gens, counts, rounds, eps_vals = \
+                        blk["ticket"].result()
             if blk["kind"] == "block":
                 # block sims count at harvest (abandoned speculative
                 # blocks never count); mirrored onto the sampler's
@@ -881,6 +932,8 @@ class ABCSMC:
             n, K = blk["n"], blk["K"]
             written = 0
             fallback = False
+            append_s_total = 0.0
+            gen_meta = []  # (eps, accepted, evals, rounds) per written
             for k in range(K):
                 t_k = blk["t0"] + k
                 count_k = int(counts[k])
@@ -914,10 +967,17 @@ class ABCSMC:
                     evals_k = blk["evals"]
                     eps_k = blk["eps"]
                     acc_rate = blk["acc_rate"]
-                self.history.append_population(
-                    t_k, eps_k, pop_k, evals_k,
-                    [m.name for m in self.models], self._param_names(),
-                    stat_spec=self.spec.shapes)
+                append_mark = _time.perf_counter()
+                with _spans.span("gen.append", gen=t_k):
+                    self.history.append_population(
+                        t_k, eps_k, pop_k, evals_k,
+                        [m.name for m in self.models],
+                        self._param_names(),
+                        stat_spec=self.spec.shapes)
+                append_s_total += _time.perf_counter() - append_mark
+                gen_meta.append(
+                    (eps_k, count_k, evals_k,
+                     int(rounds[k]) if blk["kind"] == "block" else None))
                 logger.info(
                     "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
                     t_k, acc_rate,
@@ -953,6 +1013,26 @@ class ABCSMC:
                         block_dt / written
                     self.generation_transfer[blk["t0"] + k] = {
                         key: v / written for key, v in tr_delta.items()}
+                    eps_k, count_k, evals_k, rounds_k = gen_meta[k]
+                    # stages here ran CONCURRENTLY with the caller wall
+                    # (that is the point of the pipeline), so `other`
+                    # clamps at zero and overlap_s carries attribution
+                    self.timeline.record(
+                        blk["t0"] + k, path="pipelined",
+                        wall_s=block_dt / written,
+                        stages={
+                            "dispatch": blk.get("dispatch_s",
+                                                0.0) / written,
+                            "compute": tr_delta["compute_s"] / written,
+                            "fetch": tr_delta["fetch_s"] / written,
+                            "decode": tr_delta["decode_s"] / written,
+                            "append": append_s_total / written,
+                        },
+                        eps=eps_k, accepted=count_k, total=evals_k,
+                        overlap_s=tr_delta["overlap_s"] / written)
+                    _metrics.record_generation(
+                        evals_k, count_k, count_k / max(evals_k, 1),
+                        rounds=rounds_k, wall_s=block_dt / written)
                 if blk["kind"] == "block":
                     st["last_dp"] = (dict(blk["carry_out"])
                                      if written == K else None)
@@ -1131,6 +1211,15 @@ class ABCSMC:
     # the master loop (reference smc.py:813-958)
     # ------------------------------------------------------------------
 
+    def _configure_telemetry(self):
+        """Arm the span tracer for this run: an explicit ``trace_path``
+        wins, else the ``PYABC_TPU_TRACE`` env var (no-op when neither
+        is set — the tracer stays a one-boolean-check no-op)."""
+        if self.trace_path:
+            _spans.TRACER.configure(trace_path=self.trace_path)
+        else:
+            _spans.TRACER.configure_from_env()
+
     def run(self,
             minimum_epsilon: float = 0.0,
             max_nr_populations: Union[int, float] = np.inf,
@@ -1138,17 +1227,37 @@ class ABCSMC:
             max_total_nr_simulations: Union[int, float] = np.inf) -> History:
         if self.history is None:
             raise RuntimeError("call new(db, observed) or load(db) first")
+        self._configure_telemetry()
+        # the run span covers EVERYTHING (calibration included) so trace
+        # coverage accounting has a well-defined denominator; flushed in
+        # the finally so a crashed run still leaves a loadable trace
+        run_span = _spans.span("run", path=self.ingest_mode)
+        try:
+            with run_span:
+                return self._run_master(
+                    minimum_epsilon, max_nr_populations,
+                    min_acceptance_rate, max_total_nr_simulations)
+        finally:
+            _spans.TRACER.flush()
+            if len(self.timeline):
+                logger.debug("generation timeline:\n%s",
+                             self.timeline.render_ascii())
+
+    def _run_master(self, minimum_epsilon, max_nr_populations,
+                    min_acceptance_rate,
+                    max_total_nr_simulations) -> History:
         self.minimum_epsilon = minimum_epsilon
         self.max_nr_populations = max_nr_populations
         self.min_acceptance_rate = min_acceptance_rate
 
         t0 = self.history.max_t + 1
-        self._fit_transitions(t0)
-        self._adapt_population_size(t0)
-        if t0 == 0:
-            self._calibrate(t0)
-        else:
-            self._initialize_from_history(t0)
+        with _spans.span("calibrate", gen=t0):
+            self._fit_transitions(t0)
+            self._adapt_population_size(t0)
+            if t0 == 0:
+                self._calibrate(t0)
+            else:
+                self._initialize_from_history(t0)
         # fresh feature requests each run: a previous run's eps/distance
         # must not leave stale record flags on a reused sampler
         self.sampler.record_rejected = False
@@ -1182,7 +1291,7 @@ class ABCSMC:
 
         import time as _time
 
-        from .utils import transfer as _transfer
+        from .wire import transfer as _transfer
 
         t = t0
         t_max = (t0 + max_nr_populations
@@ -1192,6 +1301,7 @@ class ABCSMC:
         # timestamp diffs the bench used through round 4)
         gen_mark = _time.perf_counter()
         tr_mark = _transfer.snapshot()
+        adapt_s = 0.0  # refit cost carried into the NEXT gen's row
         if self._overlap_enabled():
             # overlapped streaming ingest (wire/): gen t+1's device
             # compute runs while gen t's fetch + decode drain in the
@@ -1247,8 +1357,11 @@ class ABCSMC:
                 params["transition"] = self._trans_params
 
             logger.info("t: %d, eps: %.8g", t, current_eps)
-            sample = self.sampler.sample_until_n_accepted(
-                n, round_fn, self._split(), params, max_eval=max_eval)
+            sample_mark = _time.perf_counter()
+            with profile_generation(t), _spans.span("gen.sample", gen=t):
+                sample = self.sampler.sample_until_n_accepted(
+                    n, round_fn, self._split(), params, max_eval=max_eval)
+            sample_s = _time.perf_counter() - sample_mark
             if sample.n_accepted < n:
                 logger.info(
                     "Stopping: acceptance rate fell below min_acceptance_rate"
@@ -1260,15 +1373,36 @@ class ABCSMC:
             # rate is unbiased by the batch ladder's rounding
             acceptance_rate = sample.acceptance_rate
             ess = float(effective_sample_size(population.weight))
-            self.history.append_population(
-                t, current_eps, population, sample.nr_evaluations,
-                [m.name for m in self.models], self._param_names(),
-                stat_spec=self.spec.shapes)
+            append_mark = _time.perf_counter()
+            with _spans.span("gen.append", gen=t):
+                self.history.append_population(
+                    t, current_eps, population, sample.nr_evaluations,
+                    [m.name for m in self.models], self._param_names(),
+                    stat_spec=self.spec.shapes)
             now = _time.perf_counter()
+            append_s = now - append_mark
             self.generation_wall_clock[t] = now - gen_mark
             gen_mark = now
-            self.generation_transfer[t] = _transfer.delta(tr_mark)
+            tr_t = _transfer.delta(tr_mark)
+            self.generation_transfer[t] = tr_t
             tr_mark = _transfer.snapshot()
+            self.timeline.record(
+                t, path="sequential", wall_s=self.generation_wall_clock[t],
+                stages={
+                    "adapt": adapt_s,
+                    "dispatch": max(0.0, sample_s - tr_t["compute_s"]
+                                    - tr_t["fetch_s"] - tr_t["decode_s"]),
+                    "compute": tr_t["compute_s"],
+                    "fetch": tr_t["fetch_s"],
+                    "decode": tr_t["decode_s"],
+                    "append": append_s,
+                },
+                eps=current_eps, accepted=sample.raw_accepted,
+                total=sample.nr_evaluations,
+                overlap_s=tr_t["overlap_s"])
+            _metrics.record_generation(
+                sample.nr_evaluations, sample.raw_accepted,
+                acceptance_rate, wall_s=self.generation_wall_clock[t])
             if fused_ok:
                 # accepted buffers of THIS generation stay device-resident
                 # as the next fused block's carry
@@ -1300,8 +1434,11 @@ class ABCSMC:
             if t + 1 >= t_max:
                 break
 
-            self._prepare_next_iteration(
-                t + 1, sample, population, acceptance_rate)
+            adapt_mark = _time.perf_counter()
+            with _spans.span("gen.adapt", gen=t + 1):
+                self._prepare_next_iteration(
+                    t + 1, sample, population, acceptance_rate)
+            adapt_s = _time.perf_counter() - adapt_mark
             t += 1
 
         self.history.done()

@@ -1,0 +1,56 @@
+"""Unified observability for pyabc_tpu: span tracing, a typed metrics
+registry, and the per-generation run timeline.
+
+- :mod:`.spans` — Chrome-trace-emitting span tracer (``span("gen.sample",
+  gen=t)``), enabled by ``PYABC_TPU_TRACE`` or ``ABCSMC(trace_path=...)``.
+- :mod:`.metrics` — counter/gauge/histogram registry backing the wire
+  transfer ledger and the sampler counters; Prometheus-text export via
+  the ``abc-distributed-manager metrics`` CLI.
+- :mod:`.timeline` — :class:`~pyabc_tpu.telemetry.timeline.GenerationTimeline`
+  fed by the orchestrator at generation boundaries.
+- :func:`profile_generation` — optional ``jax.profiler`` hook for a
+  single generation (``PYABC_TPU_PROFILE_GEN=<t>``).
+
+See docs/observability.md for the operator guide.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from . import metrics, spans, timeline
+from .metrics import REGISTRY
+from .spans import TRACER, begin, end, span
+from .timeline import GenerationTimeline
+
+#: generation index to wrap in a device profiler trace (unset = off)
+PROFILE_GEN_ENV = "PYABC_TPU_PROFILE_GEN"
+#: where the profiler writes its trace directory
+PROFILE_DIR_ENV = "PYABC_TPU_PROFILE_DIR"
+
+
+@contextlib.contextmanager
+def profile_generation(t: int):
+    """Wrap generation ``t`` in a ``jax.profiler.trace`` when
+    ``PYABC_TPU_PROFILE_GEN`` names it; otherwise free (one env lookup).
+
+    The trace directory defaults to ``/tmp/pyabc_tpu_profile`` and is
+    overridable via ``PYABC_TPU_PROFILE_DIR``; view with TensorBoard's
+    profile plugin or ``xprof``.
+    """
+    want = os.environ.get(PROFILE_GEN_ENV)
+    if want is None or str(t) != want:
+        yield
+        return
+    import jax
+
+    log_dir = os.environ.get(PROFILE_DIR_ENV, "/tmp/pyabc_tpu_profile")
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+__all__ = [
+    "GenerationTimeline", "REGISTRY", "TRACER", "begin", "end",
+    "metrics", "profile_generation", "span", "spans", "timeline",
+]

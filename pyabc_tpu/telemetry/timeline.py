@@ -1,0 +1,118 @@
+"""Per-run generation timeline: where each generation's wall time went.
+
+The orchestrator calls :meth:`GenerationTimeline.record` once per
+completed generation (any run path) with the stage durations it
+measured.  The named stages are the pipeline's physical phases —
+``adapt`` (epsilon/transition refit), ``dispatch`` (host-side argument
+staging + XLA call launch), ``compute`` (device busy, from the wire
+ledger), ``fetch`` (d2h), ``decode`` (widen + weight normalization),
+``append`` (History write).  Whatever the named stages don't cover
+lands in ``other`` so stage-sum == wall by construction; in the
+overlapped paths stages run concurrently with the caller's wall, so
+``other`` is clamped at zero and the ``overlap_s`` column carries the
+attribution instead.
+
+Renders two ways: :meth:`render_ascii` for logs, :meth:`to_rows` for
+bench JSON (plus :meth:`summary` medians for the compact line).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+STAGES = ("adapt", "dispatch", "compute", "fetch", "decode", "append")
+
+
+class GenerationTimeline:
+    """Bounded list of per-generation stage-duration rows."""
+
+    def __init__(self, max_rows: int = 4096):
+        self._rows: list = []
+        self._max_rows = max_rows
+        self._lock = threading.Lock()
+
+    def record(self, t: int, *, path: str, wall_s: float,
+               stages: Optional[dict] = None, eps: Optional[float] = None,
+               accepted: Optional[int] = None, total: Optional[int] = None,
+               overlap_s: float = 0.0):
+        """Add one generation's row.  ``stages`` maps a subset of
+        :data:`STAGES` to seconds; unknown keys raise so a typo can't
+        silently vanish from the table."""
+        stages = dict(stages or {})
+        unknown = set(stages) - set(STAGES)
+        if unknown:
+            raise KeyError(f"unknown timeline stages: {sorted(unknown)}")
+        row = {"gen": int(t), "path": path, "wall_s": round(wall_s, 6)}
+        named = 0.0
+        for s in STAGES:
+            v = float(stages.get(s, 0.0))
+            row[s + "_s"] = round(v, 6)
+            named += v
+        row["other_s"] = round(max(0.0, wall_s - named), 6)
+        row["overlap_s"] = round(overlap_s, 6)
+        row["overlap_frac"] = (round(overlap_s / wall_s, 4)
+                               if wall_s > 1e-9 else 0.0)
+        row["eps"] = None if eps is None else float(eps)
+        row["accepted"] = None if accepted is None else int(accepted)
+        row["total"] = None if total is None else int(total)
+        with self._lock:
+            if len(self._rows) < self._max_rows:
+                self._rows.append(row)
+
+    def to_rows(self) -> list:
+        with self._lock:
+            return [dict(r) for r in self._rows]
+
+    def clear(self):
+        with self._lock:
+            self._rows = []
+
+    def __len__(self):
+        with self._lock:
+            return len(self._rows)
+
+    def summary(self) -> dict:
+        """Medians across generations — the compact-bench-line scalars."""
+        rows = self.to_rows()
+        if not rows:
+            return {}
+
+        def med(key):
+            vals = sorted(r[key] for r in rows)
+            n = len(vals)
+            mid = vals[n // 2] if n % 2 else (vals[n // 2 - 1]
+                                              + vals[n // 2]) / 2
+            return round(mid, 6)
+
+        return {
+            "generations": len(rows),
+            "wall_s_med": med("wall_s"),
+            "compute_s_med": med("compute_s"),
+            "fetch_s_med": med("fetch_s"),
+            "decode_s_med": med("decode_s"),
+            "overlap_frac_med": med("overlap_frac"),
+        }
+
+    def render_ascii(self) -> str:
+        """Fixed-width table for logs; one line per generation."""
+        rows = self.to_rows()
+        if not rows:
+            return "(timeline: no generations recorded)"
+        cols = (["gen", "path", "wall_s"] + [s + "_s" for s in STAGES]
+                + ["other_s", "overlap_s", "eps", "acc/total"])
+        table = []
+        for r in rows:
+            acc = ("-" if r["accepted"] is None
+                   else f"{r['accepted']}/{r['total']}")
+            eps = "-" if r["eps"] is None else f"{r['eps']:.4g}"
+            table.append([str(r["gen"]), r["path"], f"{r['wall_s']:.3f}"]
+                         + [f"{r[s + '_s']:.3f}" for s in STAGES]
+                         + [f"{r['other_s']:.3f}", f"{r['overlap_s']:.3f}",
+                            eps, acc])
+        widths = [max(len(cols[i]), max(len(row[i]) for row in table))
+                  for i in range(len(cols))]
+        fmt = "  ".join("{:>%d}" % w for w in widths)
+        lines = [fmt.format(*cols)]
+        lines += [fmt.format(*row) for row in table]
+        return "\n".join(lines)

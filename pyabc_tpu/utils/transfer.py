@@ -1,8 +1,10 @@
-"""Compatibility shim: the canonical transfer ledger lives in the wire
-subsystem (``pyabc_tpu/wire/transfer.py``) since streaming ingest landed
-— the counters are per-stage now (``compute_s``/``fetch_s``/
-``overlap_s`` next to the historical ``d2h_*``/``h2d_*`` keys).  This
-module re-exports it unchanged so existing imports keep working."""
+"""Deprecated alias: the canonical transfer ledger lives in the wire
+subsystem (``pyabc_tpu/wire/transfer.py``) since streaming ingest landed,
+and its storage is now the telemetry metrics registry.  This module
+re-exports the registry-backed API unchanged; import from
+``pyabc_tpu.wire.transfer`` instead."""
+
+import warnings
 
 from ..wire.transfer import (  # noqa: F401
     _lock,
@@ -11,8 +13,17 @@ from ..wire.transfer import (  # noqa: F401
     delta,
     record_compute,
     record_d2h,
+    record_decode,
     record_h2d,
     record_overlap,
+    record_rewind,
     snapshot,
     timed_d2h,
+)
+
+warnings.warn(
+    "pyabc_tpu.utils.transfer is deprecated; import "
+    "pyabc_tpu.wire.transfer instead",
+    DeprecationWarning,
+    stacklevel=2,
 )

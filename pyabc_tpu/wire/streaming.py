@@ -40,7 +40,14 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ..telemetry import spans
+from ..telemetry.metrics import REGISTRY
 from . import transfer
+
+
+def _inflight():
+    return REGISTRY.gauge("wire_ingest_inflight",
+                          "ingest tickets queued or running")
 
 
 class WireError(RuntimeError):
@@ -55,7 +62,7 @@ class IngestTicket:
     and returns the payload (or re-raises the worker's exception)."""
 
     __slots__ = ("label", "work_s", "wait_s", "_event", "_value",
-                 "_error", "_engine", "_settled")
+                 "_error", "_engine", "_settled", "_q_span", "_w_span")
 
     def __init__(self, engine, label: str = ""):
         self.label = label
@@ -66,6 +73,10 @@ class IngestTicket:
         self._error = None
         self._engine = engine
         self._settled = False
+        # queued-span covers submit backpressure + executor queue wait;
+        # the worker ends it when it picks the ticket up (cross-thread)
+        self._q_span = spans.begin("ingest.queued", label=label)
+        self._w_span = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -73,7 +84,13 @@ class IngestTicket:
     def _settle(self):
         if not self._settled:
             self._settled = True
-            transfer.record_overlap(max(0.0, self.work_s - self.wait_s))
+            credit = max(0.0, self.work_s - self.wait_s)
+            transfer.record_overlap(credit)
+            if self._w_span is not None:
+                # attrs stay mutable until flush: attribute the overlap
+                # credit to the worker span even though it already ended
+                self._w_span.set(overlap_s=round(credit, 6),
+                                 wait_s=round(self.wait_s, 6))
             self._engine._release(self)
 
     def result(self, timeout: float = None):
@@ -120,10 +137,13 @@ class StreamingIngest:
         with self._lock:
             if ticket in self._outstanding:
                 self._outstanding.remove(ticket)
+                _inflight().dec()
         if self._sem is not None:
             self._sem.release()
 
     def _run(self, ticket, fn):
+        spans.end(ticket._q_span)
+        ticket._w_span = spans.begin("ingest.work", label=ticket.label)
         t0 = time.perf_counter()
         try:
             ticket._value = fn()
@@ -134,6 +154,7 @@ class StreamingIngest:
                     self._failed = err
         finally:
             ticket.work_s = time.perf_counter() - t0
+            spans.end(ticket._w_span)
             ticket._event.set()
 
     # -- API ----------------------------------------------------------
@@ -153,6 +174,7 @@ class StreamingIngest:
             ticket.wait_s += time.perf_counter() - t0
         with self._lock:
             self._outstanding.append(ticket)
+            _inflight().inc()
         if self.depth <= 0:
             self._run(ticket, fn)       # synchronous inline mode
         else:

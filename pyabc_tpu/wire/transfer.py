@@ -8,24 +8,35 @@ process-global counters that the samplers' single choke points
 increment, so regressions in wire bytes are machine-visible in the bench
 JSON instead of hiding inside wall-clock noise.
 
-Absorbed from ``pyabc_tpu/utils/transfer.py`` (which re-exports this
-module unchanged) when the streaming-ingest subsystem landed, and
-extended with per-stage overlap accounting:
+Storage is delegated to the telemetry metrics registry
+(``pyabc_tpu.telemetry.metrics.REGISTRY``, ``wire_*`` metric names) so
+the ledger shows up in heartbeats and the Prometheus exporter for free;
+the public ``snapshot()``/``delta()``/``record_*`` API is unchanged and
+remains the canonical way to read the wire.
 
+Ledger keys (all cumulative since process start):
+
+- ``d2h_bytes`` / ``d2h_calls`` / ``h2d_bytes`` — raw wire volume.
 - ``compute_s``   — seconds fetches spent waiting for the PRODUCING
-  computation before any byte moved.  ``fetch_to_host`` now syncs
+  computation before any byte moved.  ``fetch_to_host`` syncs
   (``jax.block_until_ready``) before starting the transfer timer, so
-  compute wait is no longer booked as transfer (VERDICT r5 #3: the cpu8
-  row booked 22.2 s of device compute as "transfer" for 0.133 MB moved).
+  compute wait is not booked as transfer (VERDICT r5 #3: the cpu8 row
+  booked 22.2 s of device compute as "transfer" for 0.133 MB moved).
 - ``fetch_s``     — pure post-sync transfer seconds.  ``d2h_s`` is kept
   as the same number: it is the historical key every existing consumer
   (bench rows, generation_transfer) reads, now with the fixed semantics.
+- ``decode_s``    — host-side widen + weight-normalization seconds
+  (``widen_wire``), the third stage of the ingest path.
 - ``overlap_s``   — fetch seconds absorbed by a background ingest worker
   while the caller thread kept working (``wire.streaming``); the
   NON-overlapped wall share of the wire is ``fetch_s - overlap_s``.
+- ``rewinds``     — speculative generations discarded by the pipelined
+  orchestrator's ``rewind_to_frontier`` (wasted dispatch work,
+  machine-visible instead of inferred from wall-clock noise).
 
 ``snapshot()``/``delta()`` also report the derived ``d2h_mb_per_s`` —
-pure link bandwidth, meaningful now that the timer excludes compute.
+pure link bandwidth over ``fetch_s``, ``0.0`` when nothing was fetched
+in the window.
 
 The reference has no analog — its sampler transport is pickled
 process/network IO with no byte accounting (e.g.
@@ -34,12 +45,41 @@ pyabc/sampler/redis_eps/sampler.py result pipelines).
 
 from __future__ import annotations
 
-import threading
 import time
+from collections.abc import Mapping
 
-_lock = threading.Lock()
-_state = {"d2h_bytes": 0, "d2h_s": 0.0, "d2h_calls": 0, "h2d_bytes": 0,
-          "compute_s": 0.0, "fetch_s": 0.0, "overlap_s": 0.0}
+from ..telemetry.metrics import REGISTRY
+
+#: ledger keys, in the order snapshots report them.  ``d2h_s`` and
+#: ``fetch_s`` read the same counter (historical alias, see module doc).
+_KEYS = ("d2h_bytes", "d2h_s", "d2h_calls", "h2d_bytes", "compute_s",
+         "fetch_s", "decode_s", "overlap_s", "rewinds")
+
+#: keys reported as ints (counts, not seconds)
+_INT_KEYS = frozenset({"d2h_bytes", "d2h_calls", "h2d_bytes", "rewinds"})
+
+_HELP = "wire ledger; see pyabc_tpu/wire/transfer.py"
+
+
+def _c(name: str):
+    # create-or-return each call: survives REGISTRY.reset() in tests
+    return REGISTRY.counter(name, _HELP)
+
+
+_METRIC = {
+    "d2h_bytes": "wire_d2h_bytes_total",
+    "d2h_s": "wire_fetch_seconds_total",
+    "d2h_calls": "wire_d2h_calls_total",
+    "h2d_bytes": "wire_h2d_bytes_total",
+    "compute_s": "wire_compute_seconds_total",
+    "fetch_s": "wire_fetch_seconds_total",
+    "decode_s": "wire_decode_seconds_total",
+    "overlap_s": "wire_overlap_seconds_total",
+    "rewinds": "wire_rewinds_total",
+}
+
+#: the registry lock — held by ``snapshot()`` reads and counter writes
+_lock = REGISTRY._lock
 
 
 def _tree_nbytes(tree) -> int:
@@ -51,46 +91,79 @@ def _tree_nbytes(tree) -> int:
 
 def record_d2h(nbytes: int, seconds: float):
     with _lock:
-        _state["d2h_bytes"] += int(nbytes)
-        _state["d2h_s"] += float(seconds)
-        _state["fetch_s"] += float(seconds)
-        _state["d2h_calls"] += 1
+        _c("wire_d2h_bytes_total").inc(int(nbytes))
+        _c("wire_fetch_seconds_total").inc(float(seconds))
+        _c("wire_d2h_calls_total").inc()
 
 
 def record_h2d(nbytes: int):
-    with _lock:
-        _state["h2d_bytes"] += int(nbytes)
+    _c("wire_h2d_bytes_total").inc(int(nbytes))
 
 
 def record_compute(seconds: float):
     """Charge a pre-fetch sync wait (the producing computation)."""
-    with _lock:
-        _state["compute_s"] += float(seconds)
+    _c("wire_compute_seconds_total").inc(float(seconds))
+
+
+def record_decode(seconds: float):
+    """Charge host-side wire decode (``widen_wire`` + weight
+    normalization)."""
+    _c("wire_decode_seconds_total").inc(float(seconds))
 
 
 def record_overlap(seconds: float):
     """Credit fetch seconds that ran on a background ingest worker while
     the caller thread was NOT blocked on them (``StreamingIngest``)."""
-    with _lock:
-        _state["overlap_s"] += float(seconds)
+    _c("wire_overlap_seconds_total").inc(float(seconds))
+
+
+def record_rewind(count: int = 1):
+    """Count speculative generations discarded by a pipeline rewind."""
+    _c("wire_rewinds_total").inc(int(count))
+
+
+def _read(key: str):
+    v = _c(_METRIC[key]).value
+    return int(v) if key in _INT_KEYS else v
 
 
 def _derived(d: dict) -> dict:
-    d["d2h_mb_per_s"] = (round(d["d2h_bytes"] / 1e6 / d["d2h_s"], 3)
-                         if d.get("d2h_s", 0.0) > 1e-9 else 0.0)
+    d["d2h_mb_per_s"] = (round(d["d2h_bytes"] / 1e6 / d["fetch_s"], 3)
+                         if d.get("fetch_s", 0.0) > 1e-9 else 0.0)
     return d
 
 
 def snapshot() -> dict:
     with _lock:
-        return _derived(dict(_state))
+        return _derived({k: _read(k) for k in _KEYS})
 
 
 def delta(before: dict, after: dict = None) -> dict:
     """Counter difference ``after - before`` (``after`` defaults to now).
-    The derived ``d2h_mb_per_s`` is recomputed over the window."""
+    The derived ``d2h_mb_per_s`` is recomputed over the window; keys new
+    since ``before`` was taken count from zero."""
     after = after if after is not None else snapshot()
-    return _derived({k: after[k] - before.get(k, 0) for k in _state})
+    return _derived({k: after[k] - before.get(k, 0) for k in _KEYS})
+
+
+class _LedgerView(Mapping):
+    """Read-only live view of the ledger, kept as ``_state`` for
+    backwards compatibility (the pre-registry ledger exposed its raw
+    dict; writes must go through the ``record_*`` functions now)."""
+
+    def __getitem__(self, key):
+        if key not in _METRIC:
+            raise KeyError(key)
+        return _read(key)
+
+    def __iter__(self):
+        return iter(_KEYS)
+
+    def __len__(self):
+        return len(_KEYS)
+
+
+_state = _LedgerView()
 
 
 class timed_d2h:

@@ -51,3 +51,102 @@ def test_manager_info():
     assert res.exit_code == 0, res.output
     assert "process 0/1" in res.output
     assert "local devices" in res.output
+
+
+# ---------------------------------------------------------------------------
+# metrics-bearing heartbeats (telemetry satellite): info shows per-host
+# throughput, `metrics` exposes a Prometheus scrape per worker
+# ---------------------------------------------------------------------------
+
+_FAKE_METRICS = {
+    "uptime_s": 10.0, "generations": 4, "evaluations": 5000,
+    "accepted": 400, "acceptance_rate": 0.08, "d2h_mb": 12.5,
+    "d2h_mb_per_s": 250.0, "compute_s": 3.0, "fetch_s": 0.05,
+    "decode_s": 0.01, "overlap_s": 0.04, "rewinds": 2,
+    "ingest_inflight": 1,
+}
+
+
+def _beat(tmp_path, metrics_fn):
+    from pyabc_tpu.parallel import health
+    hb = health.Heartbeat(str(tmp_path), process_index=0,
+                          metrics_fn=metrics_fn)
+    hb.beat()
+    return hb
+
+
+def test_heartbeat_embeds_metrics(tmp_path):
+    from pyabc_tpu.parallel import health
+    _beat(tmp_path, lambda: dict(_FAKE_METRICS))
+    entry = health.worker_status(str(tmp_path))[0]
+    assert entry["alive"]
+    assert entry["metrics"]["evaluations"] == 5000
+    assert entry["metrics"]["rewinds"] == 2
+
+
+def test_heartbeat_default_metrics_fn_is_telemetry_summary(tmp_path):
+    """No metrics_fn -> the telemetry heartbeat_summary: sampler
+    throughput plus the wire ledger, all JSON-serializable scalars."""
+    from pyabc_tpu.parallel import health
+    _beat(tmp_path, None)
+    m = health.worker_status(str(tmp_path))[0]["metrics"]
+    assert {"uptime_s", "generations", "evaluations", "d2h_mb",
+            "d2h_mb_per_s", "overlap_s", "rewinds"} <= set(m)
+
+
+def test_heartbeat_survives_broken_metrics_fn(tmp_path):
+    """Metrics must never kill the liveness signal."""
+    from pyabc_tpu.parallel import health
+
+    def boom():
+        raise RuntimeError("registry on fire")
+
+    _beat(tmp_path, boom)
+    entry = health.worker_status(str(tmp_path))[0]
+    assert entry["alive"]
+    assert entry["metrics"] == {}
+
+
+def test_info_renders_worker_throughput_line(tmp_path):
+    _beat(tmp_path, lambda: dict(_FAKE_METRICS))
+    res = CliRunner().invoke(cli.info, ["--run-dir", str(tmp_path)])
+    assert res.exit_code == 0, res.output
+    assert "Workers=1 Alive=1" in res.output
+    assert "gens=4" in res.output
+    assert "evals=5000 (500.0/s)" in res.output
+    assert "acc_rate=0.08" in res.output
+    assert "d2h=12.50MB@250.00MB/s" in res.output
+    assert "rewinds=2" in res.output
+
+
+def test_metrics_command_scrapes_run_dir(tmp_path):
+    import os
+    import socket
+
+    _beat(tmp_path, lambda: dict(_FAKE_METRICS))
+    res = CliRunner().invoke(cli.metrics, ["--run-dir", str(tmp_path)])
+    assert res.exit_code == 0, res.output
+    labels = f'host="{socket.gethostname()}",pid="{os.getpid()}"'
+    assert f"pyabc_tpu_worker_evaluations{{{labels}}} 5000" in res.output
+    assert f"pyabc_tpu_worker_d2h_mb_per_s{{{labels}}} 250.0" in res.output
+
+
+def test_metrics_command_renders_local_registry():
+    from pyabc_tpu.telemetry.metrics import REGISTRY
+    REGISTRY.reset()
+    REGISTRY.counter("abc_evaluations_total",
+                     "total model evaluations").inc(5)
+    res = CliRunner().invoke(cli.metrics, [])
+    assert res.exit_code == 0, res.output
+    assert "# TYPE abc_evaluations_total counter" in res.output
+    assert "abc_evaluations_total 5.0" in res.output
+
+
+def test_render_worker_prometheus_skips_non_numeric():
+    from pyabc_tpu.telemetry.metrics import render_worker_prometheus
+    text = render_worker_prometheus([
+        {"host": "h1", "pid": 7,
+         "metrics": {"evaluations": 10, "alive": True, "note": "x"}},
+        {"host": "h2", "pid": 8, "metrics": {}},
+    ])
+    assert text == 'pyabc_tpu_worker_evaluations{host="h1",pid="7"} 10\n'
