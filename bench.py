@@ -96,12 +96,17 @@ def _log(msg):
 
 def _enable_compilation_cache():
     """Persistent XLA compile cache: repeat bench runs (and the sub-bench
-    subprocesses) skip recompiles of unchanged programs."""
+    subprocesses) skip recompiles of unchanged programs.  Routed through
+    the autotune chokepoint so PYABC_TPU_COMPILE_CACHE can redirect it
+    and the compile/cache-hit listeners are armed before first trace."""
     try:
-        import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/pyabc_tpu_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        from pyabc_tpu.autotune import (configure_compile_cache,
+                                        install_compile_listener)
+        configure_compile_cache(
+            os.environ.get("PYABC_TPU_COMPILE_CACHE",
+                           "/tmp/pyabc_tpu_jax_cache"),
+            min_compile_time_secs=1.0)
+        install_compile_listener()
     except Exception:
         pass
 
@@ -163,8 +168,10 @@ def _timed_generations(abc, pop, warmup, timed=3):
 
 def bench_primary():
     import pyabc_tpu as pt
+    from pyabc_tpu.autotune import compile_counters, compile_delta
     from pyabc_tpu.models import make_two_gaussians_problem
 
+    cc0 = compile_counters()
     models, priors, distance, observed, _ = make_two_gaussians_problem()
     abc = pt.ABCSMC(
         models, priors, distance,
@@ -191,11 +198,18 @@ def bench_primary():
     # timeline.summary() are scalars, so they survive into the compact
     # line; the row list and registry dict ride the full line only.
     from pyabc_tpu.telemetry import REGISTRY
+    cc = compile_delta(cc0)
+    n_gens = max(len(abc.timeline), 1)
     telemetry = {
         "telemetry_timeline_rows": abc.timeline.to_rows(),
         "telemetry_registry": REGISTRY.to_dict(),
         **{f"telemetry_{k}": v
            for k, v in abc.timeline.summary().items()},
+        # whole-run compile bill (warmup included — steady state is the
+        # timeline's n_compiles_total tail, which must be zero)
+        "telemetry_n_compiles": cc["n_compiles"],
+        "telemetry_compile_s_per_gen": round(cc["compile_s"] / n_gens, 4),
+        "telemetry_xla_cache_hits": cc["cache_hits"],
     }
     return rate, times, evals_ps, transfer, telemetry
 

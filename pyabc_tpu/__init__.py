@@ -100,6 +100,7 @@ from .sampler import (
 from .smc import ABCSMC
 from .storage import History, create_sqlite_db_id
 from .sumstat import SumStatSpec
+from . import autotune  # noqa: F401  (compile cache/ladder/tuner namespace)
 from . import telemetry  # noqa: F401  (spans/metrics/timeline namespace)
 from .transition import (
     AggregatedTransition,

@@ -1,0 +1,43 @@
+"""Compile-once, run-many: the compilation-and-tuning layer.
+
+Steady-state SMC generations should execute **zero XLA compiles after
+generation 1**.  Three pieces make that hold (docs/performance.md
+"Compilation & autotuning"):
+
+- :mod:`.cache` — opt-in persistent XLA compilation cache
+  (``PYABC_TPU_COMPILE_CACHE`` / ``ABCSMC(compile_cache=...)``), so
+  ladder programs survive process restarts;
+- :mod:`.ladder` — :class:`CompiledLadder`, the bounded thread-safe LRU
+  of compiled rung programs shared by the vectorized/sharded samplers
+  and the fused generation blocks, with background AOT prewarm of
+  predicted rungs and the ``xla_*`` compile-event accounting;
+- :mod:`.tuner` — :class:`BatchAutotuner`, the closed-loop batch-size
+  policy fed by the telemetry timeline (acceptance rate + variance,
+  undershoot rounds, compute/overlap seconds).
+
+``jit_compile`` is the sanctioned ``jax.jit`` spelling for
+per-generation code paths (``tools/check_no_inline_jit.py``).
+"""
+
+from __future__ import annotations
+
+from .cache import COMPILE_CACHE_ENV, configure_compile_cache
+from .ladder import (
+    AotGuard,
+    CompiledLadder,
+    aot_compile,
+    aval_of,
+    avals_like,
+    compile_counters,
+    compile_delta,
+    install_compile_listener,
+    jit_compile,
+)
+from .tuner import BatchAutotuner
+
+__all__ = [
+    "AotGuard", "BatchAutotuner", "COMPILE_CACHE_ENV", "CompiledLadder",
+    "aot_compile", "aval_of", "avals_like", "compile_counters",
+    "compile_delta", "configure_compile_cache",
+    "install_compile_listener", "jit_compile",
+]

@@ -1,0 +1,69 @@
+"""Persistent XLA compilation-cache wiring (opt-in).
+
+The batch ladder's programs are pure functions of (kernel config, rung,
+population target) — exactly the workload JAX's persistent compilation
+cache was built for: once a rung has been compiled anywhere, a later
+process pays a cache *read* instead of an XLA compile.  This module is
+the single place the cache directory is resolved:
+
+- ``ABCSMC(compile_cache="/path")`` wins;
+- else the ``PYABC_TPU_COMPILE_CACHE`` environment variable;
+- else the cache stays off (JAX default) and this module is a no-op.
+
+``min_compile_time_secs`` defaults to 0 so even the small CPU-backend
+test kernels persist — the upstream default (1 s) silently skips
+everything the tier-1 suite compiles, which would make the warm-run
+assertion vacuous.
+
+Import direction: like telemetry, autotune is a LEAF package — nothing
+here imports from the rest of ``pyabc_tpu``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("ABC.Autotune")
+
+#: environment variable naming the persistent compile-cache directory
+COMPILE_CACHE_ENV = "PYABC_TPU_COMPILE_CACHE"
+
+
+def configure_compile_cache(path: Optional[str] = None,
+                            min_compile_time_secs: float = 0.0,
+                            ) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` (explicit
+    argument, else ``$PYABC_TPU_COMPILE_CACHE``); returns the resolved
+    directory, or ``None`` when neither names one (no-op)."""
+    resolved = path if path is not None \
+        else os.environ.get(COMPILE_CACHE_ENV)
+    if not resolved:
+        return None
+    resolved = os.path.abspath(os.path.expanduser(str(resolved)))
+    os.makedirs(resolved, exist_ok=True)
+    import jax
+
+    previous = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", resolved)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+    except Exception:  # config knob renamed across jax versions
+        pass
+    if previous != resolved:
+        # jax latches cache state at the FIRST compile of the process
+        # (compilation_cache._cache_used/_cache): anything compiled
+        # before this call — e.g. construction-time capability probes —
+        # would leave the cache off (or pointed at a stale dir) for the
+        # whole process.  reset_cache() drops the latch so the next
+        # compile re-initializes against the directory just configured.
+        try:
+            from jax._src.compilation_cache import reset_cache
+            reset_cache()
+        except Exception:  # private API drifted: stale latch, not fatal
+            logger.debug("compilation_cache.reset_cache unavailable",
+                         exc_info=True)
+    logger.info("persistent compile cache: %s", resolved)
+    return resolved

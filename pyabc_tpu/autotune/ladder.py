@@ -1,0 +1,341 @@
+"""CompiledLadder: bounded, thread-safe store of compiled rung programs,
+with background AOT prewarm — plus the repo's single ``jax.jit`` choke
+point and the XLA compile-event accounting.
+
+Why a ladder object instead of the old per-sampler ``dict``:
+
+- **Bounded.**  Every batch-rung / kernel-config pair holds an XLA
+  executable (plus its donated-buffer layout); an adaptive run that
+  walks the ladder leaks programs without an LRU.  Evictions are
+  machine-visible (``autotune_ladder_evictions_total``).
+- **Thread-safe with single-flight builds.**  The AOT worker compiles
+  rungs in the background while a generation computes; a concurrent
+  ``get`` for the same key *waits for that build* instead of compiling
+  the identical program twice.
+- **Shared.**  One ladder serves the sampler's round/stateful-loop
+  programs (``sampler/vectorized.py``), the sharded variants, and the
+  fused K-generation blocks (``smc.py:_get_block_fn``), so every
+  per-generation executable has one owner, one bound, one eviction
+  policy.
+
+Compile accounting: :func:`install_compile_listener` registers one
+process-global ``jax.monitoring`` listener pair that mirrors XLA's
+backend-compile events (and the persistent cache's hit/miss events,
+when a cache directory is configured) into the telemetry registry —
+``xla_compiles_total`` / ``xla_compile_seconds_total`` /
+``xla_cache_{hits,misses}_total``.  The orchestrator snapshots these
+per generation (timeline ``compile_s`` / ``n_compiles`` columns), bench
+reports them per run, and the zero-recompile tier-1 test asserts their
+delta is zero in steady state.
+
+``jit_compile`` is a thin alias of ``jax.jit``: per-generation modules
+(``sampler/``, ``wire/``, ``smc.py``) route every jit through it so the
+``tools/check_no_inline_jit.py`` lint can forbid new inline ``jax.jit``
+call sites outside this package.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..telemetry import spans as _spans
+from ..telemetry.metrics import REGISTRY
+
+logger = logging.getLogger("ABC.Autotune")
+
+
+# ---------------------------------------------------------------------------
+# the jit choke point
+# ---------------------------------------------------------------------------
+
+def jit_compile(fn=None, **jit_kwargs):
+    """``jax.jit`` with a name the no-inline-jit lint can allowlist.
+
+    Per-generation code paths must come here (or through a
+    :class:`CompiledLadder`) for their jits, so compiled-program
+    creation stays observable and bounded in one layer."""
+    import jax
+
+    if fn is None:
+        return lambda f: jax.jit(f, **jit_kwargs)
+    return jax.jit(fn, **jit_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# XLA compile-event accounting
+# ---------------------------------------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def install_compile_listener():
+    """Idempotently register the process-global ``jax.monitoring``
+    listeners feeding the ``xla_*`` registry counters.  Safe to call
+    from every ``ABCSMC``/``CompiledLadder`` constructor — only the
+    first call registers (jax offers no unregister)."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        import jax.monitoring as monitoring
+
+        def _on_duration(event: str, duration_secs: float, **kw):
+            if event == _COMPILE_EVENT:
+                REGISTRY.counter(
+                    "xla_compiles_total",
+                    "XLA backend compile requests").inc()
+                REGISTRY.counter(
+                    "xla_compile_seconds_total",
+                    "seconds spent in XLA backend compile "
+                    "(persistent-cache hits count their retrieval "
+                    "time)").inc(duration_secs)
+
+        def _on_event(event: str, **kw):
+            if event == _CACHE_HIT_EVENT:
+                REGISTRY.counter(
+                    "xla_cache_hits_total",
+                    "persistent compile-cache hits").inc()
+            elif event == _CACHE_MISS_EVENT:
+                REGISTRY.counter(
+                    "xla_cache_misses_total",
+                    "persistent compile-cache misses").inc()
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+
+
+def compile_counters() -> dict:
+    """Scalar snapshot of the compile accounting (delta-friendly: the
+    orchestrator subtracts consecutive snapshots per generation)."""
+    d = REGISTRY.to_dict()
+    return {
+        "n_compiles": int(d.get("xla_compiles_total", 0)),
+        "compile_s": float(d.get("xla_compile_seconds_total", 0.0)),
+        "cache_hits": int(d.get("xla_cache_hits_total", 0)),
+        "cache_misses": int(d.get("xla_cache_misses_total", 0)),
+    }
+
+
+def compile_delta(before: dict, after: Optional[dict] = None) -> dict:
+    """Elementwise ``after - before`` over :func:`compile_counters`
+    snapshots (``after`` defaults to now)."""
+    if after is None:
+        after = compile_counters()
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
+# ---------------------------------------------------------------------------
+# AOT helpers
+# ---------------------------------------------------------------------------
+
+def aval_of(x):
+    """ShapeDtypeStruct mirroring a concrete array (weak_type
+    preserved — an AOT executable signature is exact about it)."""
+    import jax
+
+    return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                weak_type=getattr(x, "weak_type", False))
+
+
+def avals_like(tree):
+    """Pytree of avals mirroring a concrete pytree (aval leaves pass
+    through, so ``jax.eval_shape`` outputs compose)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+        else aval_of(x), tree)
+
+
+class AotGuard:
+    """A ``jit(...).lower(...).compile()`` executable with a lazy-jit
+    escape hatch: AOT signatures are exact, and a prewarmed rung can be
+    reached with slightly different avals than predicted (e.g. a
+    transition pad bucket grew between generations).  The guard calls
+    the precompiled executable and falls back to the ordinary jit
+    wrapper — a synchronous compile, the pre-autotune behavior — when
+    the signature no longer matches."""
+
+    __slots__ = ("_compiled", "_fallback")
+
+    def __init__(self, compiled, fallback):
+        self._compiled = compiled
+        self._fallback = fallback
+
+    def __call__(self, *args):
+        try:
+            return self._compiled(*args)
+        except (TypeError, ValueError):
+            REGISTRY.counter(
+                "autotune_aot_signature_misses_total",
+                "AOT executables bypassed by aval drift").inc()
+            return self._fallback(*args)
+
+
+def aot_compile(jit_fn, *arg_avals):
+    """AOT-compile a jitted function for exact avals; returns a
+    callable :class:`AotGuard`.  Calling the *wrapper* after lowering
+    would compile again (the AOT path does not populate the jit call
+    cache), so the ladder must store and call this object."""
+    return AotGuard(jit_fn.lower(*arg_avals).compile(), jit_fn)
+
+
+# ---------------------------------------------------------------------------
+# the ladder
+# ---------------------------------------------------------------------------
+
+class CompiledLadder:
+    """Bounded LRU of compiled programs with single-flight builds and a
+    background prewarm worker.
+
+    ``get(key, build)`` — return the cached program, or build it on the
+    calling thread (a ``compile.miss`` span).  If the same key is
+    already building (either thread), wait for that build instead.
+
+    ``prewarm(key, build)`` — enqueue the build on the daemon worker
+    (a ``compile.aot`` span); duplicate and already-cached keys are
+    dropped.  Worker exceptions are counted and logged, never raised
+    into the run: a failed prewarm just means the eventual ``get``
+    compiles synchronously, exactly the pre-autotune behavior.
+    """
+
+    def __init__(self, capacity: int = 16):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1 (got {capacity})")
+        self.capacity = int(capacity)
+        self._cache: "OrderedDict" = OrderedDict()
+        self._lock = threading.RLock()
+        self._inflight: dict = {}        # key -> threading.Event
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        install_compile_listener()
+
+    # ---- introspection ---------------------------------------------------
+
+    def __len__(self):
+        with self._lock:
+            return len(self._cache)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._cache
+
+    def keys(self):
+        with self._lock:
+            return list(self._cache)
+
+    def clear(self):
+        with self._lock:
+            self._cache.clear()
+
+    # ---- core ------------------------------------------------------------
+
+    def _insert(self, key, value):
+        with self._lock:
+            self._cache[key] = value
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.capacity:
+                evicted, _ = self._cache.popitem(last=False)
+                REGISTRY.counter(
+                    "autotune_ladder_evictions_total",
+                    "compiled programs dropped by the ladder LRU").inc()
+                logger.info("ladder evicted %r (capacity %d)",
+                            evicted, self.capacity)
+
+    def get(self, key, build: Callable):
+        """Serve ``key``, building on this thread on a miss; waits for
+        an in-flight build of the same key rather than duplicating
+        it."""
+        while True:
+            with self._lock:
+                if key in self._cache:
+                    self._cache.move_to_end(key)
+                    return self._cache[key]
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = self._inflight[key] = threading.Event()
+                    owner = True
+                else:
+                    owner = False
+            if not owner:
+                ev.wait()
+                continue  # built (or failed — then we become the owner)
+            try:
+                with _spans.span("compile.miss", key=str(key)):
+                    value = build()
+                REGISTRY.counter(
+                    "autotune_compile_misses_total",
+                    "synchronous ladder builds").inc()
+                self._insert(key, value)
+                return value
+            finally:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                ev.set()
+
+    def prewarm(self, key, build: Callable) -> bool:
+        """Schedule a background build of ``key``; returns True when
+        actually enqueued (False: cached or already in flight)."""
+        with self._lock:
+            if key in self._cache or key in self._inflight:
+                return False
+            self._inflight[key] = threading.Event()
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._worker_loop,
+                    name="pyabc-tpu-aot-prewarm", daemon=True)
+                self._worker.start()
+        self._queue.put((key, build))
+        return True
+
+    def _worker_loop(self):
+        while True:
+            key, build = self._queue.get()
+            try:
+                with _spans.span("compile.aot", key=str(key)):
+                    value = build()
+                REGISTRY.counter(
+                    "autotune_aot_builds_total",
+                    "background AOT rung precompiles").inc()
+                self._insert(key, value)
+            except Exception:
+                REGISTRY.counter(
+                    "autotune_aot_errors_total",
+                    "failed background AOT builds").inc()
+                logger.warning("AOT prewarm of %r failed "
+                               "(rung will compile on demand)",
+                               key, exc_info=True)
+            finally:
+                with self._lock:
+                    ev = self._inflight.pop(key, None)
+                if ev is not None:
+                    ev.set()
+                self._queue.task_done()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every scheduled prewarm has finished (tests /
+        teardown); returns False on timeout."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            with self._lock:
+                events = list(self._inflight.values())
+            if not events:
+                return True
+            for ev in events:
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                ev.wait(remaining)

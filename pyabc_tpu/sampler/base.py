@@ -177,9 +177,9 @@ def _nan_mask_records(batch: dict, rc) -> dict:
     module-cached so it compiles once per bucket shape — rc is a traced
     argument, not a shape)."""
     if "fn" not in _NAN_MASK_CACHE:
-        import jax
+        from ..autotune import jit_compile
 
-        @jax.jit
+        @jit_compile
         def mask(batch, rc):
             keep = jnp.arange(batch["distance"].shape[0]) < rc
             out = {}

@@ -32,7 +32,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from ..parallel.mesh import PARTICLE_AXIS, make_mesh
-from .vectorized import VectorizedSampler
+from .vectorized import VectorizedSampler, _pow2_at_least
 
 
 class ShardedSampler(VectorizedSampler):
@@ -49,11 +49,23 @@ class ShardedSampler(VectorizedSampler):
         self.min_batch_size = max(self.min_batch_size, self.n_devices)
 
     def _round_to_valid_batch(self, b: float) -> int:
-        B = super()._round_to_valid_batch(b)
-        # power-of-two ladder + pow-of-two device counts always divide; for
-        # exotic device counts round up to a multiple
-        if B % self.n_devices:
-            B = ((B // self.n_devices) + 1) * self.n_devices
+        nd = self.n_devices
+        # power-of-two ladder + pow-of-two device counts always divide
+        if nd & (nd - 1) == 0:
+            return super()._round_to_valid_batch(b)
+        # exotic device counts (e.g. 6): the ladder's rungs become
+        # nd * 2^k — still a geometric ladder (bounded program count,
+        # stable under small rate drift, cache-reusable), still evenly
+        # divisible.  Rounding B up to an arbitrary multiple of nd, as
+        # before, produced a fresh batch size — and a fresh XLA compile
+        # — for every little change of the predicted target.
+        per_device = max(int(np.ceil(b / nd)), 1)
+        B = nd * _pow2_at_least(per_device)
+        # clamp along the rung ladder so divisibility survives
+        while B < self.min_batch_size:
+            B *= 2
+        while B > self.max_batch_size and B // 2 >= self.min_batch_size:
+            B //= 2
         return B
 
     def _raw_round(self, round_fn: Callable, B: int,
@@ -95,10 +107,29 @@ class RedisEvalParallelSampler(ShardedSampler):
     broker/blackboard protocol is redesigned as SPMD shard_map rounds over
     a device mesh with XLA collectives (see module docstring) — same DYN
     semantics, no broker process.  Broker-specific constructor arguments
-    (host/port/password) are accepted and ignored."""
+    (host/port/password) are accepted and ignored — with a one-time
+    ``UserWarning`` naming them, so reference users pointing at a real
+    Redis broker learn the connection details do nothing here."""
+
+    #: process-wide once-latch for the ignored-kwargs warning
+    _warned_ignored_kwargs = False
 
     def __init__(self, host=None, port=None, password=None, batch_size=None,
                  **kwargs):
+        ignored = [name for name, value in
+                   (("host", host), ("port", port), ("password", password))
+                   if value is not None]
+        if ignored and not RedisEvalParallelSampler._warned_ignored_kwargs:
+            RedisEvalParallelSampler._warned_ignored_kwargs = True
+            import warnings
+
+            warnings.warn(
+                f"RedisEvalParallelSampler ignores {', '.join(ignored)}: "
+                "there is no Redis broker in pyabc_tpu — the sampler runs "
+                "SPMD shard_map rounds over the local device mesh. Remove "
+                "the broker arguments, or run the reference pyABC if you "
+                "need a networked broker.",
+                UserWarning, stacklevel=2)
         if batch_size is not None:  # reference network-amortization knob
             kwargs.setdefault("min_batch_size", batch_size)
         super().__init__(**kwargs)

@@ -14,8 +14,14 @@ results of every started round, ordered deterministically, truncated to the
 first n — the de-biasing protocol for free.
 
 Batch sizes come from a power-of-two ladder so at most a few XLA programs
-are ever compiled; the size is predicted from the previous generation's
-acceptance rate (adaptive over-provisioning, SURVEY.md §7 hard part #1).
+are ever compiled; the rung is chosen by the closed-loop
+:class:`~pyabc_tpu.autotune.BatchAutotuner` (acceptance-rate EWMA +
+variance, undershoot/overlap feedback), compiled programs live in the
+bounded thread-safe :class:`~pyabc_tpu.autotune.CompiledLadder` (shared
+with the fused generation blocks), and the predicted next rung is
+AOT-precompiled on a background thread while the current generation
+computes — steady state runs with zero XLA compiles after generation 1
+(SURVEY.md §7 hard part #1; docs/performance.md).
 """
 
 from __future__ import annotations
@@ -26,6 +32,8 @@ from typing import Callable, Dict, Tuple
 import jax
 import numpy as np
 
+from ..autotune import (BatchAutotuner, CompiledLadder, aot_compile,
+                        avals_like, jit_compile)
 from .base import Sample, Sampler, SamplingError, fetch_to_host
 from .device_loop import build_stateful_loop
 
@@ -51,14 +59,42 @@ class VectorizedSampler(Sampler):
         self.safety_factor = float(safety_factor)
         self.max_rounds_per_call = int(max_rounds_per_call)
         self._jit = jit
-        self._compiled: Dict[Tuple, Callable] = {}
+        #: bounded LRU of compiled rung programs, shared with the fused
+        #: generation blocks (smc.py:_get_block_fn) and the background
+        #: AOT prewarm worker
+        self._ladder = CompiledLadder()
+        #: closed-loop batch policy (acceptance EWMA + variance,
+        #: undershoot rounds, compute/overlap feedback)
+        self._tuner = BatchAutotuner()
         self._shape_cache: Dict[Tuple, Tuple[int, int]] = {}
         #: live carry buffers per compiled loop, reused across generations
         #: (allocating them fresh cost ~1.9 s/generation at pop 1e6
         #: through the relay; a reset is an O(1) cursor rewind)
         self._states: Dict[Tuple, object] = {}
-        #: acceptance-rate estimate carried across generations
-        self._rate_est = 1.0
+
+    # acceptance-rate estimate carried across generations — now owned by
+    # the tuner; the attribute stays readable/writable because run-path
+    # code and resume logic treat it as the sampler's rate state
+    @property
+    def _rate_est(self) -> float:
+        return self._tuner.rate
+
+    @_rate_est.setter
+    def _rate_est(self, value: float):
+        self._tuner.seed_rate(value)
+
+    def observe_generation(self, accepted: int, total: int,
+                           rounds=None, compute_s: float = 0.0,
+                           overlap_s: float = 0.0):
+        """Fold a finished generation's outcome (timeline-row units)
+        into the batch autotuner — called by every smc.py run path."""
+        self._tuner.observe(accepted, total, rounds=rounds,
+                            compute_s=compute_s, overlap_s=overlap_s)
+
+    def choose_batch(self, n: int) -> int:
+        """The rung for a generation targeting ``n`` accepted."""
+        return self._tuner.choose_batch(n, self.safety_factor,
+                                        self._round_to_valid_batch)
 
     # ---- building blocks (overridden by ShardedSampler) ------------------
 
@@ -69,7 +105,7 @@ class VectorizedSampler(Sampler):
 
     def _build(self, round_fn: Callable, B: int, **static_kwargs) -> Callable:
         raw = self._raw_round(round_fn, B, **static_kwargs)
-        return jax.jit(raw) if self._jit else raw
+        return jit_compile(raw) if self._jit else raw
 
     def _build_stateful(self, round_fn: Callable, B: int, n_target: int,
                         record_cap: int, d: int, s: int,
@@ -90,10 +126,11 @@ class VectorizedSampler(Sampler):
         start, step, finalize, harvest, reset, step_finalize = fns
         if self._jit:
             # donate the carry so the cap-sized buffers update in place
-            return (jax.jit(start), jax.jit(step, donate_argnums=(2,)),
-                    jax.jit(finalize), jax.jit(harvest),
-                    jax.jit(reset, donate_argnums=(0,)),
-                    jax.jit(step_finalize, donate_argnums=(2,)))
+            return (jit_compile(start),
+                    jit_compile(step, donate_argnums=(2,)),
+                    jit_compile(finalize), jit_compile(harvest),
+                    jit_compile(reset, donate_argnums=(0,)),
+                    jit_compile(step_finalize, donate_argnums=(2,)))
         return fns
 
     @staticmethod
@@ -124,14 +161,63 @@ class VectorizedSampler(Sampler):
     def _get(self, kind: str, round_fn: Callable, B: int, *extra,
              **static_kwargs) -> Callable:
         cache_key = self._cache_key(kind, round_fn, B, extra, static_kwargs)
-        if cache_key not in self._compiled:
-            if kind == "round":
-                self._compiled[cache_key] = self._build(
-                    round_fn, B, **static_kwargs)
-            else:
-                self._compiled[cache_key] = self._build_stateful(
-                    round_fn, B, *extra)
-        return self._compiled[cache_key]
+        if kind == "round":
+            build = lambda: self._build(round_fn, B, **static_kwargs)  # noqa: E731
+        else:
+            def build():
+                fns = self._build_stateful(round_fn, B, *extra)
+                if not self._jit:
+                    return fns
+                # every loop fn except reset() fires during the first
+                # generation on this rung; reset() waits for the NEXT
+                # one — AOT it now so steady state stays compile-free
+                start, step, finalize, harvest, reset, step_finalize = fns
+                reset = aot_compile(reset, jax.eval_shape(start))
+                return (start, step, finalize, harvest, reset,
+                        step_finalize)
+        return self._ladder.get(cache_key, build)
+
+    def _prewarm_next_rung(self, round_fn: Callable, n: int, B: int,
+                           extra: Tuple, key, params):
+        """AOT-precompile the stateful loop for the rung the tuner
+        predicts NEXT, on the ladder's background thread, while the
+        current generation computes on ``B``.  Input signatures are
+        taken from this generation's concrete ``key``/``params`` (the
+        next generation's match unless a pad bucket grows — then the
+        AotGuard falls back to a lazy jit).  No-op when the prediction
+        is the rung already in flight or a cached one."""
+        if not self._jit:
+            return
+        B_next = self._tuner.predict_next_batch(
+            n, self.safety_factor, self._round_to_valid_batch)
+        if B_next == B:
+            return
+        n_t, record_cap, d, s, defer, wire_stats, wire_m_bits = extra
+        record_cap_next = (min(self.max_records_cap(),
+                               B_next * self.max_rounds_per_call)
+                           if record_cap else 0)
+        extra_next = (n_t, record_cap_next, d, s, defer, wire_stats,
+                      wire_m_bits)
+        cache_key = self._cache_key("sloop", round_fn, B_next,
+                                    extra_next, {})
+        if cache_key in self._ladder:
+            return
+        key_aval = avals_like(key)
+        params_avals = avals_like(params)
+
+        def build():
+            fns = self._build_stateful(round_fn, B_next, *extra_next)
+            start, step, finalize, harvest, reset, step_finalize = fns
+            state_aval = jax.eval_shape(start)
+            return (aot_compile(start),
+                    aot_compile(step, key_aval, params_avals, state_aval),
+                    aot_compile(finalize, state_aval, params_avals),
+                    aot_compile(harvest, state_aval),
+                    aot_compile(reset, state_aval),
+                    aot_compile(step_finalize, key_aval, params_avals,
+                                state_aval))
+
+        self._ladder.prewarm(cache_key, build)
 
     def _round_to_valid_batch(self, b: float) -> int:
         return int(np.clip(_pow2_at_least(b), self.min_batch_size,
@@ -221,8 +307,7 @@ class VectorizedSampler(Sampler):
         # depends on it, and accumulating on device across calls (ONE full
         # fetch per generation instead of one per call) is worth more than
         # the stateless ladder's per-call batch adaptation
-        B = self._round_to_valid_batch(
-            n / max(self._rate_est, 1e-6) * self.safety_factor)
+        B = self.choose_batch(n)
         # per-CALL device record cap; across calls records accumulate
         # host-side up to max_records (Sample.append_record_batch)
         record_cap = (min(self.max_records_cap(),
@@ -239,10 +324,9 @@ class VectorizedSampler(Sampler):
         record_density_fn = None
         if defer and record_cap and self.record_proposal_density:
             key_fn = ("density", self._fn_id(round_fn))
-            if key_fn not in self._compiled:
-                self._compiled[key_fn] = jax.jit(
-                    round_fn.__self__.proposal_log_density)
-            jitted = self._compiled[key_fn]
+            jitted = self._ladder.get(
+                key_fn,
+                lambda: jit_compile(round_fn.__self__.proposal_log_density))
             record_density_fn = lambda m, th: jitted(m, th, params)  # noqa: E731
         # in DEFERRED mode finalize contains the proposal-density KDE over
         # the accepted buffer; a mispredicted prefetch pays (and discards)
@@ -256,12 +340,16 @@ class VectorizedSampler(Sampler):
         # bytes on the relay d2h link)
         wire_m_bits = getattr(getattr(round_fn, "__self__", None),
                               "M", 127) <= 2
-        loop_key = self._cache_key(
-            "sloop", round_fn, B,
-            (n, record_cap, d, s, defer, wire_stats, wire_m_bits), {})
+        loop_extra = (n, record_cap, d, s, defer, wire_stats, wire_m_bits)
+        loop_key = self._cache_key("sloop", round_fn, B, loop_extra, {})
         start, step, finalize, harvest, reset, step_finalize = self._get(
             "sloop", round_fn, B, n, record_cap, d, s, defer, wire_stats,
             wire_m_bits)
+        # while THIS rung computes, precompile the rung the tuner
+        # predicts for the next generation in the background — a rung
+        # move then serves an AOT executable instead of stalling the
+        # run on a synchronous XLA compile
+        self._prewarm_next_rung(round_fn, n, B, loop_extra, key, params)
         prev_state = self._states.pop(loop_key, None)
         state = start() if prev_state is None else reset(prev_state)
         # defer_wire_fetch: leave the big wire payload device-resident
@@ -331,7 +419,7 @@ class VectorizedSampler(Sampler):
                 sample.append_record_batch(rec)
             call_idx += 1
             rate_obs = count / max(rounds * B, 1)
-            self._rate_est = max(rate_obs, 1e-6)
+            self._tuner.observe(count, max(rounds * B, 1), rounds=rounds)
             if bar is not None:
                 bar.update(min(count, n))
                 logger.info(

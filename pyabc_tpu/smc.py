@@ -23,6 +23,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .acceptor import Acceptor, StochasticAcceptor, UniformAcceptor
+from .autotune import (compile_counters as _compile_counters,
+                       compile_delta as _compile_delta,
+                       configure_compile_cache, install_compile_listener,
+                       jit_compile)
 from .distance import Distance, PNormDistance, StochasticKernel, to_distance
 from .epsilon import Epsilon, MedianEpsilon, TemperatureBase
 from .model import Model, SimpleModel
@@ -50,7 +54,7 @@ def _default_sampler() -> Sampler:
 from functools import partial  # noqa: E402
 
 
-@partial(jax.jit, static_argnames=("specs",))
+@partial(jit_compile, static_argnames=("specs",))
 def _device_supports(m, theta, log_weight, count, specs):
     """Build per-model transition supports ON DEVICE from the accepted
     buffers of the finished generation (``Sample.device_population``).
@@ -104,6 +108,7 @@ class ABCSMC:
                  ingest_mode: str = "auto",
                  ingest_depth: int = 2,
                  trace_path: Optional[str] = None,
+                 compile_cache: Optional[str] = None,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -183,6 +188,15 @@ class ABCSMC:
         #: per-generation stage-duration rows (telemetry/timeline.py),
         #: fed by every run path at generation boundaries
         self.timeline = GenerationTimeline()
+        #: persistent XLA compile-cache directory (autotune/cache.py):
+        #: explicit argument wins, else $PYABC_TPU_COMPILE_CACHE, else
+        #: off.  Armed here so every program this instance compiles —
+        #: calibration included — can be served warm on the next run.
+        self.compile_cache_dir = configure_compile_cache(compile_cache)
+        # mirror XLA compile events into the xla_* registry counters
+        # (timeline compile_s/n_compiles columns, bench compile rows,
+        # the zero-recompile tier-1 assertion)
+        install_compile_listener()
 
         self._sanity_check()
 
@@ -542,9 +556,9 @@ class ABCSMC:
         cache_key = ("fused", self._kernel._uid, samp._uid, B,
                      n, K, d, s_width, eps_mode, alpha, mult, weighted,
                      wire_stats, wire_m_bits, max_rounds)
-        fn = self._fused_cache.get(cache_key)
-        if fn is None:
-            fn = jax.jit(build_fused_generations(
+
+        def build():
+            return jit_compile(build_fused_generations(
                 kernel=self._kernel,
                 # the sampler's round builder: a ShardedSampler hands
                 # back the shard_mapped round, so the fused scan SPMDs
@@ -563,7 +577,16 @@ class ABCSMC:
                 distance_params=jax.device_put(
                     self.distance_function.get_params(t)),
                 wire_stats=wire_stats, wire_m_bits=wire_m_bits))
-            self._fused_cache[cache_key] = fn
+
+        # block programs live in the sampler's CompiledLadder (one
+        # bounded LRU for every per-generation executable; stale-owner
+        # safety comes from the kernel/sampler _uids in the key)
+        ladder = getattr(samp, "_ladder", None)
+        if ladder is not None:
+            return ladder.get(cache_key, build)
+        fn = self._fused_cache.get(cache_key)
+        if fn is None:
+            fn = self._fused_cache[cache_key] = build()
             while len(self._fused_cache) > 4:
                 self._fused_cache.pop(next(iter(self._fused_cache)))
         return fn
@@ -593,13 +616,13 @@ class ABCSMC:
         samp = self.sampler
         if carry["theta"].shape[0] != n:
             return 0, 0, None  # population size changed: sequential
-        B = samp._round_to_valid_batch(
-            n / max(samp._rate_est, 1e-6) * samp.safety_factor)
+        B = samp.choose_batch(n)
         eps_mode = self._eps_device_config()[0]
         fn = self._get_block_fn(t, n, B, K)
 
         t0_block = _time.perf_counter()
         tr0_block = _transfer.snapshot()
+        cc0_block = _compile_counters()
         carry_in = {
             "m": carry["m"], "theta": carry["theta"],
             "log_weight": carry["log_weight"],
@@ -665,7 +688,6 @@ class ABCSMC:
                 t_k, acc_rate,
                 float(effective_sample_size(pop_k.weight)), evals_k)
             written += 1
-            samp._rate_est = max(acc_rate, 1e-6)
             # stopping criteria, sequential order (run loop below)
             if eps_k <= self.minimum_epsilon:
                 stop_reason = "Stopping: minimum epsilon reached"
@@ -683,6 +705,7 @@ class ABCSMC:
         if written:
             block_dt = _time.perf_counter() - t0_block
             tr_delta = _transfer.delta(tr0_block)
+            cc_delta = _compile_delta(cc0_block)
             for k in range(written):
                 self.generation_wall_clock[t + k] = block_dt / written
                 self.generation_transfer[t + k] = {
@@ -698,10 +721,18 @@ class ABCSMC:
                         "append": append_s_total / written,
                     },
                     eps=eps_k, accepted=count_k, total=evals_k,
-                    overlap_s=tr_delta["overlap_s"] / written)
+                    overlap_s=tr_delta["overlap_s"] / written,
+                    # the block compiles (at most) once — charge the
+                    # block's first generation, not a smeared fraction
+                    compile_s=(cc_delta["compile_s"] if k == 0 else 0.0),
+                    n_compiles=(cc_delta["n_compiles"] if k == 0 else 0))
                 _metrics.record_generation(
                     evals_k, count_k, count_k / max(evals_k, 1),
                     rounds=rounds_k, wall_s=block_dt / written)
+                samp.observe_generation(
+                    count_k, evals_k, rounds=rounds_k,
+                    compute_s=tr_delta["compute_s"] / written,
+                    overlap_s=tr_delta["overlap_s"] / written)
             last_pop = pop_k
             if stop_reason is None and t + written < t_max:
                 # keep the chain hot: device carry for the next block
@@ -777,15 +808,21 @@ class ABCSMC:
             "last_pop": None,   # Population of the last appended gen
             "last_dp": None,    # device view of the last appended gen
             "prepared_t": t0,   # host component state is fitted up to here
-            # acceptance-rate estimate used for DISPATCH batch sizing.
-            # Deliberately frozen between sequential generations (not
-            # updated at harvest): harvest timing depends on the ingest
-            # depth, and a depth-dependent B would make the dispatched
-            # programs — and therefore the run's results — depend on the
-            # pipelining, breaking depth-0 == depth-2 exactness
+            # acceptance-rate estimate / oversampling margin used for
+            # DISPATCH batch sizing.  Deliberately frozen between
+            # sequential generations (not updated at harvest): harvest
+            # timing depends on the ingest depth, and a depth-dependent
+            # B would make the dispatched programs — and therefore the
+            # run's results — depend on the pipelining, breaking
+            # depth-0 == depth-2 exactness.  Both snapshots come from
+            # the sampler's autotuner at the same drain points, so the
+            # closed-loop sizing still applies — just with depth-
+            # invariant staleness
             "rate_disp": samp._rate_est,
+            "safety_disp": samp._tuner.safety(samp.safety_factor),
             "gen_mark": _time.perf_counter(),
             "tr_mark": _transfer.snapshot(),
+            "cc_mark": _compile_counters(),
         }
         self._fused_carry = None
 
@@ -815,7 +852,7 @@ class ABCSMC:
             if t_d + K > t_max:
                 return False
             B = samp._round_to_valid_batch(
-                n / max(st["rate_disp"], 1e-6) * samp.safety_factor)
+                n / max(st["rate_disp"], 1e-6) * st["safety_disp"])
             fn = self._get_block_fn(t_d, n, B, K)
             carry_in = {
                 "m": carry["m"], "theta": carry["theta"],
@@ -889,6 +926,7 @@ class ABCSMC:
                 return False
             st["total_sims"] += sample.nr_evaluations
             st["rate_disp"] = samp._rate_est
+            st["safety_disp"] = samp._tuner.safety(samp.safety_factor)
             dp = sample.device_population
             st["carry"] = (dp if dp is not None and "distance" in dp
                            else None)
@@ -985,7 +1023,6 @@ class ABCSMC:
                 written += 1
                 st["t"] = t_k + 1
                 st["last_pop"] = pop_k
-                samp._rate_est = max(acc_rate, 1e-6)
                 # stopping criteria, sequential order (classic loop)
                 sims_so_far = (
                     base_sims + int(rounds[:k + 1].sum()) * blk["B"]
@@ -1008,6 +1045,8 @@ class ABCSMC:
                 st["gen_mark"] = now
                 tr_delta = _transfer.delta(st["tr_mark"])
                 st["tr_mark"] = _transfer.snapshot()
+                cc_delta = _compile_delta(st["cc_mark"])
+                st["cc_mark"] = _compile_counters()
                 for k in range(written):
                     self.generation_wall_clock[blk["t0"] + k] = \
                         block_dt / written
@@ -1029,10 +1068,21 @@ class ABCSMC:
                             "append": append_s_total / written,
                         },
                         eps=eps_k, accepted=count_k, total=evals_k,
-                        overlap_s=tr_delta["overlap_s"] / written)
+                        overlap_s=tr_delta["overlap_s"] / written,
+                        compile_s=(cc_delta["compile_s"]
+                                   if k == 0 else 0.0),
+                        n_compiles=(cc_delta["n_compiles"]
+                                    if k == 0 else 0))
                     _metrics.record_generation(
                         evals_k, count_k, count_k / max(evals_k, 1),
                         rounds=rounds_k, wall_s=block_dt / written)
+                    if blk["kind"] == "block":
+                        # seq-kind entries already fed the tuner inside
+                        # sample_until_n_accepted — don't double-count
+                        samp.observe_generation(
+                            count_k, evals_k, rounds=rounds_k,
+                            compute_s=tr_delta["compute_s"] / written,
+                            overlap_s=tr_delta["overlap_s"] / written)
                 if blk["kind"] == "block":
                     st["last_dp"] = (dict(blk["carry_out"])
                                      if written == K else None)
@@ -1301,6 +1351,7 @@ class ABCSMC:
         # timestamp diffs the bench used through round 4)
         gen_mark = _time.perf_counter()
         tr_mark = _transfer.snapshot()
+        cc_mark = _compile_counters()
         adapt_s = 0.0  # refit cost carried into the NEXT gen's row
         if self._overlap_enabled():
             # overlapped streaming ingest (wire/): gen t+1's device
@@ -1332,6 +1383,7 @@ class ABCSMC:
                     t += written
                     gen_mark = _time.perf_counter()
                     tr_mark = _transfer.snapshot()
+                    cc_mark = _compile_counters()
                     if stop_reason is not None:
                         logger.info(stop_reason)
                         break
@@ -1386,6 +1438,8 @@ class ABCSMC:
             tr_t = _transfer.delta(tr_mark)
             self.generation_transfer[t] = tr_t
             tr_mark = _transfer.snapshot()
+            cc_t = _compile_delta(cc_mark)
+            cc_mark = _compile_counters()
             self.timeline.record(
                 t, path="sequential", wall_s=self.generation_wall_clock[t],
                 stages={
@@ -1399,10 +1453,17 @@ class ABCSMC:
                 },
                 eps=current_eps, accepted=sample.raw_accepted,
                 total=sample.nr_evaluations,
-                overlap_s=tr_t["overlap_s"])
+                overlap_s=tr_t["overlap_s"],
+                compile_s=cc_t["compile_s"], n_compiles=cc_t["n_compiles"])
             _metrics.record_generation(
                 sample.nr_evaluations, sample.raw_accepted,
                 acceptance_rate, wall_s=self.generation_wall_clock[t])
+            # the sampler observed its acceptance rate per device call;
+            # the compute/overlap split (wire ledger) is only visible
+            # here — close the autotuner's timing loop
+            tuner = getattr(self.sampler, "_tuner", None)
+            if tuner is not None:
+                tuner.observe_timing(tr_t["compute_s"], tr_t["overlap_s"])
             if fused_ok:
                 # accepted buffers of THIS generation stay device-resident
                 # as the next fused block's carry
@@ -1475,7 +1536,7 @@ class ABCSMC:
                 if self._jit_dist_compute is None:
                     # one compiled program instead of an eager op-chain
                     # (each eager op pays the relay submission constant)
-                    self._jit_dist_compute = jax.jit(
+                    self._jit_dist_compute = jit_compile(
                         lambda s, o, p: self.distance_function.compute(
                             s, o, p))
                 d_new = np.asarray(self._jit_dist_compute(
@@ -1513,7 +1574,7 @@ class ABCSMC:
         # schemes solve ON device instead of fetching record columns
         if self._trans_params is not None:
             if self._jit_prop_density is None:
-                self._jit_prop_density = jax.jit(
+                self._jit_prop_density = jit_compile(
                     self._kernel.proposal_log_density)
             with np.errstate(divide="ignore"):
                 log_probs_new = jnp.asarray(

@@ -35,10 +35,15 @@ class GenerationTimeline:
     def record(self, t: int, *, path: str, wall_s: float,
                stages: Optional[dict] = None, eps: Optional[float] = None,
                accepted: Optional[int] = None, total: Optional[int] = None,
-               overlap_s: float = 0.0):
+               overlap_s: float = 0.0, compile_s: float = 0.0,
+               n_compiles: int = 0):
         """Add one generation's row.  ``stages`` maps a subset of
         :data:`STAGES` to seconds; unknown keys raise so a typo can't
-        silently vanish from the table."""
+        silently vanish from the table.  ``compile_s``/``n_compiles``
+        (the generation's XLA compile-counter delta, autotune/ladder.py)
+        are attribution columns like ``overlap_s``, NOT stages: compile
+        time overlaps ``dispatch``, so folding it into the stage sum
+        would break stage-sum == wall."""
         stages = dict(stages or {})
         unknown = set(stages) - set(STAGES)
         if unknown:
@@ -53,6 +58,8 @@ class GenerationTimeline:
         row["overlap_s"] = round(overlap_s, 6)
         row["overlap_frac"] = (round(overlap_s / wall_s, 4)
                                if wall_s > 1e-9 else 0.0)
+        row["compile_s"] = round(compile_s, 6)
+        row["n_compiles"] = int(n_compiles)
         row["eps"] = None if eps is None else float(eps)
         row["accepted"] = None if accepted is None else int(accepted)
         row["total"] = None if total is None else int(total)
@@ -92,6 +99,8 @@ class GenerationTimeline:
             "fetch_s_med": med("fetch_s"),
             "decode_s_med": med("decode_s"),
             "overlap_frac_med": med("overlap_frac"),
+            "compile_s_med": med("compile_s"),
+            "n_compiles_total": int(sum(r["n_compiles"] for r in rows)),
         }
 
     def render_ascii(self) -> str:
@@ -100,7 +109,8 @@ class GenerationTimeline:
         if not rows:
             return "(timeline: no generations recorded)"
         cols = (["gen", "path", "wall_s"] + [s + "_s" for s in STAGES]
-                + ["other_s", "overlap_s", "eps", "acc/total"])
+                + ["other_s", "overlap_s", "compile_s", "eps",
+                   "acc/total"])
         table = []
         for r in rows:
             acc = ("-" if r["accepted"] is None
@@ -109,7 +119,7 @@ class GenerationTimeline:
             table.append([str(r["gen"]), r["path"], f"{r['wall_s']:.3f}"]
                          + [f"{r[s + '_s']:.3f}" for s in STAGES]
                          + [f"{r['other_s']:.3f}", f"{r['overlap_s']:.3f}",
-                            eps, acc])
+                            f"{r.get('compile_s', 0.0):.3f}", eps, acc])
         widths = [max(len(cols[i]), max(len(row[i]) for row in table))
                   for i in range(len(cols))]
         fmt = "  ".join("{:>%d}" % w for w in widths)

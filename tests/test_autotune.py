@@ -1,0 +1,397 @@
+"""Compile-once-run-many (autotune/): ladder, tuner, AOT guard, cache.
+
+Pins the PR's acceptance bar: a 5-generation run records ZERO XLA
+compilations after generation 1 on both the sequential and the fused
+orchestrator paths (read from the timeline's per-generation
+``n_compiles`` attribution column), plus unit coverage for the
+:class:`BatchAutotuner` policy, the bounded :class:`CompiledLadder`,
+the :class:`AotGuard` lazy fallback, persistent-cache wiring, and the
+sharded-sampler rung ladder on non-power-of-two meshes (S1/S2).
+"""
+
+import os
+import threading
+import warnings
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.autotune import (
+    AotGuard,
+    BatchAutotuner,
+    COMPILE_CACHE_ENV,
+    CompiledLadder,
+    aot_compile,
+    compile_counters,
+    compile_delta,
+    configure_compile_cache,
+    jit_compile,
+)
+from pyabc_tpu.models import make_gaussian_problem
+from pyabc_tpu.sampler.sharded import RedisEvalParallelSampler, ShardedSampler
+from pyabc_tpu.telemetry import REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# tentpole acceptance: zero recompiles in steady state
+# ---------------------------------------------------------------------------
+
+def _restore_jax_cache_config(old_dir, old_min):
+    """Put the conftest cache config back AND drop jax's latched cache
+    state, so tests after a repointing one write where conftest says."""
+    jax.config.update("jax_compilation_cache_dir", old_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+    try:
+        from jax._src.compilation_cache import reset_cache
+        reset_cache()
+    except Exception:
+        pass
+
+
+def _run_gaussian(fuse, pops=5, pop=64, seed=7):
+    models, priors, distance, observed = make_gaussian_problem()
+    # min_batch_size pins the rung: every plausible acceptance rate for
+    # eps=0.8 maps below 1024 candidates, so rate wobble cannot move B
+    # (a rung move legitimately compiles; that is the prewarm's job,
+    # not this test's subject)
+    samp = pt.VectorizedSampler(min_batch_size=1024, max_batch_size=4096)
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    sampler=samp, eps=pt.ConstantEpsilon(0.8),
+                    fuse_generations=fuse, seed=seed)
+    abc.new("sqlite://", observed)
+    abc.run(max_nr_populations=pops)
+    return abc
+
+
+@pytest.mark.parametrize("fuse", [0, 2], ids=["sequential", "fused"])
+def test_zero_recompiles_after_generation_one(fuse):
+    abc = _run_gaussian(fuse)
+    rows = abc.timeline.to_rows()
+    assert [r["gen"] for r in rows] == [0, 1, 2, 3, 4]
+    if fuse:
+        assert {r["path"] for r in rows[1:]} == {"fused"}
+    # warm-up may compile (prior round at gen 0, the generation loop —
+    # or the fused K-block — at gen 1) ...
+    assert sum(r["n_compiles"] for r in rows[:2]) > 0
+    # ... and after that the ladder serves every program: steady-state
+    # generations never touch the XLA compiler
+    tail = [(r["gen"], r["n_compiles"]) for r in rows[2:]]
+    assert all(n == 0 for _, n in tail), tail
+    assert all(r["compile_s"] == 0.0 for r in rows[2:])
+
+
+def test_compile_counters_and_timeline_summary_flow():
+    abc = _run_gaussian(fuse=0, pops=3)
+    s = abc.timeline.summary()
+    assert s["generations"] == 3
+    assert s["n_compiles_total"] > 0
+    assert s["compile_s_med"] >= 0.0
+    # the run's compiles also land on the global registry counters
+    assert REGISTRY.get("xla_compiles_total").value >= s["n_compiles_total"]
+
+
+@pytest.mark.slow
+def test_warm_persistent_cache_second_run_hits(tmp_path):
+    """A second process-fresh ABCSMC sharing the same persistent cache
+    dir replays compiled programs from disk: cache hits go up and
+    misses go down versus the cold first run."""
+    cache_dir = str(tmp_path / "xla_cache")
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        deltas = []
+        for seed in (3, 3):
+            models, priors, distance, observed = make_gaussian_problem()
+            samp = pt.VectorizedSampler(min_batch_size=1024,
+                                        max_batch_size=4096)
+            abc = pt.ABCSMC(models, priors, distance, population_size=64,
+                            sampler=samp, eps=pt.ConstantEpsilon(0.8),
+                            seed=seed, compile_cache=cache_dir)
+            assert abc.compile_cache_dir == cache_dir
+            abc.new("sqlite://", observed)
+            before = compile_counters()
+            abc.run(max_nr_populations=2)
+            deltas.append(compile_delta(before))
+            # programs persist into the dir THIS run configured
+            assert len(os.listdir(cache_dir)) > 0
+        cold, warm = deltas
+        assert warm["cache_hits"] > 0
+        assert warm["cache_misses"] < cold["cache_misses"]
+    finally:
+        _restore_jax_cache_config(old_dir, old_min)
+
+
+# ---------------------------------------------------------------------------
+# BatchAutotuner policy
+# ---------------------------------------------------------------------------
+
+def _pow2(b):
+    return 1 << max(int(np.ceil(np.log2(max(b, 1)))), 0)
+
+
+def test_tuner_ewma_tracks_observed_rate():
+    t = BatchAutotuner(rate_init=1.0)
+    for _ in range(12):
+        t.observe(25, 100)
+    assert t.rate == pytest.approx(0.25, abs=0.01)
+    # stable observations decay the variance toward zero
+    assert t.stats()["rate_cv"] < 0.05
+
+
+def test_tuner_seed_rate_resets_noise_history():
+    t = BatchAutotuner()
+    t.observe(5, 100)
+    t.observe(90, 100)
+    t.seed_rate(0.5)
+    assert t.rate == 0.5
+    assert t.stats()["rate_cv"] == 0.0
+
+
+def test_tuner_undershoot_widens_margin():
+    calm, burnt = BatchAutotuner(), BatchAutotuner()
+    for tt in (calm, burnt):
+        for _ in range(8):
+            tt.observe(50, 100)
+    burnt.observe(50, 100, rounds=3)  # paid an extra device round
+    calm.observe(50, 100, rounds=1)
+    assert burnt.safety(1.2) > calm.safety(1.2)
+
+
+def test_tuner_noisy_rate_widens_margin():
+    calm, noisy = BatchAutotuner(), BatchAutotuner()
+    for _ in range(10):
+        calm.observe(50, 100)
+    for acc in (10, 90) * 5:
+        noisy.observe(acc, 100)
+    assert noisy.safety(1.2) > calm.safety(1.2)
+
+
+def test_tuner_overlap_leans_generous():
+    dry, wet = BatchAutotuner(), BatchAutotuner()
+    for _ in range(6):
+        dry.observe(50, 100, compute_s=1.0, overlap_s=0.0)
+        wet.observe(50, 100, compute_s=1.0, overlap_s=0.9)
+    assert wet.safety(1.2) > dry.safety(1.2)
+
+
+def test_tuner_safety_clipped_to_bounds():
+    t = BatchAutotuner(safety_min=1.05, safety_max=4.0)
+    for acc in (1, 99) * 20:  # violently noisy
+        t.observe(acc, 100)
+    assert t.safety(1.2) <= 4.0
+    t2 = BatchAutotuner()
+    for _ in range(20):
+        t2.observe(50, 100)
+    assert t2.safety(0.5) >= 1.05
+
+
+def test_tuner_hysteresis_holds_rung_near_boundary():
+    t = BatchAutotuner(hysteresis=0.1)
+    t.seed_rate(0.10)  # target 100/0.10*1.05 -> 1050 -> rung 2048
+    for _ in range(10):
+        t.observe(10, 100)
+    B1 = t.choose_batch(100, 1.0, _pow2)
+    assert B1 == 2048
+    # rate drifts up just enough that the raw target dips below the
+    # rung boundary — but within hysteresis, so the rung holds
+    t.seed_rate(0.1055)  # target ~995 -> pow2 would drop to 1024
+    assert t.choose_batch(100, 1.0, _pow2) == B1
+    # a real drop (far outside the band) does move down
+    t.seed_rate(0.5)
+    assert t.choose_batch(100, 1.0, _pow2) < B1
+
+
+def test_tuner_predict_does_not_commit():
+    t = BatchAutotuner()
+    t.seed_rate(0.5)
+    t.choose_batch(100, 1.2, _pow2)
+    last = t.stats()["last_B"]
+    t.predict_next_batch(100_000, 1.2, _pow2)
+    assert t.stats()["last_B"] == last
+
+
+# ---------------------------------------------------------------------------
+# CompiledLadder
+# ---------------------------------------------------------------------------
+
+def test_ladder_lru_eviction_and_counter():
+    led = CompiledLadder(capacity=2)
+    evict0 = REGISTRY.get("autotune_ladder_evictions_total")
+    evict0 = evict0.value if evict0 else 0.0
+    led.get("a", lambda: "A")
+    led.get("b", lambda: "B")
+    led.get("a", lambda: "A")  # touch: "a" is now most-recent
+    led.get("c", lambda: "C")  # evicts "b"
+    assert "b" not in led and "a" in led and "c" in led
+    assert len(led) == 2
+    assert REGISTRY.get("autotune_ladder_evictions_total").value == evict0 + 1
+
+
+def test_ladder_get_builds_once_single_flight():
+    led = CompiledLadder()
+    builds = []
+    gate = threading.Event()
+
+    def build():
+        gate.wait(timeout=5)
+        builds.append(1)
+        return "X"
+
+    results = [None] * 4
+
+    def worker(i):
+        results[i] = led.get("k", build)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for th in threads:
+        th.start()
+    gate.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert results == ["X"] * 4
+    assert len(builds) == 1
+
+
+def test_ladder_prewarm_background_build_and_drain():
+    led = CompiledLadder()
+    assert led.prewarm("warm", lambda: "W") is True
+    led.drain(timeout=10)
+    assert "warm" in led
+    # a later get() must serve the prewarmed value, not rebuild
+    assert led.get("warm", lambda: pytest.fail("rebuilt")) == "W"
+    # prewarming a cached key is a no-op
+    assert led.prewarm("warm", lambda: "V") is False
+
+
+def test_ladder_prewarm_build_error_is_contained():
+    led = CompiledLadder()
+    errs0 = REGISTRY.get("autotune_aot_errors_total")
+    errs0 = errs0.value if errs0 else 0.0
+
+    def bad():
+        raise RuntimeError("boom")
+
+    assert led.prewarm("bad", bad) is True
+    led.drain(timeout=10)
+    assert "bad" not in led
+    assert REGISTRY.get("autotune_aot_errors_total").value == errs0 + 1
+
+
+# ---------------------------------------------------------------------------
+# AOT guard
+# ---------------------------------------------------------------------------
+
+def test_aot_guard_serves_compiled_and_falls_back_on_drift():
+    fn = jit_compile(lambda x: x * 2.0)
+    x = jax.numpy.ones((4,))
+    guard = aot_compile(fn, jax.eval_shape(lambda: x))
+    np.testing.assert_allclose(np.asarray(guard(x)), 2.0 * np.ones(4))
+    miss0 = REGISTRY.get("autotune_aot_signature_misses_total")
+    miss0 = miss0.value if miss0 else 0.0
+    y = jax.numpy.ones((6,))  # pad bucket grew: signature drifts
+    np.testing.assert_allclose(np.asarray(guard(y)), 2.0 * np.ones(6))
+    assert REGISTRY.get(
+        "autotune_aot_signature_misses_total").value == miss0 + 1
+
+
+# ---------------------------------------------------------------------------
+# persistent-cache wiring
+# ---------------------------------------------------------------------------
+
+def test_configure_compile_cache_paths(tmp_path, monkeypatch):
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        monkeypatch.delenv(COMPILE_CACHE_ENV, raising=False)
+        # no path, no env: no-op
+        assert configure_compile_cache() is None
+        assert jax.config.jax_compilation_cache_dir == old_dir
+        # env var
+        env_dir = str(tmp_path / "from_env")
+        monkeypatch.setenv(COMPILE_CACHE_ENV, env_dir)
+        assert configure_compile_cache() == env_dir
+        assert os.path.isdir(env_dir)
+        assert jax.config.jax_compilation_cache_dir == env_dir
+        # explicit path beats env
+        exp_dir = str(tmp_path / "explicit")
+        assert configure_compile_cache(exp_dir) == exp_dir
+        assert jax.config.jax_compilation_cache_dir == exp_dir
+    finally:
+        _restore_jax_cache_config(old_dir, old_min)
+
+
+def test_abcsmc_compile_cache_kwarg(tmp_path):
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        models, priors, distance, observed = make_gaussian_problem()
+        cache = str(tmp_path / "cc")
+        abc = pt.ABCSMC(models, priors, distance, population_size=32,
+                        compile_cache=cache)
+        assert abc.compile_cache_dir == cache
+        assert jax.config.jax_compilation_cache_dir == cache
+    finally:
+        _restore_jax_cache_config(old_dir, old_min)
+
+
+# ---------------------------------------------------------------------------
+# S1: non-power-of-two device meshes snap to a divisible rung ladder
+# ---------------------------------------------------------------------------
+
+def _mock_mesh_sampler(nd, **kwargs):
+    mesh = SimpleNamespace(shape={"particles": nd},
+                           axis_names=("particles",))
+    return ShardedSampler(mesh=mesh, **kwargs)
+
+
+def test_sharded_rung_ladder_on_six_device_mesh():
+    samp = _mock_mesh_sampler(6, min_batch_size=1, max_batch_size=1 << 16)
+    assert samp.n_devices == 6
+    for target in (1, 5, 6, 7, 100, 750, 3000):
+        B = samp._round_to_valid_batch(target)
+        assert B % 6 == 0, (target, B)
+        assert B >= target
+        # rungs are 6 * 2^k — a geometric ladder, not arbitrary
+        # multiples of 6 (bounded program count under rate drift)
+        assert (B // 6) & (B // 6 - 1) == 0, (target, B)
+    # nearby targets share a rung (stable under small rate wobble)
+    assert samp._round_to_valid_batch(700) == samp._round_to_valid_batch(750)
+
+
+def test_sharded_rung_ladder_respects_bounds_on_exotic_mesh():
+    samp = _mock_mesh_sampler(6, min_batch_size=48, max_batch_size=96)
+    assert samp._round_to_valid_batch(1) >= 48
+    B = samp._round_to_valid_batch(10_000)
+    assert B <= 96 and B % 6 == 0
+    # power-of-two meshes keep the plain pow2 ladder
+    samp8 = _mock_mesh_sampler(8, min_batch_size=1, max_batch_size=1 << 16)
+    assert samp8._round_to_valid_batch(700) == 1024
+
+
+# ---------------------------------------------------------------------------
+# S2: broker kwargs warn once
+# ---------------------------------------------------------------------------
+
+def test_redis_sampler_warns_once_on_broker_kwargs():
+    RedisEvalParallelSampler._warned_ignored_kwargs = False
+    with pytest.warns(UserWarning, match="host, port"):
+        RedisEvalParallelSampler(host="1.2.3.4", port=6379)
+    # once-latch: a second construction stays silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        RedisEvalParallelSampler(host="1.2.3.4", port=6379)
+    # no broker kwargs, no warning — and the latch is untouched
+    RedisEvalParallelSampler._warned_ignored_kwargs = False
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        RedisEvalParallelSampler()
+    assert RedisEvalParallelSampler._warned_ignored_kwargs is False
+
+
+def test_redis_sampler_batch_size_maps_to_min_batch():
+    RedisEvalParallelSampler._warned_ignored_kwargs = True
+    samp = RedisEvalParallelSampler(batch_size=512)
+    assert samp.min_batch_size == 512

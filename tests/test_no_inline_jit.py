@@ -1,0 +1,61 @@
+"""Tier-1 wrapper for tools/check_no_inline_jit.py: per-generation
+code (sampler/, wire/, smc.py) must stage programs through
+pyabc_tpu.autotune — an inline ``jax.jit`` there would rebuild the
+unbounded invisible program cache the compile-once work removed — and
+the lint must actually catch a violation when one is planted."""
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "check_no_inline_jit.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_no_inline_jit", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_tree_is_clean():
+    """Every hot-path program goes through autotune.jit_compile — the
+    invariant the zero-recompile acceptance test rests on."""
+    mod = _load()
+    assert mod.check() == []
+
+
+def test_detects_planted_violations(tmp_path):
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "sampler").mkdir(parents=True)
+    (pkg / "wire").mkdir()
+    (pkg / "autotune").mkdir()
+    (pkg / "ops").mkdir()
+    # the chokepoint itself may call jax.jit
+    (pkg / "autotune" / "ladder.py").write_text("f = jax.jit(g)\n")
+    # cold-path modules are out of scope
+    (pkg / "ops" / "kde.py").write_text("f = jax.jit(g)\n")
+    (pkg / "sampler" / "bad.py").write_text(
+        "f = jax.jit(g)\n"
+        "ok = jax.jit(g)  # jit-ok\n"
+        "# a comment naming jax.jit is not a violation\n"
+        "h = jax.pjit(g)\n")
+    (pkg / "wire" / "leak.py").write_text("@jax.jit\ndef f(x): ...\n")
+    (pkg / "smc.py").write_text("step = jax.jit(step)\n")
+    got = mod.check(root=str(pkg))
+    assert sorted((path, lineno) for path, lineno, _ in got) == [
+        ("sampler/bad.py", 1), ("sampler/bad.py", 4),
+        ("smc.py", 1), ("wire/leak.py", 1)]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    mod = _load()
+    assert mod.main([]) == 0  # the real tree
+    assert "clean" in capsys.readouterr().out
+    pkg = tmp_path / "pkg"
+    (pkg / "sampler").mkdir(parents=True)
+    (pkg / "sampler" / "leak.py").write_text("jax.jit(f)\n")
+    assert mod.main([str(pkg)]) == 1
+    assert "sampler/leak.py:1" in capsys.readouterr().out
