@@ -210,6 +210,14 @@ def bench_primary():
         "telemetry_n_compiles": cc["n_compiles"],
         "telemetry_compile_s_per_gen": round(cc["compile_s"] / n_gens, 4),
         "telemetry_xla_cache_hits": cc["cache_hits"],
+        # resilience ledger: retries must be 0 on a healthy bench run,
+        # and the checkpoint bill 0 when sub-checkpointing is off —
+        # regressions here mean the hot loop started paying for fault
+        # handling it isn't using
+        "resilience_retries": int(REGISTRY.to_dict().get(
+            "resilience_retries_total", 0)),
+        "checkpoint_s_per_gen": round(REGISTRY.to_dict().get(
+            "resilience_checkpoint_seconds_total", 0.0) / n_gens, 4),
     }
     return rate, times, evals_ps, transfer, telemetry
 
@@ -548,7 +556,8 @@ def main():
     # what made the full line huge — restricted to the headline prefixes.
     compact = {k: v for k, v in sorted(extra.items())
                if k.startswith(("primary_", "northstar_",
-                                "posterior_gate_", "telemetry_"))
+                                "posterior_gate_", "telemetry_",
+                                "resilience_", "checkpoint_"))
                and not isinstance(v, (list, dict))}
     print(json.dumps({**header, "extra": compact}))
 

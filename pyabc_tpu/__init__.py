@@ -102,6 +102,7 @@ from .storage import History, create_sqlite_db_id
 from .sumstat import SumStatSpec
 from . import autotune  # noqa: F401  (compile cache/ladder/tuner namespace)
 from . import telemetry  # noqa: F401  (spans/metrics/timeline namespace)
+from . import resilience  # noqa: F401  (faults/retry/checkpoint namespace)
 from .transition import (
     AggregatedTransition,
     DiscreteRandomWalkTransition,

@@ -29,10 +29,27 @@ from typing import Dict, List, Optional
 
 RUN_DIR_ENV = "PYABC_TPU_RUN_DIR"
 STOP_SENTINEL = "STOP"
-#: a heartbeat older than this is considered dead
+#: a heartbeat older than this is considered dead (default; override
+#: per-deployment with $PYABC_TPU_STALE_S — slow shared filesystems
+#: and long GC pauses want a larger window)
 STALE_AFTER_S = 30.0
+STALE_ENV = "PYABC_TPU_STALE_S"
 _HB_PREFIX = "hb_"
 _PROBE_NAME = ".now_probe"
+
+#: first-seen bookkeeping for the monotonic staleness cross-check:
+#: hb path -> (mtime, monotonic clock when that mtime was first seen)
+_MONO_SEEN: Dict[str, tuple] = {}
+_MONO_LOCK = threading.Lock()
+
+
+def stale_after_default() -> float:
+    """The staleness window: ``$PYABC_TPU_STALE_S`` or 30 s."""
+    try:
+        val = float(os.environ.get(STALE_ENV, STALE_AFTER_S))
+    except ValueError:
+        return STALE_AFTER_S
+    return val if val >= 0 else STALE_AFTER_S
 
 
 def run_dir() -> Optional[str]:
@@ -67,6 +84,10 @@ class Heartbeat:
         self._thread: Optional[threading.Thread] = None
 
     def beat(self):
+        # chaos hook: `heartbeat.write@...` fault plans exercise the
+        # loop's OSError tolerance (resilience/faults.py)
+        from ..resilience.faults import SITE_HEARTBEAT, fault_point
+        fault_point(SITE_HEARTBEAT)
         os.makedirs(self.directory, exist_ok=True)
         payload = {
             "host": socket.gethostname(),
@@ -120,12 +141,24 @@ class Heartbeat:
 
 
 def worker_status(directory: str,
-                  stale_after_s: float = STALE_AFTER_S) -> List[Dict]:
+                  stale_after_s: Optional[float] = None) -> List[Dict]:
     """All workers that ever heartbeat into ``directory``, newest first.
 
-    Each entry carries ``alive`` (heartbeat within ``stale_after_s``) —
-    the reference's ``healthy()`` analog.
+    Each entry carries ``alive`` (heartbeat within ``stale_after_s``,
+    defaulting to ``$PYABC_TPU_STALE_S`` / 30 s) — the reference's
+    ``healthy()`` analog.
+
+    Liveness is cross-checked against this process's MONOTONIC clock:
+    once a heartbeat has been observed, a worker is only declared dead
+    after ``stale_after_s`` of monotonic time passes without its mtime
+    advancing — a wall-clock step (NTP correction, VM migration) on
+    either side cannot mark a live, beating worker dead.  The wall-age
+    test still applies on the FIRST observation (a manager starting up
+    must classify pre-existing stale files correctly) and remains as an
+    OR thereafter, so genuine staleness is never masked.
     """
+    if stale_after_s is None:
+        stale_after_s = stale_after_default()
     out = []
     try:
         names = os.listdir(directory)
@@ -159,7 +192,21 @@ def worker_status(directory: str,
             mtime = os.stat(path).st_mtime
         except (OSError, ValueError):
             continue
-        entry["alive"] = (now - mtime) <= stale_after_s
+        with _MONO_LOCK:
+            seen = _MONO_SEEN.get(path)
+            if seen is None or seen[0] != mtime:
+                _MONO_SEEN[path] = (mtime, time.monotonic())
+                first = seen is None
+                mono_age = 0.0
+            else:
+                first = False
+                mono_age = time.monotonic() - seen[1]
+        wall_age = now - mtime
+        if first:
+            entry["alive"] = wall_age <= stale_after_s
+        else:
+            entry["alive"] = (wall_age <= stale_after_s
+                              or mono_age <= stale_after_s)
         entry["last_seen"] = mtime
         out.append(entry)
     out.sort(key=lambda e: -e["last_seen"])
@@ -167,14 +214,14 @@ def worker_status(directory: str,
 
 
 def healthy(directory: str,
-            stale_after_s: float = STALE_AFTER_S) -> bool:
+            stale_after_s: Optional[float] = None) -> bool:
     """True iff every registered worker heartbeat recently."""
     status = worker_status(directory, stale_after_s)
     return bool(status) and all(e["alive"] for e in status)
 
 
 def reset_workers(directory: str,
-                  stale_after_s: float = STALE_AFTER_S) -> int:
+                  stale_after_s: Optional[float] = None) -> int:
     """Remove stale heartbeat files (reference ``reset-workers``,
     redis_eps/cli.py:279-280). Returns the number removed."""
     removed = 0
@@ -188,6 +235,8 @@ def reset_workers(directory: str,
                 removed += 1
             except OSError:
                 pass
+            with _MONO_LOCK:
+                _MONO_SEEN.pop(path, None)
     if not worker_status(directory, stale_after_s):
         # nothing registered anymore: remove the clock probe too so a
         # fully-reset run dir is empty again

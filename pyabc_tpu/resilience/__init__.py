@@ -1,0 +1,43 @@
+"""pyabc_tpu.resilience: fault injection, retry, and sub-checkpointing.
+
+The robustness leg of the north star ("production-scale ... handles as
+many scenarios as you can imagine"), next to the perf (autotune/, wire/)
+and observability (telemetry/) legs:
+
+- :mod:`~pyabc_tpu.resilience.faults` — deterministic, seeded fault
+  injection at the hot loop's five named chokepoints
+  (``PYABC_TPU_FAULTS``), so chaos tests are reproducible;
+- :mod:`~pyabc_tpu.resilience.retry` — bounded exponential-backoff
+  retry wrapping every device dispatch and the d2h chokepoint, with
+  transient-vs-fatal classification and graceful degradation
+  (batch-rung drop, fused/pipelined -> sequential fallback);
+- :mod:`~pyabc_tpu.resilience.checkpoint` — mid-generation
+  sub-checkpointing: a round-granular accepted-particle ledger flushed
+  to the History, so a SIGTERM mid-generation loses at most one flush
+  interval instead of the whole generation.
+
+See docs/resilience.md for the operator-facing guide.
+"""
+
+from . import checkpoint, faults, retry  # noqa: F401
+from .checkpoint import GenCheckpointer, Preempted
+from .faults import (FAULTS_ENV, SITE_APPEND, SITE_DISPATCH, SITE_FETCH,
+                     SITE_HEARTBEAT, SITE_PREEMPT, SITES, FaultPlan,
+                     FaultSpec, active_plan, fault_point, install,
+                     install_from_env, uninstall)
+from .retry import (RetryExhausted, RetryPolicy, is_transient,
+                    retry_counters, shared_policy)
+
+# env-driven chaos needs no code: subprocess tests just set
+# PYABC_TPU_FAULTS (+ PYABC_TPU_FAULT_SEED) and import the package
+install_from_env()
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "active_plan", "fault_point", "install",
+    "install_from_env", "uninstall", "FAULTS_ENV", "SITES",
+    "SITE_DISPATCH", "SITE_FETCH", "SITE_APPEND", "SITE_HEARTBEAT",
+    "SITE_PREEMPT",
+    "RetryPolicy", "RetryExhausted", "is_transient", "shared_policy",
+    "retry_counters",
+    "GenCheckpointer", "Preempted",
+]

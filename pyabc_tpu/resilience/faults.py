@@ -1,0 +1,267 @@
+"""Deterministic, seeded fault injection for the device hot loop.
+
+At north-star scale the run rides preemptible TPUs, a flaky relay d2h
+link, and a shared filesystem — but nothing in the repo could *provoke*
+those failures on demand, so the wire/, telemetry/ and autotune/ paths
+were effectively untested under faults.  This module plants named
+**fault sites** at the five chokepoints of the hot loop and lets a
+:class:`FaultPlan` (built in code or from the ``PYABC_TPU_FAULTS``
+environment variable) raise, delay, or deliver a real ``SIGTERM`` at an
+exact visit of a site — reproducibly, under a fixed seed.
+
+Fault sites (the constants below, one per chokepoint):
+
+- ``device.dispatch`` — every compiled-program dispatch
+  (``Sampler._dispatch``, the fused/pipelined block dispatches in
+  smc.py)
+- ``wire.fetch``      — the d2h chokepoint (``sampler.base
+  .fetch_to_host``), including background ingest workers (wire/)
+- ``history.append``  — the per-generation durable write
+  (``storage.history.History.append_population``)
+- ``heartbeat.write`` — ``parallel.health.Heartbeat.beat``
+- ``preempt``         — polled once per device call by the sampler
+  loop; the ``sigterm`` action here simulates a preemption notice
+  mid-generation (resilience/checkpoint.py)
+
+Plan grammar (semicolon-separated directives)::
+
+    site@N:action     fire at exactly the N-th visit of the site
+    site@N+:action    fire at every visit >= N
+    site~P:action     fire with probability P per visit (seeded RNG)
+
+    action := raise=ExcName | delay=SECONDS | sigterm
+
+e.g. ``PYABC_TPU_FAULTS="wire.fetch@3:raise=ConnectionResetError;``
+``preempt@5:sigterm"``.  Exception names resolve against builtins plus
+a small registry (``OperationalError``, ``WireError``).
+
+Disabled cost: :func:`fault_point` is one module-global load and a
+``None`` check (the same pattern as the telemetry tracer's ``_NULL``
+span), so production runs pay nothing measurable — see the <1%-overhead
+assertion in tests/test_resilience.py.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+SITE_DISPATCH = "device.dispatch"
+SITE_FETCH = "wire.fetch"
+SITE_APPEND = "history.append"
+SITE_HEARTBEAT = "heartbeat.write"
+SITE_PREEMPT = "preempt"
+
+#: every named fault site, for validation and docs
+SITES = (SITE_DISPATCH, SITE_FETCH, SITE_APPEND, SITE_HEARTBEAT,
+         SITE_PREEMPT)
+
+FAULTS_ENV = "PYABC_TPU_FAULTS"
+FAULT_SEED_ENV = "PYABC_TPU_FAULT_SEED"
+
+_HELP = "resilience fault injection; see pyabc_tpu/resilience/faults.py"
+
+
+def _counter(name: str):
+    # create-or-return each call: survives REGISTRY.reset() in tests
+    # (same idiom as the wire ledger, wire/transfer.py)
+    from ..telemetry.metrics import REGISTRY
+    return REGISTRY.counter(name, _HELP)
+
+
+def _resolve_exception(name: str) -> type:
+    """Exception class for a plan directive: builtins first, then the
+    in-repo registry of failure types chaos tests care about."""
+    import builtins
+    exc = getattr(builtins, name, None)
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        return exc
+    if name == "OperationalError":
+        import sqlite3
+        return sqlite3.OperationalError
+    if name == "WireError":
+        from ..wire.streaming import WireError
+        return WireError
+    raise ValueError(f"unknown exception name in fault plan: {name!r}")
+
+
+class FaultSpec:
+    """One parsed directive of a :class:`FaultPlan`."""
+
+    __slots__ = ("site", "mode", "arg", "action", "action_arg")
+
+    def __init__(self, site: str, mode: str, arg: float, action: str,
+                 action_arg=None):
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} (valid: {', '.join(SITES)})")
+        if mode not in ("at", "from", "prob"):
+            raise ValueError(f"unknown trigger mode {mode!r}")
+        if action not in ("raise", "delay", "sigterm"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.site = site
+        self.mode = mode
+        self.arg = arg
+        self.action = action
+        self.action_arg = action_arg
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        text = text.strip()
+        head, sep, action = text.partition(":")
+        if not sep:
+            raise ValueError(
+                f"fault directive {text!r} is missing ':action'")
+        if "@" in head:
+            site, _, trig = head.partition("@")
+            if trig.endswith("+"):
+                mode, arg = "from", int(trig[:-1])
+            else:
+                mode, arg = "at", int(trig)
+            if arg < 1:
+                raise ValueError(
+                    f"visit index must be >= 1 in {text!r}")
+        elif "~" in head:
+            site, _, trig = head.partition("~")
+            mode, arg = "prob", float(trig)
+            if not 0.0 <= arg <= 1.0:
+                raise ValueError(
+                    f"probability must be in [0, 1] in {text!r}")
+        else:
+            raise ValueError(
+                f"fault directive {text!r} needs '@N', '@N+' or '~P'")
+        kind, _, val = action.partition("=")
+        kind = kind.strip()
+        if kind == "raise":
+            return cls(site.strip(), mode, arg, "raise",
+                       _resolve_exception(val.strip()))
+        if kind == "delay":
+            return cls(site.strip(), mode, arg, "delay", float(val))
+        if kind == "sigterm":
+            return cls(site.strip(), mode, arg, "sigterm")
+        raise ValueError(f"unknown fault action in {text!r}")
+
+    def fires(self, visit: int, rng: random.Random) -> bool:
+        if self.mode == "at":
+            return visit == int(self.arg)
+        if self.mode == "from":
+            return visit >= int(self.arg)
+        return rng.random() < self.arg
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        trig = {"at": f"@{int(self.arg)}", "from": f"@{int(self.arg)}+",
+                "prob": f"~{self.arg}"}[self.mode]
+        return f"FaultSpec({self.site}{trig}:{self.action})"
+
+
+class FaultPlan:
+    """A deterministic set of :class:`FaultSpec` directives.
+
+    Visit counters are per-site and process-global for the plan's
+    lifetime; probabilistic triggers draw from a per-spec ``Random``
+    seeded from ``(seed, spec index)``, so the same plan + seed fires
+    at the same visits on every run — chaos tests are reproducible.
+    """
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self._visits: Dict[str, int] = {}
+        self._rngs = [random.Random((self.seed + 1) * 1000003 + i)
+                      for i in range(len(self.specs))]
+        self._lock = threading.Lock()
+        #: (site, action) -> times fired, for test assertions
+        self.fired: Dict[Tuple[str, str], int] = {}
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        specs = [FaultSpec.parse(part)
+                 for part in text.split(";") if part.strip()]
+        if not specs:
+            raise ValueError(f"empty fault plan: {text!r}")
+        return cls(specs, seed=seed)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        text = os.environ.get(FAULTS_ENV, "").strip()
+        if not text:
+            return None
+        seed = int(os.environ.get(FAULT_SEED_ENV, "0"))
+        return cls.parse(text, seed=seed)
+
+    def visits(self, site: str) -> int:
+        with self._lock:
+            return self._visits.get(site, 0)
+
+    def visit(self, site: str):
+        """Count one visit of ``site`` and run any triggered actions.
+
+        The trigger decision happens under the plan lock (deterministic
+        counters even with background ingest threads); the action runs
+        outside it — a raise must not leave the lock held, and a delay
+        must not serialize unrelated sites.
+        """
+        actions = []
+        with self._lock:
+            visit = self._visits.get(site, 0) + 1
+            self._visits[site] = visit
+            for i, spec in enumerate(self.specs):
+                if spec.site == site and spec.fires(visit, self._rngs[i]):
+                    actions.append(spec)
+                    key = (site, spec.action)
+                    self.fired[key] = self.fired.get(key, 0) + 1
+        for spec in actions:
+            _counter("resilience_faults_injected_total").inc()
+            if spec.action == "delay":
+                time.sleep(spec.action_arg)
+            elif spec.action == "sigterm":
+                # a REAL signal, not a flag: the installed handler
+                # (resilience/checkpoint.py) must prove it turns an
+                # asynchronous SIGTERM into a flush + clean Preempted
+                import signal
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:
+                raise spec.action_arg(
+                    f"injected fault at {site} (visit {visit})")
+
+
+#: the installed plan; ``None`` = injection disabled (the hot-path
+#: fast case: fault_point is one load + None check)
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def uninstall():
+    global _PLAN
+    _PLAN = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def install_from_env() -> Optional[FaultPlan]:
+    """Install the ``PYABC_TPU_FAULTS`` plan, if the variable is set.
+    Called once at package import so subprocess chaos tests need no
+    code — just the environment variable."""
+    plan = FaultPlan.from_env()
+    if plan is not None:
+        install(plan)
+    return plan
+
+
+def fault_point(site: str):
+    """The hook every instrumented chokepoint calls.  No-op (one global
+    load + ``None`` check) unless a plan is installed."""
+    plan = _PLAN
+    if plan is None:
+        return
+    plan.visit(site)

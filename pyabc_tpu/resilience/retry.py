@@ -1,0 +1,212 @@
+"""Retry with exponential backoff + jitter for the device hot loop.
+
+Every device dispatch (``Sampler._dispatch``, the fused/pipelined block
+dispatches in smc.py) and the d2h chokepoint (``sampler.base
+.fetch_to_host``) route through :meth:`RetryPolicy.call` — enforced by
+the ``tools/check_retry_sites.py`` lint, the same way
+``check_wire_chokepoint.py`` enforces the wire chokepoint.  A transient
+failure (relay drop, preempted remote runtime, locked sqlite, dead
+executor) is retried a bounded number of times with exponential backoff
+and seeded jitter; a fatal error (shape/type bugs, donated-buffer
+reuse) raises immediately.
+
+When the budget is exhausted on a *transient* error the wrapper raises
+:class:`RetryExhausted` — the orchestrator's graceful-degradation
+signal: the sequential path drops the sampler one batch rung
+(``VectorizedSampler.degrade_rung``, the ``nd*2^k`` ladder on
+``ShardedSampler``) and restarts the generation, the fused engine
+disables itself for the rest of the run, and the pipelined ingest path
+falls back to the sequential loop (smc.py).
+
+Every retry feeds the telemetry registry
+(``resilience_retries_total`` + a per-site counter) and emits a
+``retry.backoff`` span, so chaos runs are machine-readable in the bench
+JSON and heartbeats.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+
+from .faults import fault_point
+
+logger = logging.getLogger("ABC.Resilience")
+
+_HELP = "retry ledger; see pyabc_tpu/resilience/retry.py"
+
+RETRIES_ENV = "PYABC_TPU_RETRIES"
+RETRY_BASE_ENV = "PYABC_TPU_RETRY_BASE_S"
+
+
+def _counter(name: str):
+    # create-or-return each call (wire/transfer.py idiom): survives
+    # REGISTRY.reset() in tests
+    from ..telemetry.metrics import REGISTRY
+    return REGISTRY.counter(name, _HELP)
+
+
+class RetryExhausted(RuntimeError):
+    """A retry-wrapped site kept failing transiently until the attempt
+    budget ran out.  Carries the site and attempt count; the last
+    transient error is chained as ``__cause__``."""
+
+    def __init__(self, site: str, attempts: int):
+        super().__init__(
+            f"{site} still failing after {attempts} attempts")
+        self.site = site
+        self.attempts = attempts
+
+
+#: OSError subclasses that mean a *caller* bug, not infrastructure
+_FATAL_OSERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                   NotADirectoryError, FileExistsError)
+
+#: XLA runtime status markers that mean the backend (not the program)
+#: failed — the retryable subset of absl status codes plus the relay's
+#: connection-level failure strings
+_TRANSIENT_XLA_MARKERS = ("unavailable", "deadline", "resource_exhausted",
+                          "aborted", "cancelled", "internal", "connection",
+                          "socket", "preempt")
+
+
+def is_transient(err: BaseException, _depth: int = 0) -> bool:
+    """Transient (infrastructure, worth retrying) vs fatal (program
+    bug, raise immediately) classification.
+
+    A donated-buffer error is always fatal: the failed attempt already
+    consumed its input buffers, so re-running the same dispatch can
+    only produce a second, more confusing error.
+    """
+    msg = str(err).lower()
+    if "donat" in msg or "buffer has been deleted" in msg:
+        return False
+    from concurrent.futures import BrokenExecutor
+    if isinstance(err, BrokenExecutor):
+        return True
+    if isinstance(err, (ConnectionError, TimeoutError, InterruptedError)):
+        return True
+    import sqlite3
+    if isinstance(err, sqlite3.OperationalError):
+        return ("locked" in msg or "busy" in msg or "disk i/o" in msg)
+    if isinstance(err, OSError):
+        return not isinstance(err, _FATAL_OSERRORS)
+    # jaxlib's XlaRuntimeError without importing jaxlib: match by name
+    # across the class hierarchy (the relay backend subclasses it)
+    type_names = {c.__name__ for c in type(err).__mro__}
+    if "XlaRuntimeError" in type_names or "JaxRuntimeError" in type_names:
+        return any(k in msg for k in _TRANSIENT_XLA_MARKERS)
+    if "WireError" in type_names:
+        # the streaming engine's wrapper: transient iff its cause is
+        # (a bare WireError is a transfer failure — transient)
+        cause = err.__cause__
+        return True if cause is None else is_transient(cause, _depth + 1)
+    cause = err.__cause__
+    if cause is not None and cause is not err and _depth < 4:
+        return is_transient(cause, _depth + 1)
+    return False
+
+
+class RetryPolicy:
+    """Bounded retry with exponential backoff and seeded jitter.
+
+    ``max_attempts`` counts total tries (1 = no retries).  The backoff
+    before try ``k`` (k >= 2) is ``min(max_delay_s, base_delay_s *
+    2^(k-2)) * (1 + jitter * u)`` with ``u ~ U[0, 1)`` from a seeded,
+    lock-protected RNG — deterministic in tests, thread-safe under the
+    streaming-ingest workers.
+    """
+
+    def __init__(self, max_attempts: int = 3, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0, jitter: float = 0.5,
+                 seed: int = 0):
+        self.max_attempts = max(int(max_attempts), 1)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        """Policy from ``PYABC_TPU_RETRIES`` (total attempts, default 3)
+        and ``PYABC_TPU_RETRY_BASE_S`` (first backoff, default 0.05)."""
+        try:
+            attempts = int(os.environ.get(RETRIES_ENV, "3"))
+        except ValueError:
+            attempts = 3
+        try:
+            base = float(os.environ.get(RETRY_BASE_ENV, "0.05"))
+        except ValueError:
+            base = 0.05
+        return cls(max_attempts=attempts, base_delay_s=base)
+
+    def delay_s(self, failures: int) -> float:
+        """Backoff after ``failures`` consecutive failures (>= 1)."""
+        with self._lock:
+            u = self._rng.random()
+        base = min(self.max_delay_s,
+                   self.base_delay_s * (2.0 ** (failures - 1)))
+        return base * (1.0 + self.jitter * u)
+
+    def call(self, fn, site: str, *args, **kwargs):
+        """Run ``fn(*args, **kwargs)`` under this policy at ``site``.
+
+        The fault point fires at the START of each attempt — before the
+        dispatch runs — so injected faults never land after a
+        buffer-donating program has consumed its inputs (retrying would
+        then hit a fatal donation error instead of testing the retry).
+        """
+        from ..telemetry import spans
+        failures = 0
+        while True:
+            try:
+                fault_point(site)
+                return fn(*args, **kwargs)
+            except Exception as err:
+                if isinstance(err, RetryExhausted) or not is_transient(err):
+                    raise
+                failures += 1
+                _counter("resilience_retries_total").inc()
+                _counter("resilience_retry_"
+                         + site.replace(".", "_")).inc()
+                if failures >= self.max_attempts:
+                    raise RetryExhausted(site, failures) from err
+                backoff = self.delay_s(failures)
+                logger.warning(
+                    "transient failure at %s (%s: %s) — retry %d/%d in "
+                    "%.3gs", site, type(err).__name__, err, failures,
+                    self.max_attempts - 1, backoff)
+                with spans.span("retry.backoff", site=site,
+                                attempt=failures):
+                    time.sleep(backoff)
+
+
+_SHARED: RetryPolicy = None
+
+
+def shared_policy() -> RetryPolicy:
+    """The process-global policy used by module-level chokepoints that
+    have no sampler/orchestrator instance to hang one on
+    (``fetch_to_host``, ``History.append_population``)."""
+    global _SHARED
+    if _SHARED is None:
+        _SHARED = RetryPolicy.from_env()
+    return _SHARED
+
+
+def record_degrade():
+    """Count one graceful-degradation step (batch-rung drop or engine
+    fallback) in the telemetry registry."""
+    _counter("resilience_degrade_total").inc()
+
+
+def retry_counters() -> dict:
+    """The resilience ledger as plain numbers (bench / heartbeats)."""
+    from ..telemetry.metrics import REGISTRY
+    snap = REGISTRY.to_dict()
+    return {k: v for k, v in snap.items()
+            if k.startswith("resilience_")}

@@ -110,6 +110,8 @@ def fetch_to_host(tree):
 
     import jax
 
+    from ..resilience import faults as _faults
+    from ..resilience import retry as _retry
     from ..telemetry import spans
     from ..wire import transfer
 
@@ -130,11 +132,21 @@ def fetch_to_host(tree):
         return np.asarray(multihost_utils.process_allgather(leaf,
                                                             tiled=True))
     import jax.tree_util as tu
-    with spans.span("wire.fetch") as sp, transfer.timed_d2h() as timer:
-        out = jax.device_get(tu.tree_map(get, tree))
-    out = timer.commit(out)
-    sp.set(nbytes=transfer._tree_nbytes(out))
-    return out
+
+    def _fetch():
+        # one attempt: transfer + ledger commit; failed attempts charge
+        # nothing to the byte counters (commit only runs on success)
+        with spans.span("wire.fetch") as sp, transfer.timed_d2h() as timer:
+            out = jax.device_get(tu.tree_map(get, tree))
+        out = timer.commit(out)
+        sp.set(nbytes=transfer._tree_nbytes(out))
+        return out
+
+    # the d2h retry chokepoint: transient link failures (relay drops,
+    # preempted remote runtimes) back off and re-pull through the SAME
+    # path on every caller — sampler loops and background ingest
+    # workers alike (tools/check_retry_sites.py)
+    return _retry.shared_policy().call(_fetch, _faults.SITE_FETCH)
 
 
 def widen_wire(out: dict, take: int) -> dict:
@@ -359,6 +371,27 @@ class Sample:
         take = min(count, out["theta"].shape[0])
         if take:
             self._acc.append(widen_wire(out, take))
+
+    def splice_front(self, batch: dict, nr_evaluations: int):
+        """Prepend rows restored from a mid-generation sub-checkpoint
+        (resilience/checkpoint.py): the preempted process flushed them
+        in round order BEFORE any row of this sample was drawn, so
+        front insertion preserves the deterministic round-order
+        truncation contract.  Evaluation counts add exactly (the
+        flushed rounds ran once, in the killed process; this process
+        never re-ran them), and the raw log-weights normalize together
+        in :meth:`get_accepted_population` — both halves are draws from
+        the same proposal at the same eps, so the spliced population is
+        statistically identical to an uninterrupted one."""
+        self.resolve_pending()
+        self._acc.insert(0, batch)
+        self.nr_evaluations += int(nr_evaluations)
+        self.raw_accepted += int(batch["m"].shape[0])
+        # the device-resident view covers only this process's rows —
+        # it no longer represents the whole generation, so device
+        # consumers (fused carry, device transition fits) must rebuild
+        # from the host population
+        self.device_population = None
 
     def append_record_batch(self, rec: dict):
         """Ingest one per-call record harvest (``rec_*`` buffers + count)
@@ -609,6 +642,24 @@ class Sampler:
         #: smc.py:1009-1010 first_m_particles)
         self.max_records = 1 << 21
         self.sample_factory = self  # reference-compat alias
+        #: bounded-backoff retry policy every device dispatch routes
+        #: through (:meth:`_dispatch`; resilience/retry.py)
+        from ..resilience.retry import RetryPolicy
+        self._retry = RetryPolicy.from_env()
+        #: mid-generation sub-checkpoint sink, set by the sequential
+        #: run path for the duration of one generation
+        #: (resilience/checkpoint.py GenCheckpointer); None = disabled
+        self.checkpointer = None
+
+    def _dispatch(self, fn, *args):
+        """THE device-dispatch chokepoint: every compiled-program call
+        in a sampler loop goes through here so transient backend
+        failures retry with backoff and injected faults have one
+        deterministic site (``device.dispatch``).  Enforced by the
+        tools/check_retry_sites.py lint, like check_wire_chokepoint.py
+        enforces the d2h chokepoint."""
+        from ..resilience.faults import SITE_DISPATCH
+        return self._retry.call(fn, SITE_DISPATCH, *args)
 
     def sample_until_n_accepted(
             self, n: int,

@@ -23,6 +23,7 @@ import time
 import jax
 import numpy as np
 
+from ..resilience import retry as _retry
 from .base import Sample, fetch_to_host
 
 logger = logging.getLogger("ABC.Sampler")
@@ -83,6 +84,11 @@ class EPSMixin:
     #: redis_eps/cli.py:141-145 which only warns per failure)
     max_consecutive_failures: int = 64
 
+    #: resubmissions of the SAME batch after a transient infrastructure
+    #: failure (resilience/retry.py classification) before it is written
+    #: off as a genuine model failure
+    max_transient_retries: int = 3
+
     def sample_until_n_accepted(self, n, round_fn, key, params,
                                 max_eval=np.inf, all_accepted=False,
                                 **kwargs) -> Sample:
@@ -102,7 +108,12 @@ class EPSMixin:
         in_flight = {}
         results = {}
         harvested = 0  # next submission id to account
+        #: simulation budget charges UNIQUE dispatched batches, not
+        #: attempts — a transiently-failed batch that is resubmitted and
+        #: succeeds counts once (through the Sample), and only a batch
+        #: written off for good charges failed_evals
         failed_evals = 0
+        seed_retries = {}
         consecutive_failures = 0
         bar = None
         if getattr(self, "show_progress", False):
@@ -131,13 +142,8 @@ class EPSMixin:
                     seed, rr = done.result()
                     consecutive_failures = 0
                 except Exception as err:  # model error or dead worker
-                    seed = in_flight[done]
-                    rr = None
-                    failed_evals += B
+                    seed = in_flight.pop(done)
                     consecutive_failures += 1
-                    logger.warning(
-                        "batch %d failed (%s: %s) — discarded, continuing "
-                        "with fresh work", seed, type(err).__name__, err)
                     if consecutive_failures > self.max_consecutive_failures:
                         raise RuntimeError(
                             f"{consecutive_failures} consecutive batch "
@@ -145,16 +151,41 @@ class EPSMixin:
                             "broken") from err
                     if self._is_broken_backend(err):
                         # in-flight futures all died with the backend —
-                        # drop them and resubmit their seeds after recovery
+                        # drop them and resubmit their seeds (the dying
+                        # one included: its simulations never ran, so a
+                        # retry is an attempt, not a new batch — no
+                        # failed_evals charge) after recovery
                         if not self._recover():
                             raise
-                        lost = sorted(s for s in in_flight.values()
-                                      if s != seed)
+                        lost = sorted(set(in_flight.values()) | {seed})
                         in_flight = {}
                         for s in lost:
                             in_flight[self._submit(eval_batch, s)] = s
-                        results[seed] = None
+                        logger.warning(
+                            "backend died under batch %d (%s: %s) — "
+                            "rebuilt, %d batches resubmitted", seed,
+                            type(err).__name__, err, len(lost))
                         continue
+                    retries = seed_retries.get(seed, 0)
+                    if (_retry.is_transient(err)
+                            and retries < self.max_transient_retries):
+                        # transient infrastructure failure: same batch,
+                        # new attempt — unique dispatched batches are
+                        # charged once, attempts are not
+                        seed_retries[seed] = retries + 1
+                        in_flight[self._submit(eval_batch, seed)] = seed
+                        logger.warning(
+                            "batch %d failed transiently (%s: %s) — "
+                            "resubmitted (attempt %d/%d)", seed,
+                            type(err).__name__, err, retries + 1,
+                            self.max_transient_retries)
+                        continue
+                    failed_evals += B
+                    logger.warning(
+                        "batch %d failed (%s: %s) — discarded, continuing "
+                        "with fresh work", seed, type(err).__name__, err)
+                    results[seed] = None
+                    continue
                 del in_flight[done]
                 results[seed] = rr
         finally:

@@ -34,7 +34,9 @@ import numpy as np
 
 from ..autotune import (BatchAutotuner, CompiledLadder, aot_compile,
                         avals_like, jit_compile)
-from .base import Sample, Sampler, SamplingError, fetch_to_host
+from ..resilience import faults as _faults
+from ..resilience import retry as _retry
+from .base import Sample, Sampler, SamplingError, fetch_to_host, widen_wire
 from .device_loop import build_stateful_loop
 
 logger = logging.getLogger("ABC.Sampler")
@@ -223,6 +225,26 @@ class VectorizedSampler(Sampler):
         return int(np.clip(_pow2_at_least(b), self.min_batch_size,
                            self.max_batch_size))
 
+    def degrade_rung(self):
+        """Graceful degradation after a retry-exhausted dispatch
+        failure (resilience/retry.py): halve the batch ceiling one rung
+        — the pow2 ladder here, the ``nd*2^k`` ladder on
+        :class:`ShardedSampler` via its ``_round_to_valid_batch``
+        override — so a device/memory-pressure failure mode gets a
+        strictly smaller program on the restart.  Returns the new
+        ceiling, or None when already at the floor (caller re-raises).
+        Cached carry states of the old rung simply age out of the
+        bounded ``_states`` cache."""
+        if self.max_batch_size <= self.min_batch_size:
+            return None
+        self.max_batch_size = max(self.max_batch_size // 2,
+                                  self.min_batch_size)
+        _retry.record_degrade()
+        logger.warning(
+            "degrading batch ceiling to %d after repeated dispatch "
+            "failure", self.max_batch_size)
+        return self.max_batch_size
+
     #: finalize-prefetch budget for DEFERRED mode: a mispredicted prefetch
     #: pays (and discards) the proposal-density KDE over the accepted
     #: buffer, so prefetch only when that costs well under a relay
@@ -282,7 +304,7 @@ class VectorizedSampler(Sampler):
             while sample.n_accepted < n:
                 key, sub = jax.random.split(key)
                 before = sample.n_accepted
-                sample.append_round(fn(sub, params))
+                sample.append_round(self._dispatch(fn, sub, params))
                 zero_rounds = (zero_rounds + 1
                                if sample.n_accepted == before else 0)
                 if zero_rounds >= 3:  # model fails on EVERY draw: abort
@@ -351,7 +373,8 @@ class VectorizedSampler(Sampler):
         # run on a synchronous XLA compile
         self._prewarm_next_rung(round_fn, n, B, loop_extra, key, params)
         prev_state = self._states.pop(loop_key, None)
-        state = start() if prev_state is None else reset(prev_state)
+        state = (self._dispatch(start) if prev_state is None
+                 else self._dispatch(reset, prev_state))
         # defer_wire_fetch: leave the big wire payload device-resident
         # (only the count/rounds scalars sync) so a streaming-ingest
         # engine (wire/) can overlap the fetch with the next
@@ -363,6 +386,10 @@ class VectorizedSampler(Sampler):
         count = rounds = 0
         out = None
         while True:
+            # the preemption probe: a `preempt@K:sigterm` fault plan
+            # delivers a real SIGTERM here, deterministically
+            # mid-generation (resilience/faults.py)
+            _faults.fault_point(_faults.SITE_PREEMPT)
             key, sub = jax.random.split(key)
             # ONE host transfer per call.  When this call is expected to
             # finish the generation (the common single-call case) the
@@ -377,7 +404,8 @@ class VectorizedSampler(Sampler):
             expected = count + B * self.max_rounds_per_call * self._rate_est
             out = out_dev = rec = None
             if expected >= n and prefetch_ok and not record_cap:
-                state, wire_dev, out_dev = step_finalize(sub, params, state)
+                state, wire_dev, out_dev = self._dispatch(
+                    step_finalize, sub, params, state)
                 if defer_wire:
                     scalars = fetch_to_host([wire_dev["count"],
                                              wire_dev["rounds"]])
@@ -387,18 +415,19 @@ class VectorizedSampler(Sampler):
                     out = fetch_to_host(wire_dev)
                     count, rounds = int(out["count"]), int(out["rounds"])
             else:
-                state = step(sub, params, state)
+                state = self._dispatch(step, sub, params, state)
                 if record_cap:
                     # records are harvested + reset every call: the
                     # device buffer bounds one call, max_records bounds
                     # the whole generation (reference first-m-particles
                     # accounting); the arrays stay device-resident
                     # (Sample materializes only what consumers read)
-                    rec, state = harvest(state)
+                    rec, state = self._dispatch(harvest, state)
                     if record_density_fn is not None:
                         rec["record_density_fn"] = record_density_fn
                 if expected >= n and prefetch_ok:
-                    wire_dev, out_dev = finalize(state, params)
+                    wire_dev, out_dev = self._dispatch(
+                        finalize, state, params)
                     fetch = [wire_dev]
                     if rec is not None:
                         fetch.append(rec["rec_count"])
@@ -425,6 +454,20 @@ class VectorizedSampler(Sampler):
                 logger.info(
                     "call %d: %d/%d accepted (B=%d, %d rounds, rate=%.3g)",
                     call_idx, count, n, B, rounds, rate_obs)
+            ck = self.checkpointer
+            if ck is not None and count < n:
+                if ck.should_flush(rounds):
+                    # flush the CUMULATIVE accepted ledger: finalize is
+                    # not buffer-donating, so a mid-loop call leaves the
+                    # carry intact for the rounds that follow
+                    wire_ck, _ = self._dispatch(finalize, state, params)
+                    out_ck = fetch_to_host(wire_ck)
+                    take = min(count, out_ck["theta"].shape[0])
+                    ck.flush(widen_wire(out_ck, take), rounds=rounds,
+                             nr_evaluations=rounds * B)
+                # the ledger is durable: a preemption signal now exits
+                # cleanly (Preempted) instead of racing the kill timeout
+                ck.maybe_raise_preempted()
             if count >= n:
                 break
             if rounds * B >= max_eval:
@@ -435,7 +478,7 @@ class VectorizedSampler(Sampler):
                 break
             out = out_dev = pending = None  # mis-predicted prefetch: discard
         if out is None and pending is None:
-            wire_dev, out_dev = finalize(state, params)
+            wire_dev, out_dev = self._dispatch(finalize, state, params)
             if defer_wire:
                 pending = (wire_dev, out_dev)
             else:

@@ -32,6 +32,9 @@ from .epsilon import Epsilon, MedianEpsilon, TemperatureBase
 from .model import Model, SimpleModel
 from .parallel.health import stop_requested
 from .population import Population
+from .resilience import checkpoint as _ckpt
+from .resilience import faults as _faults
+from .resilience import retry as _retry
 from .populationstrategy import ConstantPopulationSize, PopulationStrategy
 from .random_variables import Distribution, ModelPerturbationKernel
 from .sampler.base import Sample, Sampler
@@ -109,6 +112,7 @@ class ABCSMC:
                  ingest_depth: int = 2,
                  trace_path: Optional[str] = None,
                  compile_cache: Optional[str] = None,
+                 checkpoint_every_rounds: Optional[int] = None,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -193,6 +197,21 @@ class ABCSMC:
         #: off.  Armed here so every program this instance compiles —
         #: calibration included — can be served warm on the next run.
         self.compile_cache_dir = configure_compile_cache(compile_cache)
+        #: mid-generation sub-checkpoint cadence (resilience/checkpoint):
+        #: flush the accepted ledger every N device rounds on the
+        #: sequential path; 0 disables.  None defers to
+        #: $PYABC_TPU_CKPT_ROUNDS.
+        self.checkpoint_every_rounds = (
+            _ckpt.default_every_rounds() if checkpoint_every_rounds is None
+            else max(int(checkpoint_every_rounds), 0))
+        #: bounded-backoff retry for the orchestrator's own dispatches
+        #: (fused blocks, pipelined blocks); sampler dispatches carry
+        #: their own policy (sampler/base.py)
+        self._retry = _retry.RetryPolicy.from_env()
+        #: degradation latches: a retry-exhausted fused/pipelined
+        #: dispatch permanently drops this instance to the simpler path
+        self._fault_fused_off = False
+        self._fault_sequential_only = False
         # mirror XLA compile events into the xla_* registry counters
         # (timeline compile_s/n_compiles columns, bench compile rows,
         # the zero-recompile tier-1 assertion)
@@ -486,6 +505,8 @@ class ABCSMC:
         transactions) — transfer dominates there and fusion has no
         headroom.  Cap at 2^17 particles; above it the overlapped
         ingest pipeline (wire/) is the scaling lever instead."""
+        if self._fault_fused_off:
+            return False  # degraded after a retry-exhausted block dispatch
         if self.fuse_generations < 2:
             return False
         if self.population_strategy(0) > (1 << 17):
@@ -503,6 +524,8 @@ class ABCSMC:
         identical to the pre-wire path.  "overlap" whenever the device
         chain is eligible (warns + falls back otherwise).  "auto"
         additionally requires a transfer-bound population size."""
+        if self._fault_sequential_only:
+            return False  # degraded after a pipelined dispatch failure
         if self.ingest_mode == "sequential":
             return False
         if not self._device_chain_eligible():
@@ -630,9 +653,20 @@ class ABCSMC:
             "eps": jnp.float32(self.eps(t) if eps_mode == "constant"
                                else 0.0),
         }
-        with profile_generation(t), \
-                _spans.span("fused.dispatch", gen=t, k=K):
-            carry_out, wires = fn(carry_in, self._split())
+        try:
+            with profile_generation(t), \
+                    _spans.span("fused.dispatch", gen=t, k=K):
+                carry_out, wires = self._retry.call(
+                    fn, _faults.SITE_DISPATCH, carry_in, self._split())
+        except _retry.RetryExhausted as err:
+            # the carry is NOT donated, so the inputs survived every
+            # failed attempt — degrade to the per-generation sequential
+            # path for the rest of this run and redo t there
+            logger.warning(
+                "fused block dispatch failed after retries (%s): "
+                "disabling generation fusion for this run", err)
+            self._fault_fused_off = True
+            return 0, 0, None
         dispatch_s = _time.perf_counter() - t0_block
         # ONE transaction for all K gens, split + widened through the
         # SHARED wire decoder (wire/ingest.py)
@@ -864,7 +898,11 @@ class ABCSMC:
             disp_mark = _time.perf_counter()
             with profile_generation(t_d), \
                     _spans.span("pipeline.dispatch", gen=t_d, k=K):
-                carry_out, wires = fn(carry_in, self._split())
+                # RetryExhausted propagates to _run_master, which falls
+                # back to the sequential path and resumes from the
+                # History (everything durable is per-generation there)
+                carry_out, wires = self._retry.call(
+                    fn, _faults.SITE_DISPATCH, carry_in, self._split())
                 ticket = ingest.submit(
                     lambda: split_block_wire(fetch_to_host(wires), K, n),
                     label=f"block@t={t_d}")
@@ -1358,11 +1396,28 @@ class ABCSMC:
             # compute runs while gen t's fetch + decode drain in the
             # background; the classic loop below stays byte-identical
             # for ingest_mode="sequential" (and for ineligible configs)
-            self._run_pipelined(t0, t_max, max_total_nr_simulations)
+            try:
+                self._run_pipelined(t0, t_max, max_total_nr_simulations)
+            except _retry.RetryExhausted as err:
+                # everything durable is per-generation: drop to the
+                # sequential path and resume from the History frontier
+                logger.warning(
+                    "pipelined dispatch failed after retries (%s): "
+                    "falling back to the sequential ingest path", err)
+                self._fault_sequential_only = True
+                self._fused_carry = None
+                return self._run_master(
+                    minimum_epsilon, max_nr_populations,
+                    min_acceptance_rate, max_total_nr_simulations)
             self.history.done()
             return self.history
 
         fused_ok = self._fused_eligible()
+        ckpt_every = self.checkpoint_every_rounds
+        if ckpt_every:
+            # SIGTERM -> flag; the sampler flushes its ledger at the
+            # next device-call boundary and raises Preempted
+            _ckpt.install_signal_handlers()
         while t < t_max:
             # operator clean-stop (abc-distributed-manager stop): exit
             # between generations, like the reference's Redis STOP message
@@ -1371,10 +1426,16 @@ class ABCSMC:
             if stop_requested():
                 logger.info("Stopping: operator stop requested")
                 break
+            if _ckpt.preempt_requested():
+                # signal arrived between generations: nothing in flight,
+                # the History frontier is already durable
+                logger.info("Stopping: preemption requested (SIGTERM)")
+                break
             # enter a fused block only when ALL K generations fit before
             # t_max — the compiled program always executes K, so a tail
             # block would burn device work on discarded generations
-            if fused_ok and self._fused_carry is not None \
+            if fused_ok and not self._fault_fused_off \
+                    and self._fused_carry is not None \
                     and t + self.fuse_generations <= t_max:
                 written, sims, stop_reason = self._run_fused_block(
                     t, t_max, total_sims, max_total_nr_simulations)
@@ -1409,10 +1470,36 @@ class ABCSMC:
                 params["transition"] = self._trans_params
 
             logger.info("t: %d, eps: %.8g", t, current_eps)
+            # resume splice: rows a preempted previous process flushed
+            # for THIS generation (only meaningful at the resume
+            # frontier — later generations never left a ledger)
+            splice = (self._load_splice(t, current_eps)
+                      if ckpt_every and t == t0 else None)
+            n_req = n - (splice["n_accepted"] if splice else 0)
             sample_mark = _time.perf_counter()
-            with profile_generation(t), _spans.span("gen.sample", gen=t):
-                sample = self.sampler.sample_until_n_accepted(
-                    n, round_fn, self._split(), params, max_eval=max_eval)
+            if ckpt_every:
+                ck = _ckpt.GenCheckpointer(self.history, t, ckpt_every,
+                                           eps=current_eps)
+                if splice:
+                    ck.set_base(splice["batch"], splice["nr_evaluations"])
+                self.sampler.checkpointer = ck
+            try:
+                with profile_generation(t), \
+                        _spans.span("gen.sample", gen=t):
+                    if n_req > 0:
+                        sample = self._sample_generation(
+                            n_req, round_fn, params, max_eval)
+                    else:
+                        sample = Sample()  # the splice already covers n
+            finally:
+                self.sampler.checkpointer = None
+            if splice is not None:
+                # both halves are draws from the same proposal at the
+                # same eps; weight normalization happens once over the
+                # concatenated rows (get_accepted_population), so the
+                # spliced population is statistically exact
+                sample.splice_front(splice["batch"],
+                                    splice["nr_evaluations"])
             sample_s = _time.perf_counter() - sample_mark
             if sample.n_accepted < n:
                 logger.info(
@@ -1504,6 +1591,65 @@ class ABCSMC:
 
         self.history.done()
         return self.history
+
+    #: generation restarts allowed under graceful degradation before a
+    #: retry-exhausted dispatch failure is considered fatal
+    _MAX_GEN_RESTARTS = 2
+
+    def _sample_generation(self, n_req: int, round_fn, params,
+                           max_eval) -> Sample:
+        """One generation's sampling with graceful degradation: a
+        retry-exhausted device dispatch drops the sampler one batch
+        rung (``degrade_rung``) and restarts the generation on a fresh
+        key — a strictly smaller program for a device/memory-pressure
+        failure mode.  At the rung floor (or after ``_MAX_GEN_RESTARTS``
+        restarts) the error propagates.  An abandoned attempt's
+        evaluations are NOT counted: its Sample is discarded before the
+        caller reads ``nr_evaluations`` (documented in
+        docs/resilience.md — the budget charges durable work only)."""
+        restarts = 0
+        while True:
+            try:
+                return self.sampler.sample_until_n_accepted(
+                    n_req, round_fn, self._split(), params,
+                    max_eval=max_eval)
+            except _retry.RetryExhausted as err:
+                degrade = getattr(self.sampler, "degrade_rung", None)
+                if degrade is None or restarts >= self._MAX_GEN_RESTARTS:
+                    raise
+                new_cap = degrade()
+                if new_cap is None:
+                    raise  # already at the floor
+                restarts += 1
+                logger.warning(
+                    "generation dispatch failed after retries (%s): "
+                    "restarting with batch ceiling %d (restart %d/%d)",
+                    err, new_cap, restarts, self._MAX_GEN_RESTARTS)
+
+    def _load_splice(self, t: int, current_eps: float):
+        """Load (and validate) the sub-checkpoint ledger a preempted
+        previous process flushed for generation ``t``.  The splice is
+        only statistically exact when this process derived the SAME eps
+        — the schedule is deterministic from the last durable
+        generation, so a mismatch only happens in edge cases like a t=0
+        re-calibration; the stale ledger is discarded then."""
+        row = self.history.load_sub_checkpoint(t)
+        if row is None:
+            return None
+        eps_ck = row.get("eps")
+        if eps_ck is not None and not np.isclose(
+                float(eps_ck), float(current_eps), rtol=1e-6, atol=1e-12):
+            logger.warning(
+                "discarding the sub-checkpoint for t=%d: its eps %.8g "
+                "does not match the derived schedule (%.8g)",
+                t, eps_ck, current_eps)
+            self.history.clear_sub_checkpoint(t)
+            return None
+        logger.info(
+            "resuming generation %d from a sub-checkpoint: %d accepted "
+            "rows (%d rounds, %d evaluations) survived the preemption",
+            t, row["n_accepted"], row["rounds"], row["nr_evaluations"])
+        return row
 
     # ------------------------------------------------------------------
     # per-generation adaptation (reference smc.py:960-1040)

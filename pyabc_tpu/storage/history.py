@@ -87,6 +87,21 @@ CREATE TABLE IF NOT EXISTS observed_data (
     tag TEXT DEFAULT 'npy',
     PRIMARY KEY (abc_smc_id, key)
 );
+CREATE TABLE IF NOT EXISTS sub_checkpoints (
+    abc_smc_id INTEGER,
+    t INTEGER,
+    rounds INTEGER,
+    n_accepted INTEGER,
+    nr_evaluations INTEGER,
+    eps REAL,
+    m BLOB,
+    theta BLOB,
+    distance BLOB,
+    log_weight BLOB,
+    stats BLOB,
+    created TEXT,
+    PRIMARY KEY (abc_smc_id, t)
+);
 """
 
 
@@ -205,7 +220,22 @@ class History:
         ``stat_spec`` maps sum-stat key -> shape; stored alongside the flat
         stats block so reads reconstruct keyed per-particle sum-stats
         (:meth:`get_sum_stats`) without a row-per-statistic table.
+
+        Every statement is INSERT OR REPLACE and the commit is the
+        durability point, so the write is idempotent — a transient
+        sqlite failure (locked / busy / disk I/O) is simply retried
+        through the shared policy (resilience/retry.py).
         """
+        from ..resilience import faults as _faults
+        from ..resilience import retry as _retry
+        _retry.shared_policy().call(
+            self._append_population_once, _faults.SITE_APPEND,
+            t, current_epsilon, population, nr_simulations, model_names,
+            param_names, stat_spec)
+
+    def _append_population_once(self, t, current_epsilon, population,
+                                nr_simulations, model_names,
+                                param_names=None, stat_spec=None):
         probs = np.asarray(population.get_model_probabilities(
             nr_models=len(model_names)))
         self._conn.execute(
@@ -238,6 +268,67 @@ class History:
                  json.dumps(list(names_m or [])),
                  json.dumps({k: list(v) for k, v in stat_spec.items()})
                  if stat_spec else None))
+        # the generation is durable in the same transaction, so its
+        # mid-generation ledger row (if any) is obsolete
+        self._conn.execute(
+            "DELETE FROM sub_checkpoints WHERE abc_smc_id=? AND t=?",
+            (self.id, t))
+        self._conn.commit()
+
+    # ---- mid-generation sub-checkpoints (resilience/checkpoint.py) -------
+
+    def save_sub_checkpoint(self, t: int, batch: Dict, rounds: int,
+                            nr_evaluations: int,
+                            eps: Optional[float] = None):
+        """REPLACE the round-granular accepted-particle ledger for
+        generation ``t``: the CUMULATIVE accepted rows through device
+        round ``rounds`` (``batch`` is a ``widen_wire``-shaped host
+        dict).  One row per generation — a crash between flushes loses
+        at most one flush interval, and :meth:`append_population`
+        deletes the row once the full generation is durable."""
+        from ..resilience import faults as _faults
+        from ..resilience import retry as _retry
+
+        def _write():
+            self._conn.execute(
+                "INSERT OR REPLACE INTO sub_checkpoints VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                (self.id, int(t), int(rounds),
+                 int(batch["m"].shape[0]), int(nr_evaluations),
+                 float(eps) if eps is not None else None,
+                 _pack(batch["m"]), _pack(batch["theta"]),
+                 _pack(batch["distance"]), _pack(batch["log_weight"]),
+                 _pack(batch["stats"]) if batch.get("stats") is not None
+                 else None,
+                 datetime.datetime.now().isoformat()))
+            self._conn.commit()
+
+        _retry.shared_policy().call(_write, _faults.SITE_APPEND)
+
+    def load_sub_checkpoint(self, t: int) -> Optional[Dict]:
+        """The flushed ledger for generation ``t``, or None.  Returns
+        ``{rounds, nr_evaluations, eps, n_accepted, batch}`` with the
+        batch in ``widen_wire`` layout, ready for
+        ``Sample.splice_front``."""
+        row = self._conn.execute(
+            "SELECT rounds, n_accepted, nr_evaluations, eps, m, theta,"
+            " distance, log_weight, stats FROM sub_checkpoints"
+            " WHERE abc_smc_id=? AND t=?", (self.id, int(t))).fetchone()
+        if row is None:
+            return None
+        batch = {"m": _unpack(row[4]), "theta": _unpack(row[5]),
+                 "distance": _unpack(row[6]), "log_weight": _unpack(row[7])}
+        if row[8] is not None:
+            batch["stats"] = _unpack(row[8])
+        return {"rounds": int(row[0]), "n_accepted": int(row[1]),
+                "nr_evaluations": int(row[2]),
+                "eps": float(row[3]) if row[3] is not None else None,
+                "batch": batch}
+
+    def clear_sub_checkpoint(self, t: int):
+        self._conn.execute(
+            "DELETE FROM sub_checkpoints WHERE abc_smc_id=? AND t=?",
+            (self.id, int(t)))
         self._conn.commit()
 
     # ---- queries (reference history.py:269-330, 732-780, 1004-1078) ------

@@ -259,6 +259,11 @@ def heartbeat_summary() -> dict:
         "overlap_s": round(tr["overlap_s"], 3),
         "rewinds": int(tr["rewinds"]),
         "ingest_inflight": int(d.get("wire_ingest_inflight", 0)),
+        # resilience ledger: non-zero retries/degrades on a healthy run
+        # are the early-warning signal `info` exists for
+        "retries": int(d.get("resilience_retries_total", 0)),
+        "degrades": int(d.get("resilience_degrade_total", 0)),
+        "checkpoints": int(d.get("resilience_checkpoints_total", 0)),
     }
 
 

@@ -110,8 +110,11 @@ def test_cfuture_recovers_from_broken_executor():
     sample = sampler.sample_until_n_accepted(
         6, round_fn, jax.random.PRNGKey(0), {})
     assert sample.n_accepted >= 6
-    # the broken batch counted as failed evaluations
-    assert sampler.nr_evaluations_ >= 6 + 2
+    # unique-batch accounting: the broken-executor batch never ran its
+    # simulations, so its RESUBMISSION is an attempt, not a new batch —
+    # no failed-evaluation surcharge on top of the successful rounds
+    assert sampler.nr_evaluations_ == sample.nr_evaluations
+    assert sampler.nr_evaluations_ >= 6
     sampler.stop()
 
 
@@ -281,3 +284,111 @@ def test_calibration_aborts_when_model_always_fails(db_path):
     abc.new(db_path, {"s0": 2.8})
     with pytest.raises(SamplingError, match="calibration"):
         abc.run(max_nr_populations=2)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM mid-generation: the sub-checkpoint ledger survives a real kill
+# (resilience/checkpoint.py) and the resumed run passes the posterior gate
+# ---------------------------------------------------------------------------
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PREEMPT_POP = 10_000
+
+#: child process: a probe run counts the preempt-site visits of
+#: generation 0 under the same seed, so the real SIGTERM lands
+#: deterministically on the FIRST device call of generation 1 — always
+#: mid-generation (one 16k round cannot finish a 10k-accepted
+#: generation at ~50% acceptance), never racing a generation boundary.
+_PREEMPT_CHILD = """
+import sys
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+from pyabc_tpu.resilience import faults
+from pyabc_tpu.resilience.checkpoint import Preempted
+
+db = sys.argv[1]
+models, priors, distance, observed, _ = make_two_gaussians_problem()
+
+
+def make_abc(path):
+    abc = pt.ABCSMC(models, priors, distance, population_size=%(pop)d,
+                    eps=pt.MedianEpsilon(),
+                    sampler=pt.VectorizedSampler(max_batch_size=1 << 14,
+                                                 max_rounds_per_call=1),
+                    stores_sum_stats=False, seed=7,
+                    checkpoint_every_rounds=1)
+    abc.new(path, observed)
+    return abc
+
+
+probe = faults.install(faults.FaultPlan.parse("preempt@999999999:sigterm"))
+make_abc(db + ".probe").run(max_nr_populations=1)
+v0 = probe.visits(faults.SITE_PREEMPT)
+faults.install(faults.FaultPlan.parse("preempt@%%d:sigterm" %% (v0 + 1)))
+try:
+    make_abc(db).run(max_nr_populations=30)
+except Preempted:
+    sys.exit(17)
+sys.exit(3)
+""" % {"pop": _PREEMPT_POP}
+
+
+def test_sigterm_mid_generation_resumes_and_passes_gate(tmp_path):
+    """Kill a pop-1e4 child with a real SIGTERM mid-generation; the
+    flushed ledger loses at most one flush interval, and a fresh
+    process resumes the generation from the splice, completes, and
+    passes the posterior gate (tools/verify_northstar_posterior.py
+    tolerances scaled to the population)."""
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.resilience import checkpoint as ckpt
+
+    db = str(tmp_path / "preempt.db")
+    script = tmp_path / "child.py"
+    script.write_text(_PREEMPT_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    proc = subprocess.run([sys.executable, str(script), db], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 17, proc.stderr[-3000:]
+
+    hist = pt.History(db, abc_id=1)
+    assert hist.max_t == 0  # generation 0 durable, generation 1 cut short
+    row = hist.load_sub_checkpoint(1)
+    assert row is not None
+    assert 1 <= row["n_accepted"] < _PREEMPT_POP
+    assert row["nr_evaluations"] >= row["n_accepted"]
+
+    # resume in-process with a DIFFERENT seed and sampler shape: the
+    # splice only depends on the durable t=0 data (eps re-derives
+    # identically), not on the dead process's key or batch rungs
+    ckpt.clear_preempt()
+    models, priors, distance, observed, posterior_fn = \
+        make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance,
+                    population_size=_PREEMPT_POP,
+                    eps=pt.MedianEpsilon(),
+                    sampler=pt.VectorizedSampler(max_batch_size=1 << 17,
+                                                 max_rounds_per_call=4),
+                    stores_sum_stats=False, seed=8,
+                    checkpoint_every_rounds=1)
+    abc.load(db)
+    h = abc.run(max_nr_populations=5)
+    t = h.max_t
+    assert t == 5
+    assert h.load_sub_checkpoint(1) is None  # consumed and cleared
+    pops = h.get_all_populations()
+    # the dead process's evaluations count exactly once in t=1
+    assert int(pops[pops.t == 1].samples.iloc[0]) >= row["nr_evaluations"]
+    for tt in range(t + 1):
+        pop = h.get_population(t=tt)
+        assert np.asarray(pop.theta).shape[0] == _PREEMPT_POP
+        assert np.isclose(np.asarray(pop.weight).sum(), 1.0, atol=1e-5)
+
+    probs = h.get_model_probabilities(t)
+    p_b = float(probs.get(1, 0.0))
+    p_true = float(posterior_fn(1.0))
+    df, w = h.get_distribution(m=1, t=t)
+    mu = float(np.sum(np.asarray(df["mu"]) * w))
+    assert abs(p_b - p_true) < max(2.5e-3, 2.5 / _PREEMPT_POP ** 0.5)
+    assert abs(mu - 1.0) < max(3e-3, 3.0 / _PREEMPT_POP ** 0.5)

@@ -1,0 +1,464 @@
+"""pyabc_tpu/resilience/: fault injection, retry/backoff classification,
+graceful degradation, and mid-generation sub-checkpointing.
+
+The chaos contract: every injected transient failure is absorbed
+WITHOUT changing the statistics (faults fire at attempt start, before
+any buffer-donating program consumed its inputs, so a retried dispatch
+is bit-identical), and a preemption mid-generation loses at most one
+flush interval of accepted rounds."""
+
+import sqlite3
+import time
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.resilience import checkpoint as ckpt
+from pyabc_tpu.resilience import faults, retry
+from pyabc_tpu.telemetry import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Every test starts and ends with no plan installed and no pending
+    preemption flag — the module state is process-global."""
+    faults.uninstall()
+    ckpt.clear_preempt()
+    yield
+    faults.uninstall()
+    ckpt.clear_preempt()
+
+
+def _sampler(**kw):
+    kw.setdefault("min_batch_size", 8)
+    kw.setdefault("max_batch_size", 64)
+    kw.setdefault("max_rounds_per_call", 1)
+    return pt.VectorizedSampler(**kw)
+
+
+def _abc(db_path, observed_out=None, seed=11, pop=300, ckpt_rounds=0,
+         **sampler_kw):
+    from pyabc_tpu.models import make_two_gaussians_problem
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    if observed_out is not None:
+        observed_out.update(observed)
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    sampler=_sampler(**sampler_kw), seed=seed,
+                    checkpoint_every_rounds=ckpt_rounds)
+    if db_path is not None:
+        abc.new(db_path, observed)
+    return abc
+
+
+# ---------------------------------------------------------------------------
+# fault plan grammar + determinism
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_grammar():
+    plan = faults.FaultPlan.parse(
+        "wire.fetch@3:raise=ConnectionResetError;"
+        "device.dispatch@2+:delay=0.5; preempt~0.25:sigterm")
+    assert len(plan.specs) == 3
+    s0, s1, s2 = plan.specs
+    assert (s0.site, s0.mode, s0.arg) == (faults.SITE_FETCH, "at", 3)
+    assert s0.action == "raise" and s0.action_arg is ConnectionResetError
+    assert (s1.site, s1.mode, s1.arg) == (faults.SITE_DISPATCH, "from", 2)
+    assert s1.action == "delay" and s1.action_arg == 0.5
+    assert (s2.site, s2.mode) == (faults.SITE_PREEMPT, "prob")
+    assert s2.action == "sigterm"
+    # resolution of the registered non-builtin exception names
+    assert (faults.FaultSpec.parse("history.append@1:raise=OperationalError")
+            .action_arg is sqlite3.OperationalError)
+
+
+@pytest.mark.parametrize("bad", [
+    "nope@1:raise=ValueError",       # unknown site
+    "wire.fetch:raise=ValueError",   # missing trigger
+    "wire.fetch@0:raise=ValueError", # visit must be >= 1
+    "wire.fetch~1.5:sigterm",        # probability out of range
+    "wire.fetch@1:explode",          # unknown action
+    "wire.fetch@1:raise=NoSuchExc",  # unknown exception name
+    "",                              # empty plan
+])
+def test_fault_plan_rejects_bad_directives(bad):
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse(bad)
+
+
+def test_exact_visit_semantics_and_counters():
+    plan = faults.install(
+        faults.FaultPlan.parse("wire.fetch@3:raise=ConnectionResetError"))
+    fired_at = []
+    for visit in range(1, 7):
+        try:
+            faults.fault_point(faults.SITE_FETCH)
+        except ConnectionResetError:
+            fired_at.append(visit)
+    assert fired_at == [3]  # exactly the 3rd visit, nothing after
+    assert plan.visits(faults.SITE_FETCH) == 6
+    assert plan.fired == {(faults.SITE_FETCH, "raise"): 1}
+    # other sites are untouched
+    faults.fault_point(faults.SITE_DISPATCH)
+    assert plan.visits(faults.SITE_DISPATCH) == 1
+
+
+def test_probabilistic_triggers_deterministic_under_seed():
+    def fire_pattern(seed):
+        plan = faults.FaultPlan.parse("wire.fetch~0.4:delay=0", seed=seed)
+        pattern = []
+        for _ in range(32):
+            before = plan.fired.get((faults.SITE_FETCH, "delay"), 0)
+            plan.visit(faults.SITE_FETCH)
+            after = plan.fired.get((faults.SITE_FETCH, "delay"), 0)
+            pattern.append(after > before)
+        return pattern
+
+    assert fire_pattern(7) == fire_pattern(7)  # reproducible chaos
+    assert any(fire_pattern(7)) and not all(fire_pattern(7))
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_ENV,
+                       "heartbeat.write@2:raise=OSError")
+    monkeypatch.setenv(faults.FAULT_SEED_ENV, "5")
+    plan = faults.install_from_env()
+    assert plan is not None and faults.active_plan() is plan
+    assert plan.seed == 5
+    faults.fault_point(faults.SITE_HEARTBEAT)
+    with pytest.raises(OSError):
+        faults.fault_point(faults.SITE_HEARTBEAT)
+    monkeypatch.delenv(faults.FAULTS_ENV)
+    assert faults.install_from_env() is None  # unset env: no plan
+
+
+# ---------------------------------------------------------------------------
+# transient-vs-fatal classification
+# ---------------------------------------------------------------------------
+
+def test_is_transient_classification():
+    assert retry.is_transient(ConnectionResetError("relay died"))
+    assert retry.is_transient(TimeoutError("slow"))
+    assert retry.is_transient(OSError("generic I/O hiccup"))
+    from concurrent.futures import BrokenExecutor
+    assert retry.is_transient(BrokenExecutor("worker died"))
+    # caller bugs are fatal
+    assert not retry.is_transient(ValueError("bad shape"))
+    assert not retry.is_transient(FileNotFoundError("no such db"))
+    assert not retry.is_transient(KeyError("theta"))
+    # sqlite: only contention/IO flavors retry
+    assert retry.is_transient(
+        sqlite3.OperationalError("database is locked"))
+    assert not retry.is_transient(
+        sqlite3.OperationalError("no such table: populations"))
+
+
+def test_is_transient_xla_markers_and_donation():
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert retry.is_transient(XlaRuntimeError("UNAVAILABLE: socket closed"))
+    assert retry.is_transient(XlaRuntimeError("ABORTED: preempted"))
+    assert not retry.is_transient(
+        XlaRuntimeError("INVALID_ARGUMENT: shape mismatch"))
+    # a donated-buffer error is ALWAYS fatal — the failed attempt
+    # consumed its inputs, re-running cannot succeed
+    assert not retry.is_transient(
+        XlaRuntimeError("Invalid buffer: donated to the computation"))
+    assert not retry.is_transient(
+        ConnectionResetError("buffer has been deleted"))
+
+
+def test_is_transient_follows_cause_chain():
+    from pyabc_tpu.wire import WireError
+    wrapped = RuntimeError("ingest worker failed")
+    wrapped.__cause__ = ConnectionResetError("relay died")
+    assert retry.is_transient(wrapped)
+    assert retry.is_transient(WireError("fetch failed"))  # bare: transfer
+    fatal = WireError("decode failed")
+    fatal.__cause__ = ValueError("bad dtype")
+    assert not retry.is_transient(fatal)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_retries_then_succeeds():
+    pol = retry.RetryPolicy(max_attempts=4, base_delay_s=0.001)
+    before = REGISTRY.to_dict().get("resilience_retries_total", 0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionResetError("relay hiccup")
+        return "ok"
+
+    assert pol.call(flaky, faults.SITE_DISPATCH) == "ok"
+    assert calls["n"] == 3
+    snap = REGISTRY.to_dict()
+    assert snap["resilience_retries_total"] - before == 2
+    assert snap["resilience_retry_device_dispatch"] >= 2
+
+
+def test_retry_policy_exhausts_transient():
+    pol = retry.RetryPolicy(max_attempts=3, base_delay_s=0.001)
+    calls = {"n": 0}
+
+    def dying():
+        calls["n"] += 1
+        raise ConnectionResetError("relay gone")
+
+    with pytest.raises(retry.RetryExhausted) as exc:
+        pol.call(dying, faults.SITE_FETCH)
+    assert calls["n"] == 3  # max_attempts total tries
+    assert exc.value.site == faults.SITE_FETCH
+    assert exc.value.attempts == 3
+    assert isinstance(exc.value.__cause__, ConnectionResetError)
+
+
+def test_retry_policy_fatal_raises_immediately():
+    pol = retry.RetryPolicy(max_attempts=5, base_delay_s=0.001)
+    calls = {"n": 0}
+
+    def buggy():
+        calls["n"] += 1
+        raise ValueError("shape bug")
+
+    with pytest.raises(ValueError):
+        pol.call(buggy, faults.SITE_DISPATCH)
+    assert calls["n"] == 1  # no retry for a program bug
+
+
+def test_retry_policy_backoff_grows_and_from_env(monkeypatch):
+    pol = retry.RetryPolicy(max_attempts=5, base_delay_s=0.1,
+                            max_delay_s=0.35, jitter=0.0)
+    assert pol.delay_s(1) == pytest.approx(0.1)
+    assert pol.delay_s(2) == pytest.approx(0.2)
+    assert pol.delay_s(4) == pytest.approx(0.35)  # capped
+    monkeypatch.setenv(retry.RETRIES_ENV, "7")
+    monkeypatch.setenv(retry.RETRY_BASE_ENV, "0.25")
+    env_pol = retry.RetryPolicy.from_env()
+    assert env_pol.max_attempts == 7
+    assert env_pol.base_delay_s == 0.25
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation ladders
+# ---------------------------------------------------------------------------
+
+def test_vectorized_degrade_rung_halves_to_floor():
+    s = pt.VectorizedSampler(min_batch_size=256, max_batch_size=1024)
+    assert s.degrade_rung() == 512
+    assert s.degrade_rung() == 256
+    assert s.degrade_rung() is None  # at the floor: caller re-raises
+    assert s._round_to_valid_batch(1 << 20) == 256
+
+
+def test_sharded_degrade_rung_respects_device_ladder():
+    s = pt.ShardedSampler(min_batch_size=8, max_batch_size=64)
+    caps = []
+    while True:
+        cap = s.degrade_rung()
+        if cap is None:
+            break
+        caps.append(cap)
+        # every rung the clamp emits stays on the nd*2^k ladder and
+        # under the degraded ceiling
+        b = s._round_to_valid_batch(1 << 20)
+        assert b <= s.max_batch_size
+        assert b % s.n_devices == 0 or b >= s.n_devices
+    assert caps == [32, 16, 8]
+    assert s.max_batch_size == s.min_batch_size
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos: injected faults are absorbed without changing stats
+# ---------------------------------------------------------------------------
+
+def test_injected_dispatch_fault_absorbed_exactly(tmp_path):
+    """A transient dispatch failure costs one backoff, NOT a different
+    posterior: faults fire at attempt start, so the retried dispatch is
+    bit-identical and the faulted run equals the clean run."""
+    clean = _abc(str(tmp_path / "clean.db"), seed=21)
+    h_clean = clean.run(max_nr_populations=2)
+
+    plan = faults.install(faults.FaultPlan.parse(
+        "device.dispatch@3:raise=ConnectionResetError"))
+    chaos = _abc(str(tmp_path / "chaos.db"), seed=21)
+    h_chaos = chaos.run(max_nr_populations=2)
+    assert plan.fired == {(faults.SITE_DISPATCH, "raise"): 1}
+
+    assert h_chaos.max_t == h_clean.max_t
+    for t in range(h_clean.max_t + 1):
+        p_clean = h_clean.get_population(t=t)
+        p_chaos = h_chaos.get_population(t=t)
+        np.testing.assert_allclose(np.asarray(p_chaos.theta),
+                                   np.asarray(p_clean.theta))
+        np.testing.assert_allclose(np.asarray(p_chaos.weight),
+                                   np.asarray(p_clean.weight))
+
+
+def test_injected_fetch_and_append_faults_absorbed(tmp_path):
+    faults.install(faults.FaultPlan.parse(
+        "wire.fetch@2:raise=ConnectionResetError;"
+        "history.append@1:raise=ConnectionResetError"))
+    before = REGISTRY.to_dict().get("resilience_retries_total", 0)
+    abc = _abc(str(tmp_path / "chaos2.db"), seed=22)
+    h = abc.run(max_nr_populations=2)
+    assert h.max_t == 1
+    assert REGISTRY.to_dict()["resilience_retries_total"] - before >= 2
+    for t in range(h.max_t + 1):
+        pop = h.get_population(t=t)
+        assert np.isclose(np.asarray(pop.weight).sum(), 1.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# mid-generation sub-checkpointing + preemption
+# ---------------------------------------------------------------------------
+
+def test_preemption_mid_generation_flushes_and_resume_splices(tmp_path):
+    """A (real) SIGTERM mid-generation: the ledger flushes, Preempted
+    raises, and a fresh ABCSMC.load resumes the generation from the
+    flushed rows — completing with full populations and exact
+    evaluation accounting across the splice."""
+    db = str(tmp_path / "preempt.db")
+    # probe run: count preempt-site visits during t=0 so the SIGTERM
+    # can be planted deterministically in the SECOND call of t=1
+    probe_plan = faults.install(
+        faults.FaultPlan.parse("preempt@1000000:sigterm"))
+    probe = _abc(str(tmp_path / "probe.db"), seed=31, ckpt_rounds=1)
+    probe.run(max_nr_populations=1)
+    v0 = probe_plan.visits(faults.SITE_PREEMPT)
+    assert v0 >= 1
+
+    plan = faults.install(
+        faults.FaultPlan.parse(f"preempt@{v0 + 2}:sigterm"))
+    abc = _abc(db, seed=31, ckpt_rounds=1)
+    with pytest.raises(ckpt.Preempted):
+        abc.run(max_nr_populations=3)
+    faults.uninstall()
+    ckpt.clear_preempt()
+    assert plan.fired == {(faults.SITE_PREEMPT, "sigterm"): 1}
+
+    # generation 0 is durable; generation 1 left a sub-checkpoint with
+    # SOME but not all rows (at most one flush interval was lost)
+    assert abc.history.max_t == 0
+    row = abc.history.load_sub_checkpoint(1)
+    assert row is not None
+    assert 1 <= row["n_accepted"] < 300
+    assert row["nr_evaluations"] >= row["n_accepted"]
+    assert row["batch"]["theta"].shape[0] == row["n_accepted"]
+
+    # resume: eps(1) re-derives deterministically from gen 0, so the
+    # splice is accepted; the run completes with full populations
+    abc2 = _abc(None, seed=32, ckpt_rounds=1)
+    abc2.load(db)
+    h = abc2.run(max_nr_populations=2)
+    assert h.max_t >= 1
+    assert h.load_sub_checkpoint(1) is None  # consumed + cleared
+    pops = h.get_all_populations()
+    t1 = pops[pops.t == 1].iloc[0]
+    # the preempted process's evaluations count exactly once
+    assert int(t1.samples) >= row["nr_evaluations"]
+    for t in range(h.max_t + 1):
+        pop = h.get_population(t=t)
+        assert np.asarray(pop.theta).shape[0] == 300
+        assert np.isclose(np.asarray(pop.weight).sum(), 1.0, atol=1e-5)
+
+
+def test_stale_splice_discarded_on_eps_mismatch(tmp_path):
+    """A sub-checkpoint whose eps disagrees with the re-derived schedule
+    (the t=0 re-calibration edge case) is discarded, not spliced."""
+    db = str(tmp_path / "stale.db")
+    abc = _abc(db, seed=41, ckpt_rounds=1)
+    # plant a ledger row for the NEXT generation with a nonsense eps
+    fake = {"m": np.zeros(5, np.int8),
+            "theta": np.zeros((5, 1), np.float32),
+            "distance": np.full(5, 0.1, np.float32),
+            "log_weight": np.zeros(5, np.float32)}
+    abc.history.save_sub_checkpoint(0, fake, rounds=3,
+                                    nr_evaluations=192, eps=1e9)
+    h = abc.run(max_nr_populations=1)
+    assert h.max_t == 0
+    assert h.load_sub_checkpoint(0) is None  # discarded, then cleared
+    pop = h.get_population(t=0)
+    assert np.asarray(pop.theta).shape[0] == 300
+
+
+def test_checkpointer_should_flush_cadence(tmp_path):
+    db = str(tmp_path / "cadence.db")
+    hist = pt.History("sqlite:///" + db)
+    hist.id = 1
+    ck = ckpt.GenCheckpointer(hist, t=2, every_rounds=4)
+    assert not ck.should_flush(3)   # under cadence, no preemption
+    assert ck.should_flush(4)       # cadence reached
+    batch = {"m": np.zeros(3, np.int8),
+             "theta": np.zeros((3, 1), np.float32),
+             "distance": np.zeros(3, np.float32),
+             "log_weight": np.zeros(3, np.float32)}
+    ck.flush(batch, rounds=4, nr_evaluations=256)
+    assert not ck.should_flush(4)   # nothing new since the flush
+    ckpt.request_preempt()
+    try:
+        assert ck.should_flush(5)   # preemption flushes immediately
+        with pytest.raises(ckpt.Preempted):
+            ck.maybe_raise_preempted()
+    finally:
+        ckpt.clear_preempt()
+    row = hist.load_sub_checkpoint(2)
+    assert row["rounds"] == 4 and row["n_accepted"] == 3
+    assert row["nr_evaluations"] == 256
+
+
+def test_checkpointer_base_splice_survives_second_preemption(tmp_path):
+    """Rows restored by a resume splice are re-flushed in FRONT of the
+    new rows, so a second preemption still has the full ledger."""
+    db = str(tmp_path / "twice.db")
+    hist = pt.History("sqlite:///" + db)
+    hist.id = 1
+    ck = ckpt.GenCheckpointer(hist, t=0, every_rounds=1)
+    base = {"m": np.zeros(4, np.int8),
+            "theta": np.full((4, 1), 7.0, np.float32),
+            "distance": np.zeros(4, np.float32),
+            "log_weight": np.zeros(4, np.float32)}
+    ck.set_base(base, nr_evaluations=100)
+    fresh = {"m": np.ones(2, np.int8),
+             "theta": np.full((2, 1), 9.0, np.float32),
+             "distance": np.zeros(2, np.float32),
+             "log_weight": np.zeros(2, np.float32)}
+    ck.flush(fresh, rounds=2, nr_evaluations=50)
+    row = hist.load_sub_checkpoint(0)
+    assert row["n_accepted"] == 6
+    assert row["nr_evaluations"] == 150  # base + new, exactly once each
+    np.testing.assert_allclose(row["batch"]["theta"][:4], 7.0)
+    np.testing.assert_allclose(row["batch"]["theta"][4:], 9.0)
+
+
+# ---------------------------------------------------------------------------
+# disabled-path overhead
+# ---------------------------------------------------------------------------
+
+def test_disabled_fault_point_overhead():
+    """With no plan installed the probe is one global load + None check;
+    a device dispatch is >= ~5 ms even on the local CPU mesh, so 5 us
+    per probe keeps the disabled chaos path under 0.1% of a round."""
+    faults.uninstall()
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.fault_point(faults.SITE_DISPATCH)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 5e-6
+
+
+def test_retry_wrapper_overhead():
+    """The happy-path retry wrapper (one fault probe + try/except) must
+    cost well under 1% of a >= 5 ms dispatch: 50 us/call."""
+    pol = retry.RetryPolicy()
+    n = 2_000
+    fn = lambda: 1  # noqa: E731
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pol.call(fn, faults.SITE_DISPATCH)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 50e-6

@@ -1,0 +1,70 @@
+"""Tier-1 wrapper for tools/check_retry_sites.py: every hot-loop device
+dispatch must route through resilience/retry.py (self._dispatch /
+self._retry.call), the d2h chokepoint must keep its retry wrapper, and
+the lint must actually catch a violation when one is planted."""
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "check_retry_sites.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_retry_sites", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_tree_is_clean():
+    """No raw stateful-loop/block dispatch outside the retry wrappers —
+    the invariant that makes transient-failure absorption total."""
+    mod = _load()
+    assert mod.check() == []
+
+
+def test_detects_planted_violations(tmp_path):
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "sampler").mkdir(parents=True)
+    (pkg / "sampler" / "vectorized.py").write_text(
+        "state = self._dispatch(step, sub, params, state)\n"
+        "state = step(sub, params, state)\n"
+        "ok = finalize(state, params)  # retry-ok\n"
+        "# a comment naming finalize(x) is not a violation\n"
+        "jitted = jit_compile(step, donate_argnums=(2,))\n"
+        "wire_dev, out_dev = finalize(state, params)\n")
+    (pkg / "smc.py").write_text(
+        "carry_out, wires = self._retry.call(fn, SITE, carry_in, key)\n"
+        "carry_out, wires = fn(carry_in, key)\n")
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [
+        ("sampler/vectorized.py", 2), ("sampler/vectorized.py", 6),
+        ("smc.py", 2)]
+
+
+def test_detects_unwrapped_chokepoint(tmp_path):
+    """sampler/base.py dropping the SITE_FETCH retry routing is itself
+    a violation — the d2h chokepoint rule."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "sampler").mkdir(parents=True)
+    (pkg / "sampler" / "base.py").write_text(
+        "def fetch_to_host(tree):\n"
+        "    return jax.device_get(tree)\n")
+    got = mod.check(root=str(pkg))
+    assert {path for path, _, _ in got} == {"sampler/base.py"}
+    assert len(got) == 2  # both markers missing
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    mod = _load()
+    assert mod.main([]) == 0  # the real tree
+    assert "clean" in capsys.readouterr().out
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "smc.py").write_text("carry_out, wires = fn(carry_in, key)\n")
+    assert mod.main([str(pkg)]) == 1
+    assert "smc.py:1" in capsys.readouterr().out
