@@ -37,6 +37,11 @@ is one-off).
   this population), with a sequential-ingest control row
   (``northstar_seq_pop1e6_*``) in the same capture so the overlap win
   is measured inside one relay-weather sample
+- ``fused_northstar_*`` / ``seq_northstar_*`` — the fused-vs-
+  sequential engine A/B at pop 1e6 (same capture, so relay weather
+  cancels), plus the engine probe's recorded decision
+  (``fused_northstar_engine_decision``) — the ISSUE-5 headline claim,
+  on the compact line so the driver tail captures it
 - ``posterior_gate_*``     — the repeatable 1e6 adaptive posterior-
   exactness gate (tools/verify_northstar_posterior.py): perf work
   cannot silently trade statistical bias
@@ -291,6 +296,79 @@ def bench_northstar():
     return out
 
 
+def bench_fused_northstar():
+    """Fused-vs-sequential engine A/B at the north star (pop 1e6),
+    both sides in ONE capture so relay weather (±30-40 % across runs)
+    cancels out of the comparison.
+
+    The sequential control runs first; its measured steady-state s/gen
+    is then handed to a fused run as the engine probe's baseline
+    (``_note_sequential_gen_s``) so the first at-scale fused block's
+    ``_decide_engine`` makes a REAL comparison and records the decision
+    in the GenerationTimeline — the acceptance-criterion artifact: at
+    1e6 either fused s/gen <= sequential, or the selector provably
+    picks the faster engine and ``fused_northstar_engine_decision``
+    says so on the compact line."""
+    import pyabc_tpu as pt
+    from pyabc_tpu.autotune import compile_counters, compile_delta
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    K = 4
+
+    def build(fuse):
+        models, priors, distance, observed, _ = \
+            make_two_gaussians_problem()
+        abc = pt.ABCSMC(
+            models, priors, distance,
+            population_size=NORTHSTAR_POP,
+            eps=pt.ConstantEpsilon(0.2),
+            sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                         max_rounds_per_call=16),
+            stores_sum_stats=False,
+            fuse_generations=fuse,
+            seed=0)
+        abc.new("sqlite://", observed)
+        return abc
+
+    # sequential control (fuse=1 never enters the fused engine); the
+    # north-star warmup-3 protocol covers the round compiles
+    abc_s = build(1)
+    _, seq_spg, seq_times, _, _ = _timed_generations(
+        abc_s, NORTHSTAR_POP, 3, 3)
+
+    # fused run: 1 sequential gen 0 + two K-gen blocks (block 1 pays
+    # the fused program's compile; block 2 is the steady sample)
+    abc_f = build(K)
+    abc_f._note_sequential_gen_s(seq_spg)
+    cc0 = compile_counters()
+    abc_f.run(max_nr_populations=1 + 2 * K)
+    cc = compile_delta(cc0)
+    fused_ts = sorted(r["gen"] for r in abc_f.timeline.to_rows()
+                      if r["path"] == "fused")
+    steady = [abc_f.generation_wall_clock[t] for t in fused_ts if t > K]
+    if steady:
+        fused_spg = float(np.median(steady))
+    elif fused_ts:
+        # the probe retired fusion after block 1: back the one-off
+        # compile bill out of its wall clock, matching the probe's own
+        # steady-state view of that block
+        wall = sum(abc_f.generation_wall_clock[t] for t in fused_ts)
+        fused_spg = max(wall - cc["compile_s"], 0.0) / len(fused_ts)
+    else:
+        fused_spg = None
+    decision = abc_f.timeline.summary().get("engine_decision")
+    return {
+        "fused_northstar_s_per_gen": (None if fused_spg is None
+                                      else round(fused_spg, 2)),
+        "seq_northstar_s_per_gen": round(seq_spg, 2),
+        "fused_northstar_engine_decision": decision,
+        "fused_northstar_fuse_generations": K,
+        "fused_northstar_gen_times_s": [
+            round(abc_f.generation_wall_clock[t], 2) for t in fused_ts],
+        "seq_northstar_gen_times_s": seq_times,
+    }
+
+
 def bench_kde_1e6():
     """Standalone 1e6-query × 1e6-support streamed weighted-KDE log-pdf
     (the SURVEY.md §7 '1e6 × 1e6 KDE' hard part)."""
@@ -361,9 +439,9 @@ def _bench_problem(make_problem, pop, prefix):
             **{f"{prefix}_{k}": v for k, v in transfer.items()}}
 
 
-SUB_BENCHES = ("kde_1e6", "northstar", "posterior_gate", "lotka_volterra",
-               "sir", "petab_ode", "sharded_mesh1", "ab_vec_sharded",
-               "sharded_cpu8")
+SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "posterior_gate",
+               "lotka_volterra", "sir", "petab_ode", "sharded_mesh1",
+               "ab_vec_sharded", "sharded_cpu8")
 
 
 def bench_ab_vec_vs_sharded():
@@ -392,7 +470,7 @@ def bench_ab_vec_vs_sharded():
     abcs = {"vec": build(pt.VectorizedSampler(max_batch_size=1 << 20)),
             "sharded": build(pt.ShardedSampler(mesh=make_mesh(),
                                                max_batch_size=1 << 20))}
-    warm = 3
+    warm = 3  # warmup-3 steady-state protocol, matching the north-star row
     for abc in abcs.values():  # compile + warmup
         abc.run(max_nr_populations=1 + warm)
     times = {k: [] for k in abcs}
@@ -463,6 +541,8 @@ def _run_sub(name: str) -> dict:
         return bench_kde_1e6()
     if name == "northstar":
         return bench_northstar()
+    if name == "fused_northstar":
+        return bench_fused_northstar()
     if name == "posterior_gate":
         # the 1e6 adaptive posterior-exactness gate (BASELINE.md
         # "Correctness at scale", now repeatable): perf work cannot
@@ -556,6 +636,7 @@ def main():
     # what made the full line huge — restricted to the headline prefixes.
     compact = {k: v for k, v in sorted(extra.items())
                if k.startswith(("primary_", "northstar_",
+                                "fused_northstar_", "seq_northstar_",
                                 "posterior_gate_", "telemetry_",
                                 "resilience_", "checkpoint_"))
                and not isinstance(v, (list, dict))}
@@ -615,8 +696,13 @@ def bench_petab_ode():
                                      max_batch_size=1 << 18),
         seed=0)
     abc.new("sqlite://", importer.get_observed())
+    # warmup-3 steady-state protocol (matching the north-star row): the
+    # r5 capture's gen times [1.32, 0.65, 0.25] were monotone-decreasing
+    # — with warmup 2 the timed window still contained the temperature
+    # anneal's early high-acceptance generations and the median was a
+    # warmup artifact, not a steady-state rate
     rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
-        abc, PETAB_POP, 2, 3)
+        abc, PETAB_POP, 3, 3)
     return {"petab_ode_pop100k_accepted_per_sec": round(rate, 1),
             "petab_ode_pop100k_wallclock_s_per_gen": round(s_per_gen, 2),
             "petab_ode_pop100k_gen_times_s": times,

@@ -49,6 +49,15 @@ class Acceptor:
     (reference acceptor.py:68-190).  Device kernel: :meth:`accept`.
     """
 
+    #: fused-chain capability flag: True when :meth:`get_params` can be
+    #: reproduced ON DEVICE for every generation of a fused block (the
+    #: in-scan epsilon/temperature plus at most baked constants) —
+    #: concrete classes opt in; ``ABCSMC._device_chain_eligible``
+    #: consults it (tools/check_fused_eligibility.py keeps the two in
+    #: sync).  Default False: an acceptor with host-side per-generation
+    #: state must run the sequential path.
+    device_accept_ok = False
+
     def initialize(self, t: int, get_weighted_distances: Optional[Callable],
                    distance_function=None, x_0=None):
         pass
@@ -107,6 +116,13 @@ class UniformAcceptor(Acceptor):
         self.use_complete_history = use_complete_history
         self._eps_history: dict = {}
 
+    @property
+    def device_accept_ok(self) -> bool:
+        """d ≤ ε against the in-scan epsilon; the complete-history min
+        needs the host ``_eps_history`` every generation, and a subclass
+        may override :meth:`get_params` arbitrarily."""
+        return type(self) is UniformAcceptor and not self.use_complete_history
+
     def get_params(self, t: int, epsilon) -> dict:
         eps = float(epsilon(t))
         self._eps_history[t] = eps
@@ -135,6 +151,16 @@ class StochasticAcceptor(Acceptor):
 
     def requires_calibration(self) -> bool:
         return True
+
+    @property
+    def device_accept_ok(self) -> bool:
+        """(pdf_norm, T) acceptance with T from the in-scan temperature
+        solve; the pdf_norm must be a data-independent constant for a
+        whole block, which only the kernel-derived method guarantees —
+        ``pdf_norm_max_found`` tracks the realized max density across
+        generations on the host."""
+        return (type(self) is StochasticAcceptor
+                and self.pdf_norm_method is pdf_norm_from_kernel)
 
     def initialize(self, t, get_weighted_distances=None,
                    distance_function=None, x_0=None):

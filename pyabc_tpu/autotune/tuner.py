@@ -33,12 +33,18 @@ from __future__ import annotations
 import math
 from typing import Callable, Optional
 
+#: the acceptance-rate EWMA gain shared by every estimator that tracks
+#: the rate: this host-side tuner and the fused scan's in-carry estimate
+#: (sampler/fused.py), so a fused block's carried rate and the host
+#: tuner's agree on smoothing semantics and can seed each other
+EWMA_ALPHA = 0.5
+
 
 class BatchAutotuner:
     """Acceptance-rate estimator + batch-rung policy for one sampler."""
 
     def __init__(self,
-                 alpha: float = 0.5,
+                 alpha: float = EWMA_ALPHA,
                  cv_gain: float = 1.0,
                  hysteresis: float = 0.1,
                  safety_min: float = 1.05,

@@ -197,8 +197,33 @@ class AdaptivePNormDistance(PNormDistance):
     def update(self, t, get_all_stats=None) -> bool:
         if not self.adaptive or get_all_stats is None:
             return False
-        self._fit(t, self.spec.flatten(get_all_stats()))
+        if t in self.weights:
+            # pre-seeded by a fused device block's in-scan refit
+            # (ABCSMC._run_fused_block continuation): the schedule for t
+            # is already decided — still report "changed" so population
+            # distances are re-evaluated under it
+            return True
+        data = self.spec.flatten(get_all_stats())
+        if getattr(data, "shape", (0,))[0] == 0:
+            # nothing recorded (e.g. a fused continuation without a
+            # record sample): keep the previous weights
+            return False
+        self._fit(t, data)
         return True
+
+    @property
+    def device_refit_ok(self) -> bool:
+        """True when the per-generation scale refit can run INSIDE a
+        fused device block (sampler/fused.py): adaptation on, a library
+        scale function (traceable NaN-aware jnp reducer — a custom
+        callable may use host numpy), no side-channel log file, and this
+        exact class (a subclass may override ``_fit`` arbitrarily).
+        Checked by ``ABCSMC._device_chain_eligible``."""
+        return (type(self) is AdaptivePNormDistance
+                and self.adaptive
+                and self.log_file is None
+                and any(self.scale_function is f
+                        for f in SCALE_FUNCTIONS.values()))
 
     def params_time_invariant(self) -> bool:
         # adaptive refits rewrite the weight schedule every generation

@@ -19,6 +19,15 @@ class Epsilon:
     ``__call__(t) -> float``.
     """
 
+    #: fused-chain capability flag: True when the schedule can be
+    #: advanced INSIDE a fused device block (a constant, a weighted
+    #: quantile of the carried distances, or the device temperature
+    #: solve) — concrete classes opt in; ``ABCSMC._device_chain_eligible``
+    #: consults it (tools/check_fused_eligibility.py keeps the two in
+    #: sync).  Default False: an unknown schedule silently baked into a
+    #: compiled K-generation block would freeze its adaptation.
+    device_schedule_ok = False
+
     def initialize(self, t: int,
                    get_weighted_distances: Optional[Callable] = None,
                    get_all_records: Optional[Callable] = None,

@@ -13,6 +13,9 @@ from .base import Epsilon
 class ConstantEpsilon(Epsilon):
     """Fixed ε for all generations (reference epsilon.py:12-36)."""
 
+    #: a constant trivially advances inside a fused block
+    device_schedule_ok = True
+
     def __init__(self, constant_epsilon_value: float):
         self.constant_epsilon_value = float(constant_epsilon_value)
 
@@ -44,6 +47,11 @@ class QuantileEpsilon(Epsilon):
     The quantile itself is computed on-device via
     :func:`weighted_quantile`; only the scalar comes back to the host.
     """
+
+    #: the weighted quantile of the carried distances is the fused
+    #: scan's in-generation epsilon (sampler/fused.py
+    #: ``_weighted_quantile_device``); MedianEpsilon inherits
+    device_schedule_ok = True
 
     def __init__(self, initial_epsilon: str = "from_sample",
                  alpha: float = 0.5, quantile_multiplier: float = 1.0,

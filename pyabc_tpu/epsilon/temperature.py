@@ -156,6 +156,31 @@ class Temperature(TemperatureBase):
     def __call__(self, t: int) -> float:
         return self.temperatures[t]
 
+    # ---- fused-chain capability flags ------------------------------------
+
+    @property
+    def device_solve_ok(self) -> bool:
+        """True when the whole temperature update is expressible as the
+        single in-scan acceptance-rate solve (sampler/fused.py): exactly
+        one :class:`AcceptanceRateScheme` (its host-side ``min_rate``
+        guard reads the realized acceptance rate, which the scan does
+        not thread), min-aggregation, no side-channel log file, and this
+        exact class (a subclass may override ``_update`` arbitrarily).
+        Checked by ``ABCSMC._device_chain_eligible`` via
+        :attr:`device_schedule_ok`."""
+        return (type(self) is Temperature
+                and len(self.schemes) == 1
+                and type(self.schemes[0]) is AcceptanceRateScheme
+                and self.schemes[0].min_rate is None
+                and self.aggregate_fun is min
+                and self.log_file is None)
+
+    @property
+    def device_schedule_ok(self) -> bool:
+        # the schedule can only advance inside a fused block when the
+        # solve itself can
+        return self.device_solve_ok
+
     def get_config(self):
         return {"name": type(self).__name__,
                 "schemes": [type(s).__name__ for s in self.schemes]}
@@ -217,15 +242,79 @@ class TemperatureScheme:
 _DEVICE_SOLVE_CACHE: dict = {}
 
 
+def acceptance_rate_solve_trace(log_dens, log_ratio, pdf_norm, target,
+                                lin_scale: bool):
+    """TRACEABLE core of the acceptance-rate temperature solve:
+    importance weights + log-beta bisection, same math as the host path
+    (importance-weighted mean of min(1, exp(logvals·beta)) matched to
+    the target rate, bisected over b = log beta ∈ [-100, 0]).
+
+    Shared single source of truth between the jitted host-call wrapper
+    (:func:`_device_acceptance_rate_solve`) and the fused scan's
+    in-generation temperature schedule (sampler/fused.py), so the two
+    paths cannot drift.  Returns ``(b_opt, rate_at_b0, rate_at_bmin)``.
+
+    All-invalid records (every log_dens NaN) degrade gracefully: weights
+    all zero → rate ≡ 0 → rate_at_bmin < target, which callers map to
+    the "numerics limit" +inf proposal — the monotone clamp then keeps
+    the previous temperature.
+    """
+    import jax
+
+    # NaN rows are bucket padding — excluded.  A -inf log_dens is
+    # a REAL record (zero-likelihood candidate): it keeps its
+    # importance weight and contributes acceptance 0, exactly as
+    # on the host path.  A +inf log_ratio (pd_prev = 0) carries
+    # weight 0, mirroring the host's pd_prev > 0 guard.
+    valid = ~jnp.isnan(log_dens) & ~jnp.isnan(log_ratio)
+    w_ok = valid & (log_ratio < jnp.inf)
+    shift = jnp.max(jnp.where(
+        w_ok & jnp.isfinite(log_ratio), log_ratio, -jnp.inf))
+    shift = jnp.where(jnp.isfinite(shift), shift, 0.0)
+    w = jnp.where(w_ok, jnp.exp(log_ratio - shift), 0.0)
+    wsum = jnp.sum(w)
+    # all-zero ratios -> uniform over valid (host-path parity)
+    w = jnp.where(wsum > 0, w,
+                  jnp.where(valid, 1.0, 0.0))
+    w = w / jnp.maximum(jnp.sum(w), 1e-30)
+    ld = log_dens
+    if lin_scale:
+        # mirror the host clamp log(max(d, 1e-290)): f32 record
+        # storage flushes such densities to 0, so 0 maps to the
+        # host's floor value instead of -inf
+        ld = jnp.where(ld > 0, jnp.log(jnp.maximum(ld, 1e-38)),
+                       jnp.float32(np.log(1e-290)))
+    logvals = jnp.where(valid, ld - pdf_norm, -jnp.inf)
+
+    def rate(b):
+        # beta floored at the smallest f32 NORMAL: subnormal
+        # exp(b) flushes to 0 on this stack and -inf·0 = NaN
+        # would poison the sum; guard w > 0 for padding rows too
+        beta = jnp.maximum(jnp.exp(b), 1e-37)
+        acc = jnp.exp(jnp.minimum(logvals * beta, 0.0))
+        return jnp.sum(jnp.where(w > 0, w * acc, 0.0))
+
+    def body(_, lo_hi):
+        # rate(b) DECREASES in b (hotter beta -> colder accept);
+        # rate(lo) > target > rate(hi) is the loop invariant
+        lo, hi = lo_hi
+        mid = 0.5 * (lo + hi)
+        too_cold = rate(mid) < target
+        return (jnp.where(too_cold, lo, mid),
+                jnp.where(too_cold, mid, hi))
+
+    lo, hi = jax.lax.fori_loop(
+        0, 60, body, (jnp.float32(-100.0), jnp.float32(0.0)))
+    b_opt = 0.5 * (lo + hi)
+    return b_opt, rate(0.0), rate(-100.0)
+
+
 def _device_acceptance_rate_solve(log_dens, log_ratio, pdf_norm,
                                   target_rate, lin_scale: bool):
-    """One compiled program: importance weights + log-beta bisection.
-
-    Same math as the host path (importance-weighted mean of
-    min(1, exp(logvals·beta)) matched to the target rate, bisected over
-    b = log beta ∈ [-100, 0]), evaluated over the DEVICE record columns
-    with NaN bucket-padding masked.  Returns (b_opt, rate_at_b0,
-    rate_at_bmin) — three scalars, one fetch.
+    """One compiled program around :func:`acceptance_rate_solve_trace`,
+    evaluated over the DEVICE record columns with NaN bucket-padding
+    masked.  Returns (b_opt, rate_at_b0, rate_at_bmin) — three scalars,
+    one fetch.
     """
     import jax
 
@@ -234,52 +323,8 @@ def _device_acceptance_rate_solve(log_dens, log_ratio, pdf_norm,
 
         @jax.jit
         def solve(log_dens, log_ratio, pdf_norm, target):
-            # NaN rows are bucket padding — excluded.  A -inf log_dens is
-            # a REAL record (zero-likelihood candidate): it keeps its
-            # importance weight and contributes acceptance 0, exactly as
-            # on the host path.  A +inf log_ratio (pd_prev = 0) carries
-            # weight 0, mirroring the host's pd_prev > 0 guard.
-            valid = ~jnp.isnan(log_dens) & ~jnp.isnan(log_ratio)
-            w_ok = valid & (log_ratio < jnp.inf)
-            shift = jnp.max(jnp.where(
-                w_ok & jnp.isfinite(log_ratio), log_ratio, -jnp.inf))
-            shift = jnp.where(jnp.isfinite(shift), shift, 0.0)
-            w = jnp.where(w_ok, jnp.exp(log_ratio - shift), 0.0)
-            wsum = jnp.sum(w)
-            # all-zero ratios -> uniform over valid (host-path parity)
-            w = jnp.where(wsum > 0, w,
-                          jnp.where(valid, 1.0, 0.0))
-            w = w / jnp.maximum(jnp.sum(w), 1e-30)
-            ld = log_dens
-            if lin_scale:
-                # mirror the host clamp log(max(d, 1e-290)): f32 record
-                # storage flushes such densities to 0, so 0 maps to the
-                # host's floor value instead of -inf
-                ld = jnp.where(ld > 0, jnp.log(jnp.maximum(ld, 1e-38)),
-                               jnp.float32(np.log(1e-290)))
-            logvals = jnp.where(valid, ld - pdf_norm, -jnp.inf)
-
-            def rate(b):
-                # beta floored at the smallest f32 NORMAL: subnormal
-                # exp(b) flushes to 0 on this stack and -inf·0 = NaN
-                # would poison the sum; guard w > 0 for padding rows too
-                beta = jnp.maximum(jnp.exp(b), 1e-37)
-                acc = jnp.exp(jnp.minimum(logvals * beta, 0.0))
-                return jnp.sum(jnp.where(w > 0, w * acc, 0.0))
-
-            def body(_, lo_hi):
-                # rate(b) DECREASES in b (hotter beta -> colder accept);
-                # rate(lo) > target > rate(hi) is the loop invariant
-                lo, hi = lo_hi
-                mid = 0.5 * (lo + hi)
-                too_cold = rate(mid) < target
-                return (jnp.where(too_cold, lo, mid),
-                        jnp.where(too_cold, mid, hi))
-
-            lo, hi = jax.lax.fori_loop(
-                0, 60, body, (jnp.float32(-100.0), jnp.float32(0.0)))
-            b_opt = 0.5 * (lo + hi)
-            return b_opt, rate(0.0), rate(-100.0)
+            return acceptance_rate_solve_trace(
+                log_dens, log_ratio, pdf_norm, target, lin_scale)
 
         _DEVICE_SOLVE_CACHE[key] = solve
     return _DEVICE_SOLVE_CACHE[key](

@@ -26,34 +26,33 @@ Array = jnp.ndarray
 _BLOCK = 256
 
 
-def fast_weighted_choice(key, log_w: Array, n: int) -> Array:
-    """``n`` indices sampled ∝ ``exp(log_w)`` (unnormalized log weights).
+def _cap_draws(cdf: Array, u: Array) -> Array:
+    """Cap draws strictly below ``cdf[-1]``.
 
-    Padded entries with log_w ≈ -inf get zero probability mass (flat CDF
-    segments are never hit by a strictly-below-cap uniform draw).
-
-    The inversion ``idx = smallest i with cdf[i] > u`` is a TWO-LEVEL
-    vectorized search, not ``jnp.searchsorted``: binary search lowers to
-    ~log2(N) serial random-gather steps per lane, which dominated the
-    whole sampling round at the 1e6 scale (measured ~0.08 s/round at
-    n=2^19, N=2^20 — >90 % of the non-KDE round cost).  Instead the
-    block-end CDF values are compared against every draw in one fused
-    broadcast-reduce (no gathers), then ONE contiguous [n, block] row
-    gather + count refines within the block — all parallel VPU work.
+    A draw scaled by cdf[-1] can round UP to exactly cdf[-1] in f32, in
+    which case no cdf[i] > u exists and the inversion counts hit N — and
+    a plain N-1 clamp would land on a zero-weight padded row.  Capping u
+    at the float just below cdf[-1] routes the draw to the LAST
+    positive-weight index instead (trailing flat CDF segments all equal
+    cdf[-1], so the first cdf[i] > u is the final real entry).  The same
+    strictly-below-cap property makes flat (zero-weight) segments
+    unhittable even when u lands EXACTLY on their value.
     """
-    w = jax.nn.softmax(log_w)
-    cdf = jnp.cumsum(w)
-    N = log_w.shape[0]
-    u = jax.random.uniform(key, (n,), dtype=cdf.dtype) * cdf[-1]
-    # uniform*cdf[-1] can round UP to exactly cdf[-1] in f32 (uniform near 1),
-    # in which case no cdf[i] > u exists and the counts below hit N — and a
-    # plain N-1 clamp would land on a zero-weight padded row.  Capping u at
-    # the float just below cdf[-1] routes the draw to the LAST
-    # positive-weight index instead (trailing flat CDF segments all equal
-    # cdf[-1], so the first cdf[i] > u is the final real entry).  The same
-    # strictly-below-cap property makes flat (zero-weight) segments
-    # unhittable even when u lands EXACTLY on their value.
-    u = jnp.minimum(u, jnp.nextafter(cdf[-1], jnp.zeros((), cdf.dtype)))
+    return jnp.minimum(u, jnp.nextafter(cdf[-1], jnp.zeros((), cdf.dtype)))
+
+
+def _invert_cdf(cdf: Array, u: Array) -> Array:
+    """``idx = smallest i with cdf[i] > u`` for every draw, as a
+    TWO-LEVEL vectorized search, not ``jnp.searchsorted``: binary search
+    lowers to ~log2(N) serial random-gather steps per lane, which
+    dominated the whole sampling round at the 1e6 scale (measured
+    ~0.08 s/round at n=2^19, N=2^20 — >90 % of the non-KDE round cost).
+    Instead the block-end CDF values are compared against every draw in
+    one fused broadcast-reduce (no gathers), then ONE contiguous
+    [n, block] row gather + count refines within the block — all
+    parallel VPU work.  ``u`` must already be capped (:func:`_cap_draws`).
+    """
+    N = cdf.shape[0]
     if N <= _BLOCK * 4:
         # small support: one fused compare-reduce over the whole CDF
         idx = jnp.sum((cdf[None, :] <= u[:, None]).astype(jnp.int32),
@@ -75,3 +74,35 @@ def fast_weighted_choice(key, log_w: Array, n: int) -> Array:
     off = jnp.sum((rows <= u[:, None]).astype(jnp.int32), axis=1)
     idx = blk * _BLOCK + off
     return jnp.minimum(idx, N - 1).astype(jnp.int32)
+
+
+def fast_weighted_choice(key, log_w: Array, n: int) -> Array:
+    """``n`` indices sampled ∝ ``exp(log_w)`` (unnormalized log weights).
+
+    Padded entries with log_w ≈ -inf get zero probability mass (flat CDF
+    segments are never hit by a strictly-below-cap uniform draw).  The
+    inversion is the shared two-level search (:func:`_invert_cdf`).
+    """
+    w = jax.nn.softmax(log_w)
+    cdf = jnp.cumsum(w)
+    u = jax.random.uniform(key, (n,), dtype=cdf.dtype) * cdf[-1]
+    return _invert_cdf(cdf, _cap_draws(cdf, u))
+
+
+def systematic_weighted_choice(key, log_w: Array, n: int) -> Array:
+    """Systematic (stratified) resampling: ``n`` indices ∝ ``exp(log_w)``
+    from ONE uniform draw, ``u_i = (u0 + i)/n · cdf[-1]``.
+
+    The classic low-variance resampler: every index with weight
+    ≥ 1/n mass appears ⌊n·w⌋ or ⌈n·w⌉ times, so the resampled support
+    preserves the weighted moments to O(1/n) instead of the O(1/√n)
+    of i.i.d. draws — exactly what the fused capped-support refit wants
+    (the KDE covariance is a weighted second moment).  Sorted draws also
+    make the two-level inversion's block gathers near-sequential.
+    Consumes one scalar uniform, not ``n``.
+    """
+    w = jax.nn.softmax(log_w)
+    cdf = jnp.cumsum(w)
+    u0 = jax.random.uniform(key, (), dtype=cdf.dtype)
+    u = (u0 + jnp.arange(n, dtype=cdf.dtype)) / n * cdf[-1]
+    return _invert_cdf(cdf, _cap_draws(cdf, u))

@@ -90,6 +90,19 @@ def narrow_wire(view: dict, valid, wire_stats: bool, wire_m_bits: bool
     return wire
 
 
+def slice_block_wire(wires: dict, k: int) -> dict:
+    """Take generation ``k``'s slice of a fused K-generation block wire.
+
+    Every lane the fused scan stacks — narrow columns, their
+    ``{k}_scale`` companions, and the ``count``/``rounds``/``eps``
+    scalars — carries a leading K axis, so a plain leading-index view is
+    the whole slice.  The result feeds ``wire.ingest.split_gen_wire``;
+    indexing on device keeps the per-generation d2h transaction to one
+    generation's bytes (the streamed-fetch unit) instead of the block's.
+    """
+    return {key: v[k] for key, v in wires.items()}
+
+
 def build_stateful_loop(raw_round: Callable, B: int, n_target: int,
                         max_rounds: int, record_cap: int, d: int, s: int,
                         weight_correction: Callable = None,

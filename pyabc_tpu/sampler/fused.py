@@ -5,12 +5,13 @@ generation is one ~0.1 s relay round-trip plus a small fetch, so the
 per-generation wall clock is the HOST choreography, not device work.
 For configurations whose per-generation adaptation is fully
 device-computable — KDE transition refit, weighted-quantile epsilon,
-model probabilities — the entire propose → accept → refit → new-eps
-chain for K generations runs inside one ``lax.scan``; the host makes one
-call and fetches K narrow-wire populations in one transaction, then
-writes K durable History generations (the reference's per-generation
-writes, smc.py:921 analog, become every-K — each generation's stored
-content is unchanged).
+model probabilities, adaptive distance-scale refit, acceptance-rate
+temperature solve — the entire propose → accept → refit → new-eps chain
+for K generations runs inside one ``lax.scan``; the host makes one call
+and fetches K narrow-wire populations (streamed per generation through
+``wire.GenStream``), then writes K durable History generations (the
+reference's per-generation writes, smc.py:921 analog, become every-K —
+each generation's stored content is unchanged).
 
 Sequential-equivalence contract (mirrors the host loop in smc.py):
 
@@ -19,24 +20,43 @@ Sequential-equivalence contract (mirrors the host loop in smc.py):
 - per-model refit selects that model's rows, renormalizes weights, and
   applies ``smart_cov × bandwidth² × scaling`` with the same jitter as
   ``MultivariateNormalTransition._fit``; supports are zero-padded with
-  ``-1e30`` log weights exactly like ``_device_supports``;
+  ``-1e30`` log weights exactly like ``_device_supports``.  Above
+  ``support_cap`` rows the support is first resampled to a fixed-size
+  uniform-weight support by systematic inverse-CDF (capped-support
+  refit) — O(cap) refit cost at any population; below the cap the exact
+  path runs unchanged (bit-identical wires);
 - epsilon follows ``QuantileEpsilon._update`` (weighted quantile of the
-  previous generation's accepted distances × multiplier) or stays
-  constant;
+  previous generation's accepted distances × multiplier), stays
+  constant, or — for the stochastic-acceptance triple — is the
+  acceptance-rate temperature solve over the carried candidate records
+  (``epsilon.temperature.acceptance_rate_solve_trace``) with the host
+  ``Temperature._update`` clamp semantics;
+- an adaptive p-norm distance refits its scale weights each generation
+  from the last rejection round's candidate statistics (documented
+  approximation of the host fit's all-records sample) with the exact
+  ``AdaptivePNormDistance._fit`` recipe, and re-evaluates the carried
+  distances under the new weights so the next quantile epsilon matches
+  the sequential ``_prepare_next_iteration`` re-evaluation;
 - the rejection loop is the same scatter-compaction protocol as
   ``device_loop.build_stateful_loop`` (deterministic round order,
   truncate to first n), with the proposal-density correction deferred
-  to once per generation.
+  to once per generation.  The per-generation round CAP adapts in-scan:
+  an EWMA acceptance-rate estimate (``autotune.tuner.EWMA_ALPHA``, the
+  same gain as the host ``BatchAutotuner``) carried across generations
+  sizes each generation's rounds, so no new programs compile and the
+  round count tracks the annealing acceptance decay instead of a frozen
+  worst-case margin.
 
-Eligibility is decided by the orchestrator (``ABCSMC._fused_eligible``):
-non-adaptive distance, UniformAcceptor, Constant/Quantile epsilon, pure
-``MultivariateNormalTransition`` proposals, constant population size, no
-record consumers.  Anything else falls back to the sequential path.
+Eligibility is decided by the orchestrator (``ABCSMC._fused_eligible``)
+from the components' device-capability flags (``device_accept_ok``,
+``device_schedule_ok``, ``device_refit_ok``, ``device_support_ok`` —
+kept in sync by tools/check_fused_eligibility.py).  Anything else falls
+back to the sequential path.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -98,7 +118,8 @@ def _compress_support_device(sup, w, ok, chol):
 
 
 def _refit_model(theta, log_w, valid, m_col, j, dim_j, n_target,
-                 bandwidth_selector, scaling):
+                 bandwidth_selector, scaling,
+                 support_cap: Optional[int] = None, key=None):
     """Device refit of model j's MVN-KDE from the carry population.
 
     Returns the params dict ``MultivariateNormalTransition.get_params``
@@ -107,10 +128,50 @@ def _refit_model(theta, log_w, valid, m_col, j, dim_j, n_target,
     same static-pytree dispatch the host fit uses), padded to
     ``n_target`` rows (pad rows carry -1e30 log weight, as
     ``_device_supports``).
+
+    When ``support_cap`` is set and ``n_target`` exceeds it, the model's
+    weighted rows are first resampled to a ``support_cap``-row
+    UNIFORM-weight support by systematic inverse-CDF
+    (``ops.choice.systematic_weighted_choice`` — one uniform draw from
+    ``key``, stratified offsets), and the same covariance recipe runs on
+    the resampled support: refit cost becomes O(cap·d²) regardless of
+    population size, and every downstream proposal-density evaluation
+    sums cap rows instead of n_target.  Below the cap this branch is
+    never built, so sub-cap programs are byte-identical to the exact
+    refit (no extra RNG ops enter the trace).
     """
     from ..transition.multivariatenormal import regularized_kde_cov
 
     n_rows = theta.shape[0]
+    if support_cap is not None and n_target > support_cap:
+        from ..ops.choice import systematic_weighted_choice
+
+        sel = valid & (m_col == j)
+        any_sel = jnp.any(sel)
+        lw_sel = jnp.where(sel & jnp.isfinite(log_w), log_w, -jnp.inf)
+        # dead model: point-mass on row 0 keeps the inverse CDF finite;
+        # the output log_w is forced to -1e30 below so the density
+        # matches the exact path's ~zero contribution
+        lw_safe = jnp.where(any_sel, lw_sel,
+                            jnp.where(jnp.arange(n_rows) == 0, 0.0,
+                                      -jnp.inf))
+        idx = systematic_weighted_choice(key, lw_safe, support_cap)
+        sup = theta[idx, :dim_j]
+        # systematic resampling yields equally-weighted rows
+        w = jnp.full((support_cap,), 1.0 / support_cap, jnp.float32)
+        lw = jnp.full((support_cap,), -jnp.log(float(support_cap)),
+                      jnp.float32)
+        cov = regularized_kde_cov(sup, w, bandwidth_selector, scaling)
+        chol = jnp.linalg.cholesky(cov)
+        log_norm = (-0.5 * dim_j * jnp.log(2 * jnp.pi)
+                    - jnp.sum(jnp.log(jnp.diag(chol))))
+        params = {"support": sup,
+                  "log_w": jnp.where(any_sel, lw, -1e30),
+                  "chol": chol, "log_norm": log_norm}
+        # no grid compression: the cap is already _DEVICE_GRID-sized, so
+        # the pair budget is met by construction
+        return params, jnp.bool_(True)
+
     sel = valid & (m_col == j)
     idx = jnp.nonzero(sel, size=n_target, fill_value=n_rows)[0]
     ok = idx < n_rows
@@ -166,23 +227,42 @@ def build_fused_generations(
         K: int,
         d: int,
         s: int,
-        eps_mode: str,            # "constant" | "quantile"
+        eps_mode: str,            # "constant" | "quantile" | "temperature"
         eps_alpha: float,
         eps_multiplier: float,
         eps_weighted: bool,
         distance_params,
         wire_stats: bool,
         wire_m_bits: bool,
-        raw_round: Callable):
-    """Compile-ready ``fused(carry, key) -> (carry, wires)`` for K
-    generations.  ``carry`` = the previous generation's accepted
+        raw_round: Callable,
+        support_cap: Optional[int] = None,
+        rate_pred_factor: float = 1.0,
+        adaptive_cfg: Optional[dict] = None,
+        stoch_cfg: Optional[dict] = None):
+    """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
+    for K generations.  ``carry`` = the previous generation's accepted
     population on device: dict(m[i32 n], theta[f32 n,d], log_weight
-    [f32 n], distance[f32 n], count[i32], eps[f32]).
+    [f32 n], distance[f32 n], stats[f32 n,s], count[i32], eps[f32],
+    rate[f32], safety[f32]); an adaptive distance adds ``dist_w``
+    [f32 s] (the RAW inverse-scale weights, pre fixed-factor), the
+    stochastic triple adds the candidate record ring ``rec_m``/
+    ``rec_theta``/``rec_dist``/``rec_loggen`` (R rows) feeding the
+    in-scan temperature solve.  The ``stats`` lane is write-only inside
+    the scan (the input seed may be zeros); it exits as the last
+    generation's accepted stats so a block-boundary
+    ``_prepare_next_iteration`` can re-evaluate distances ON device.
+
+    ``rate``/``safety`` are the in-scan autotuner state: an EWMA
+    acceptance-rate estimate (gain ``autotune.tuner.EWMA_ALPHA``) and an
+    undershoot-escalated safety margin that together size each
+    generation's rejection-round cap — ``max_rounds`` stays the static
+    ceiling, so adaptation only ever SHRINKS work.
 
     ``wires`` stacks K narrow-wire generation payloads (leading axis K):
     the same f16/per-column-scale/bit-packed format as
     ``device_loop.finalize`` plus per-generation ``eps``/``count``/
-    ``rounds`` scalars.
+    ``rounds`` scalars.  ``device_loop.slice_block_wire`` takes one
+    generation's slice for the streamed per-generation fetch.
 
     ``raw_round(key, params) -> RoundResult`` is the SAMPLER's round
     builder for the kernel's deferred generation round at batch ``B``
@@ -190,16 +270,56 @@ def build_fused_generations(
     with_proposal=False)``): for a ``ShardedSampler`` that is the
     shard_mapped round, so the whole fused scan SPMDs over the mesh
     exactly like the per-generation loop.
+
+    ``eps_mode == "temperature"`` requires ``stoch_cfg`` (keys
+    ``pdf_norm`` — the kernel-derived log normalization constant,
+    ``target_rate``, ``lin_scale``, ``record_rows``); ``adaptive_cfg``
+    (keys ``scale_fn``, ``distance_fn``, ``obs_flat``,
+    ``max_weight_ratio``, ``normalize_weights``, ``factors``) switches
+    on the in-scan distance refit.  When ``stoch_cfg`` is set the
+    returned ``fused`` takes a third argument ``final_mask`` [K bool]:
+    True pins that generation's temperature to 1
+    (``Temperature._update``'s final-generation rule).
     """
+    from ..autotune.tuner import EWMA_ALPHA
     from .device_loop import narrow_wire
 
     M = kernel.M
     cap = n_target + B
+    stoch = stoch_cfg is not None
+    adaptive = adaptive_cfg is not None
+    if eps_mode == "temperature" and not stoch:
+        raise ValueError("temperature eps_mode requires stoch_cfg")
+    if stoch:
+        pdf_norm_c = jnp.float32(stoch_cfg["pdf_norm"])
+        target_c = jnp.float32(stoch_cfg["target_rate"])
+        lin_scale = bool(stoch_cfg["lin_scale"])
+        R = int(stoch_cfg["record_rows"])
+        if not 0 < R <= B:
+            raise ValueError("record_rows must be in (0, B]")
+    if adaptive:
+        scale_fn = adaptive_cfg["scale_fn"]
+        dist_fn = adaptive_cfg["distance_fn"]
+        obs_flat = jnp.asarray(adaptive_cfg["obs_flat"], jnp.float32)
+        max_weight_ratio = adaptive_cfg.get("max_weight_ratio")
+        normalize_weights = bool(adaptive_cfg.get("normalize_weights",
+                                                  True))
+        factors = adaptive_cfg.get("factors")
+        if factors is not None:
+            factors = jnp.asarray(factors, jnp.float32)
+    capped = support_cap is not None and n_target > support_cap
+    rounds_hi = float(max_rounds)
+    rounds_lo = min(2.0, rounds_hi)
 
-    def one_generation(carry, gen_key):
+    def one_generation(carry, xs):
+        if stoch:
+            gen_key, final_flag = xs["key"], xs["final"]
+        else:
+            gen_key = xs
         m0, theta0, lw0, dist0, count0, eps0 = (
             carry["m"], carry["theta"], carry["log_weight"],
             carry["distance"], carry["count"], carry["eps"])
+        rate0, safety0 = carry["rate"], carry["safety"]
         n_rows = m0.shape[0]
         valid0 = jnp.arange(n_rows) < count0
 
@@ -215,28 +335,83 @@ def build_fused_generations(
         model_log_probs = jnp.log(jnp.maximum(probs, 1e-300)).astype(
             jnp.float32)
 
-        # epsilon for THIS generation (QuantileEpsilon._update semantics)
-        if eps_mode == "constant":
-            eps_t = eps0
-        else:
-            qw = w if eps_weighted else jnp.where(valid0, 1.0, 0.0)
-            eps_t = (_weighted_quantile_device(dist0, qw, valid0,
-                                               eps_alpha)
-                     * eps_multiplier)
-
-        # per-model KDE refit (device analog of _fit_transitions)
+        # per-model KDE refit (device analog of _fit_transitions);
+        # capped builds draw resampling keys by fold_in so the while-
+        # loop's split chain from gen_key is untouched (sub-cap RNG
+        # stream stays identical to the exact build)
+        rs_key = jax.random.fold_in(gen_key, 7919) if capped else None
         refits = [
             _refit_model(theta0, lw0, valid0, m0, j, dims[j], n_target,
-                         bandwidth_selectors[j], scalings[j])
+                         bandwidth_selectors[j], scalings[j],
+                         support_cap=support_cap,
+                         key=(jax.random.fold_in(rs_key, j)
+                              if capped else None))
             for j in range(M)]
         trans = tuple(p for p, _ in refits)
         grids_resolved = refits[0][1]
         for _, r in refits[1:]:
             grids_resolved &= r
-        params = {"distance": distance_params,
-                  "acceptor": {"eps": eps_t},
+
+        # epsilon for THIS generation
+        if eps_mode == "constant":
+            eps_t = eps0
+        elif eps_mode == "quantile":
+            # QuantileEpsilon._update semantics
+            qw = w if eps_weighted else jnp.where(valid0, 1.0, 0.0)
+            eps_t = (_weighted_quantile_device(dist0, qw, valid0,
+                                               eps_alpha)
+                     * eps_multiplier)
+        else:  # "temperature": in-scan acceptance-rate solve
+            from ..epsilon.temperature import acceptance_rate_solve_trace
+
+            rec_m0, rec_theta0 = carry["rec_m"], carry["rec_theta"]
+            rec_dist0, rec_loggen0 = (carry["rec_dist"],
+                                      carry["rec_loggen"])
+            params_prop = {"distance": distance_params,
+                           "model_log_probs": model_log_probs,
+                           "transition": trans}
+            log_new = kernel.proposal_log_density(rec_m0, rec_theta0,
+                                                  params_prop)
+            b_opt, rate_at_1, rate_min = acceptance_rate_solve_trace(
+                rec_dist0, log_new - rec_loggen0, pdf_norm_c, target_c,
+                lin_scale)
+            # AcceptanceRateScheme device branch: already-hot records →
+            # T = 1; target unreachable even at the coldest beta → +inf
+            # proposal (the clamp below then keeps the previous temp —
+            # the NaN-seeded first-block records land here by design)
+            t_prop = jnp.where(rate_at_1 > target_c, 1.0,
+                               jnp.where(rate_min < target_c, jnp.inf,
+                                         jnp.exp(-b_opt)))
+            # Temperature._update: monotone clamp vs prev, floor at 1;
+            # prev ≤ 1 or the run's final generation pins T = 1
+            t_new = jnp.maximum(jnp.minimum(t_prop, eps0), 1.0)
+            eps_t = jnp.where((eps0 <= 1.0) | final_flag,
+                              jnp.float32(1.0), t_new)
+
+        if stoch:
+            acc_params = {"pdf_norm": pdf_norm_c, "temp": eps_t}
+        else:
+            acc_params = {"eps": eps_t}
+        if adaptive:
+            dist_w0 = carry["dist_w"]
+            w_eff0 = dist_w0 * factors if factors is not None else dist_w0
+            dparams = {"w": w_eff0}
+        else:
+            dparams = distance_params
+        params = {"distance": dparams,
+                  "acceptor": acc_params,
                   "model_log_probs": model_log_probs,
                   "transition": trans}
+
+        # in-scan rate adaptation: size this generation's round cap from
+        # the carried EWMA acceptance-rate estimate (the host
+        # BatchAutotuner's semantics — same EWMA gain, same 1.25x
+        # undershoot escalation capped at 4x — but in the carry, so the
+        # cap adapts per generation with zero recompiles).  +1 round of
+        # slack, floor 2, never beyond the static max_rounds ceiling.
+        pred = jnp.maximum(rate0, 1e-6) * jnp.float32(rate_pred_factor)
+        need = jnp.ceil(jnp.float32(n_target) / (pred * B) * safety0) + 1.0
+        dyn_rounds = jnp.clip(need, rounds_lo, rounds_hi).astype(jnp.int32)
 
         # rejection rounds with scatter compaction (device_loop protocol)
         bufs = {
@@ -246,13 +421,22 @@ def build_fused_generations(
             "log_weight": jnp.full((cap,), -jnp.inf, jnp.float32),
             "stats": jnp.zeros((cap, s), jnp.float32),
         }
+        if adaptive:
+            # last round's candidate stats feed the end-of-generation
+            # scale refit (loop always runs ≥ 1 round: count starts 0)
+            extras = {"cs": jnp.zeros((B, s), jnp.float32)}
+        elif stoch:
+            extras = {"rm": carry["rec_m"], "rtheta": carry["rec_theta"],
+                      "rdist": carry["rec_dist"]}
+        else:
+            extras = {}
 
         def cond(st):
-            _, b, count, rounds = st
-            return (count < n_target) & (rounds < max_rounds)
+            _, _, count, rounds, _ = st
+            return (count < n_target) & (rounds < dyn_rounds)
 
         def body(st):
-            key, b, count, rounds = st
+            key, b, count, rounds, ex = st
             key, sub = jax.random.split(key)
             rr = raw_round(sub, params)
             acc = rr.accepted
@@ -268,41 +452,102 @@ def build_fused_generations(
             b["stats"] = b["stats"].at[idx].set(rr.stats, mode="drop")
             count = jnp.minimum(count + jnp.sum(acc.astype(jnp.int32)),
                                 cap)
-            return key, b, count, rounds + 1
+            if adaptive:
+                ex = {"cs": rr.stats}
+            elif stoch:
+                # the newest B candidates' head refreshes the record
+                # ring (accepted AND rejected — record_rejected
+                # semantics of the host temperature scheme)
+                ex = {"rm": rr.m[:R], "rtheta": rr.theta[:R],
+                      "rdist": rr.distance[:R]}
+            return key, b, count, rounds + 1, ex
 
-        _, bufs, count1, rounds1 = lax.while_loop(
-            cond, body, (gen_key, bufs, jnp.int32(0), jnp.int32(0)))
+        _, bufs, count1, rounds1, extras = lax.while_loop(
+            cond, body,
+            (gen_key, bufs, jnp.int32(0), jnp.int32(0), extras))
 
-        # deferred proposal-density correction over the accepted buffer.
-        # When every compressed grid resolves its bandwidth the ~2^14
-        # cells stand in for the full support; otherwise (outlier-
-        # stretched range) the EXACT support is evaluated — the
-        # eligibility pair-budget keeps that branch affordable, and
-        # lax.cond executes only the chosen side
+        # EWMA rate/safety update for the NEXT generation's round cap
+        obs_rate = (count1.astype(jnp.float32)
+                    / jnp.maximum(rounds1 * B, 1).astype(jnp.float32))
+        rate1 = jnp.maximum(rate0 + EWMA_ALPHA * (obs_rate - rate0),
+                            1e-6)
+        safety1 = jnp.where(count1 < n_target,
+                            jnp.minimum(safety0 * 1.25, 4.0), safety0)
+
+        # deferred proposal-density correction over the accepted buffer
+        # (and, for the stochastic triple, the record ring's generating
+        # density — one evaluation serves both).  When every compressed
+        # grid resolves its bandwidth the ~2^14 cells stand in for the
+        # full support; otherwise (outlier-stretched range) the EXACT
+        # support is evaluated — the eligibility pair-budget keeps that
+        # branch affordable, and lax.cond executes only the chosen side
         m1 = bufs["m"][:n_target]
         theta1 = bufs["theta"][:n_target]
         dist1 = bufs["distance"][:n_target]
         stats1 = bufs["stats"][:n_target]
         lw1 = bufs["log_weight"][:n_target]
+        if stoch:
+            m_q = jnp.concatenate([m1, extras["rm"]])
+            th_q = jnp.concatenate([theta1, extras["rtheta"]], axis=0)
+        else:
+            m_q, th_q = m1, theta1
         has_grids = any("c_support" in p for p in trans)
         if has_grids:
             trans_exact = tuple(
                 {k: v for k, v in p.items()
                  if k not in ("c_support", "c_log_w")} for p in trans)
             params_exact = {**params, "transition": trans_exact}
-            log_denom = lax.cond(
+            log_den_q = lax.cond(
                 grids_resolved,
                 lambda args: kernel.proposal_log_density(
                     args[0], args[1], params),
                 lambda args: kernel.proposal_log_density(
                     args[0], args[1], params_exact),
-                (m1, theta1))
+                (m_q, th_q))
         else:
-            log_denom = kernel.proposal_log_density(m1, theta1, params)
+            log_den_q = kernel.proposal_log_density(m_q, th_q, params)
+        log_denom = log_den_q[:n_target]
         lw1 = jnp.where(jnp.isfinite(lw1), lw1 - log_denom, lw1)
 
+        if adaptive:
+            # end-of-generation scale refit from the last round's B
+            # candidate stats — the in-scan stand-in for the host fit's
+            # all-records sample; same scale → invert → ratio-clamp →
+            # normalize recipe as AdaptivePNormDistance._fit
+            scale = scale_fn(extras["cs"], obs_flat)
+            w_new = jnp.where(scale > 0,
+                              1.0 / jnp.maximum(scale, 1e-30), 0.0)
+            if max_weight_ratio is not None:
+                pos_min = jnp.min(jnp.where(w_new > 0, w_new, jnp.inf))
+                w_new = jnp.where(
+                    jnp.isfinite(pos_min),
+                    jnp.minimum(w_new, pos_min * max_weight_ratio),
+                    w_new)
+            if normalize_weights:
+                wsum = jnp.sum(w_new)
+                w_new = jnp.where(wsum > 0, w_new * s / wsum, w_new)
+            w_new = w_new.astype(jnp.float32)
+            w_eff1 = w_new * factors if factors is not None else w_new
+            # the next generation's quantile epsilon must see the
+            # carried distances under the REFIT weights (sequential
+            # parity: _prepare_next_iteration re-evaluates population
+            # distances after a distance update); the wire keeps the
+            # acceptance-time distances for History
+            dist_carry = dist_fn(stats1, obs_flat, {"w": w_eff1})
+        else:
+            dist_carry = dist1
+
         new_carry = {"m": m1, "theta": theta1, "log_weight": lw1,
-                     "distance": dist1, "count": count1, "eps": eps_t}
+                     "distance": dist_carry, "stats": stats1,
+                     "count": count1, "eps": eps_t, "rate": rate1,
+                     "safety": safety1}
+        if adaptive:
+            new_carry["dist_w"] = w_new
+        if stoch:
+            new_carry["rec_m"] = extras["rm"]
+            new_carry["rec_theta"] = extras["rtheta"]
+            new_carry["rec_dist"] = extras["rdist"]
+            new_carry["rec_loggen"] = log_den_q[n_target:]
 
         # narrow wire entry (the shared encoder — device_loop.narrow_wire)
         valid1 = jnp.arange(n_target) < count1
@@ -315,8 +560,12 @@ def build_fused_generations(
         wire["eps"] = eps_t
         return new_carry, wire
 
-    def fused(carry, key):
+    def fused(carry, key, final_mask=None):
         keys = jax.random.split(key, K)
-        return lax.scan(one_generation, carry, keys)
+        if stoch:
+            xs = {"key": keys, "final": final_mask}
+        else:
+            xs = keys
+        return lax.scan(one_generation, carry, xs)
 
     return fused

@@ -161,6 +161,19 @@ class ABCSMC:
         self.fuse_generations = int(fuse_generations)
         self._fused_cache: Dict[tuple, Callable] = {}
         self._fused_carry = None
+        #: capped-support refit (sampler/fused.py): above this many
+        #: particles a fused block resamples each model's accepted rows
+        #: to this many uniform-weight support rows (systematic
+        #: inverse-CDF) before the KDE refit, making the refit O(cap)
+        #: at any population size.  None disables; below the cap the
+        #: exact refit runs unchanged (bit-identical programs).
+        self.fused_support_cap: Optional[int] = 1 << 14
+        #: probe-based engine selection at scale (populations above
+        #: PROBE_MIN_POP): None until the first at-scale fused block is
+        #: timed against the sequential-loop baseline, then "fused" or
+        #: "sequential" (recorded on timeline rows / bench summary)
+        self._engine_choice: Optional[str] = None
+        self._seq_probe_s: Optional[float] = None
         if ingest_mode not in ("auto", "overlap", "sequential"):
             raise ValueError(
                 "ingest_mode must be 'auto', 'overlap' or 'sequential' "
@@ -299,6 +312,10 @@ class ABCSMC:
         # from the previous run's population
         self._fused_carry = None
         self._fused_cache.clear()
+        # ... nor inherit its engine-probe decision: a new observed
+        # dataset changes the simulate/accept cost balance
+        self._engine_choice = None
+        self._seq_probe_s = None
         self.spec = SumStatSpec.from_example(self.x_0)
         self._obs_flat = self.spec.flatten_single(self.x_0)
         self.distance_function.bind(self.spec, self.x_0)
@@ -447,9 +464,18 @@ class ABCSMC:
         shared precondition of the fused multi-generation engine AND the
         overlapped streaming-ingest pipeline (wire/), both of which run
         generations from a device-resident carry with no host adaptation
-        in between.  Anything outside the known-safe component set falls
-        back to the sequential loop."""
-        from .epsilon.epsilon import ConstantEpsilon, QuantileEpsilon
+        in between.
+
+        Decided from the components' own capability flags —
+        ``device_accept_ok`` (acceptor), ``device_schedule_ok``
+        (epsilon; for a Temperature it reduces to ``device_solve_ok``,
+        the in-scan acceptance-rate solve), ``device_refit_ok``
+        (adaptive distance), ``device_support_ok`` (transition) — so a
+        component that grows a
+        device path opts in WHERE ITS SEMANTICS LIVE instead of by an
+        isinstance whitelist here (tools/check_fused_eligibility.py
+        keeps this body and the flag owners in sync).  Anything outside
+        the flagged set falls back to the sequential loop."""
         from .sampler.sharded import ShardedSampler
         from .sampler.vectorized import VectorizedSampler
         s = self.sampler
@@ -460,16 +486,32 @@ class ABCSMC:
             # every wire entry; the per-generation loop already handles
             # that path — keep it
             return False
-        if s.record_rejected:
+        if not getattr(self.acceptor, "device_accept_ok", False):
             return False
-        if type(self.acceptor) is not UniformAcceptor \
-                or self.acceptor.use_complete_history:
+        if not getattr(self.eps, "device_schedule_ok", False):
             return False
-        if not isinstance(self.eps, (ConstantEpsilon, QuantileEpsilon)):
+        temp = isinstance(self.eps, TemperatureBase)
+        stoch = isinstance(self.distance_function, StochasticKernel)
+        adaptive = self._distance_is_adaptive()
+        if temp != stoch:
+            # the stochastic triple is all-or-none (_sanity_check); a
+            # half-configured chain can never run fused
             return False
-        if isinstance(self.distance_function, StochasticKernel) \
-                or self._distance_is_adaptive() \
-                or not self.distance_function.params_time_invariant():
+        if adaptive:
+            if stoch:
+                return False  # no in-scan refit of a StochasticKernel
+            if not getattr(self.distance_function, "device_refit_ok",
+                           False):
+                return False
+        elif not self.distance_function.params_time_invariant():
+            return False
+        # record streams: the fused block substitutes device-side
+        # stand-ins (the last round's candidate stats for an adaptive
+        # refit, the R-row record ring for the temperature solve); any
+        # OTHER consumer of recorded candidates needs the host loop
+        if s.record_rejected and not (adaptive or temp):
+            return False
+        if getattr(s, "record_proposal_density", False) and not temp:
             return False
         if type(self.population_strategy) is not ConstantPopulationSize:
             return False
@@ -477,41 +519,92 @@ class ABCSMC:
                    "nr_samples_per_parameter", 1) != 1:
             return False
         if not all(type(tr) is MultivariateNormalTransition
+                   and getattr(tr, "device_support_ok", False)
                    for tr in self.transitions):
             return False
         # bound the per-generation deferred proposal correction: n
-        # queries x the pdf-support rows of every model (large 1-D
-        # models compress to a ~2^14 device grid,
-        # fused._compress_support_device; others keep full n rows)
+        # queries x the pdf-support rows of every model (above the
+        # capped-support threshold every model is a fixed cap rows;
+        # large 1-D models otherwise compress to a ~2^14 device grid,
+        # fused._compress_support_device; the rest keep full n rows)
         from .sampler.fused import _DEVICE_GRID
         from .transition.multivariatenormal import _COMPRESS_MIN_N
         n = self.population_strategy(0)
-        rows = sum(
-            (_DEVICE_GRID if (p.dim == 1 and n >= _COMPRESS_MIN_N)
-             else n)
-            for p in self.parameter_priors)
+        cap = self.fused_support_cap
+
+        def support_rows(dim: int) -> int:
+            if cap is not None and n > cap:
+                return cap
+            if dim == 1 and n >= _COMPRESS_MIN_N:
+                return _DEVICE_GRID
+            return n
+
+        rows = sum(support_rows(p.dim) for p in self.parameter_priors)
         if float(n) * rows > float(1 << 35):
             return False
         return True
 
+    #: population size above which the fused-vs-sequential choice is no
+    #: longer assumed but PROBED: the first at-scale fused block's
+    #: measured s/gen is compared against the sequential baseline and
+    #: the loser is retired for the rest of the run (the decision lands
+    #: in the timeline's ``engine`` column).  Below this the fused
+    #: engine always wins — the dispatch floor dominates.
+    PROBE_MIN_POP = 1 << 17
+
+    #: record-ring rows carried through a fused block for the in-scan
+    #: temperature solve (candidate records, accepted AND rejected) —
+    #: the host scheme sees every candidate; the ring keeps the newest
+    #: min(this, B) per generation
+    _RECORD_ROWS_MAX = 1 << 12
+
     def _fused_eligible(self) -> bool:
         """Run ``fuse_generations`` generations per dispatch?  Requires
-        the device-computable chain, and pays off only in the
-        DISPATCH-FLOORED regime (small-to-mid populations where a
-        generation is one relay round-trip); measured same-session at
-        pop 1e6 the fused block is ~25 % SLOWER than the per-generation
-        loop (full-support gathers per refit, no early-stop rate
-        adaptation, worse per-byte relay throughput on block-sized
-        transactions) — transfer dominates there and fusion has no
-        headroom.  Cap at 2^17 particles; above it the overlapped
-        ingest pipeline (wire/) is the scaling lever instead."""
+        the device-computable chain.  With the rate-adaptive round cap,
+        capped-support refit and streamed per-generation block fetch the
+        fused engine is no longer assumed to lose at scale: above
+        PROBE_MIN_POP the first fused block PROBES the actual s/gen
+        against the sequential baseline (``_decide_engine``) and only a
+        measured loss retires fusion — replacing the static population
+        cap this method used to carry."""
         if self._fault_fused_off:
             return False  # degraded after a retry-exhausted block dispatch
         if self.fuse_generations < 2:
             return False
-        if self.population_strategy(0) > (1 << 17):
-            return False
+        if (self.population_strategy(0) > self.PROBE_MIN_POP
+                and self._engine_choice == "sequential"):
+            return False  # the at-scale probe measured fused slower
         return self._device_chain_eligible()
+
+    def _note_sequential_gen_s(self, wall_s: float, compile_s: float = 0.0):
+        """Record a sequential generation's steady-state seconds as the
+        engine probe's baseline (compile time excluded — the fused
+        block's probe sample excludes its own).  Generation 0 never
+        lands here: its prior-predictive round has no refit/proposal
+        work, so it would bias the baseline low."""
+        steady = wall_s - compile_s
+        if steady > 1e-9:
+            self._seq_probe_s = steady
+
+    def _decide_engine(self, fused_s_per_gen: float) -> str:
+        """One-shot fused-vs-sequential selection at scale, from the
+        first at-scale fused block's measured steady-state s/gen.  A 5 %
+        hysteresis band avoids flapping on noise; with no sequential
+        baseline observed yet (the run fused from its first eligible
+        generation) fused is kept — a later retry-degrade still exists
+        as the safety net."""
+        if self._engine_choice is None:
+            seq = self._seq_probe_s
+            if seq is None or fused_s_per_gen <= seq * 1.05:
+                self._engine_choice = "fused"
+            else:
+                self._engine_choice = "sequential"
+            logger.info(
+                "engine probe: fused %.4g s/gen vs sequential %s s/gen "
+                "-> %s", fused_s_per_gen,
+                "n/a" if seq is None else f"{seq:.4g}",
+                self._engine_choice)
+        return self._engine_choice
 
     #: "auto" ingest overlaps only at transfer-bound population sizes;
     #: below this the fetch is sub-millisecond and pipelining would only
@@ -545,8 +638,124 @@ class ABCSMC:
         from .epsilon.epsilon import ConstantEpsilon
         if isinstance(self.eps, ConstantEpsilon):
             return "constant", 0.5, 1.0, True
+        if isinstance(self.eps, TemperatureBase):
+            # the in-scan acceptance-rate solve replaces the quantile
+            # schedule; alpha/multiplier/weighted are unused
+            return "temperature", 0.5, 1.0, True
         return ("quantile", self.eps.alpha, self.eps.quantile_multiplier,
                 self.eps.weighted)
+
+    def _block_mode(self) -> dict:
+        """Which in-scan adaptation chains a device block must carry."""
+        return {"adaptive": self._distance_is_adaptive(),
+                "stoch": isinstance(self.acceptor, StochasticAcceptor)}
+
+    def _block_record_rows(self, B: int) -> int:
+        """Record-ring rows of a stochastic-triple block (<= one round's
+        candidates; bounded so the ring never dominates the carry)."""
+        return min(self._RECORD_ROWS_MAX, B)
+
+    def _final_mask(self, t: int, K: int):
+        """[K] bool — which generations of a block starting at ``t`` are
+        the run's FINAL generation (``Temperature._update`` pins their
+        temperature to 1, matching enforce_exact_final_temperature)."""
+        nr_pop = self.max_nr_populations
+        if not np.isfinite(nr_pop):
+            return jnp.zeros((K,), bool)
+        return jnp.asarray([(t + k) >= nr_pop - 1 for k in range(K)],
+                           bool)
+
+    def _dist_compute_fn(self):
+        """Lazily-jitted ``distance.compute`` (shared by the block-carry
+        seeding and ``_prepare_next_iteration`` — one compiled program
+        instead of an eager op-chain, each eager op pays the relay
+        submission constant)."""
+        if self._jit_dist_compute is None:
+            self._jit_dist_compute = jit_compile(
+                lambda s, o, p: self.distance_function.compute(s, o, p))
+        return self._jit_dist_compute
+
+    def _seed_block_carry(self, t: int, carry: dict, B: int,
+                          rate_est: float, safety: float):
+        """Build a fused block's full device carry from either the
+        previous block's ``carry_out`` (all lanes present — passed
+        through) or a sequential generation's ``Sample.device_population``
+        (base lanes only — the mode-dependent lanes are seeded here).
+        Returns None when the seed cannot reproduce the sequential
+        chain's state for ``t`` (caller takes the sequential path)."""
+        mode = self._block_mode()
+        eps_mode = self._eps_device_config()[0]
+        n = carry["theta"].shape[0]
+        carry_in = {
+            "m": carry["m"], "theta": carry["theta"],
+            "log_weight": carry["log_weight"],
+            "distance": carry["distance"], "count": carry["count"],
+            "stats": (carry["stats"] if "stats" in carry
+                      else jnp.zeros((n, self.spec.total_size),
+                                     jnp.float32)),
+        }
+        if eps_mode == "constant":
+            # the scan passes the lane through unchanged (eps_t = eps0)
+            carry_in["eps"] = jnp.float32(self.eps(t))
+        elif "eps" in carry:
+            carry_in["eps"] = jnp.asarray(carry["eps"], jnp.float32)
+        elif eps_mode == "temperature":
+            # the newest host-known temperature <= t is the monotone-
+            # clamp ceiling of the block's first solve: at a prepared
+            # sequential boundary that is the solved T_t itself; at a
+            # pipelined dispatch ahead of the host schedule (or a fused
+            # continuation whose host update degraded on empty records)
+            # it is T_{t-1} — exactly the value Temperature._update
+            # would keep
+            temps = getattr(self.eps, "temperatures", {})
+            known = [tt for tt in temps if tt <= t]
+            if not known:
+                return None
+            carry_in["eps"] = jnp.float32(temps[max(known)])
+        else:
+            # quantile: the lane is recomputed in-scan (seed is unused)
+            carry_in["eps"] = jnp.float32(self.eps(t))
+        carry_in["rate"] = jnp.float32(
+            carry["rate"] if "rate" in carry else max(rate_est, 1e-6))
+        carry_in["safety"] = jnp.float32(
+            carry["safety"] if "safety" in carry else safety)
+        if mode["adaptive"]:
+            if "dist_w" in carry:
+                carry_in["dist_w"] = carry["dist_w"]
+            else:
+                # seeding from a sequential generation: the host refit
+                # for t already ran (_prepare_next_iteration) — carry
+                # its RAW weights, and re-evaluate the carry distances
+                # under them (the device population still holds
+                # acceptance-time distances from w_{t-1}; the first
+                # in-scan quantile must see w_t — sequential parity)
+                if "stats" not in carry:
+                    return None
+                w_host = self.distance_function._weights_for(t)
+                carry_in["dist_w"] = jnp.asarray(
+                    np.asarray(w_host, np.float32))
+                carry_in["distance"] = self._dist_compute_fn()(
+                    carry["stats"], self._obs_flat,
+                    self.distance_function.get_params(t))[:n]
+        if mode["stoch"]:
+            R = self._block_record_rows(B)
+            if ("rec_m" in carry
+                    and carry["rec_m"].shape[0] == R):
+                for key in ("rec_m", "rec_theta", "rec_dist",
+                            "rec_loggen"):
+                    carry_in[key] = carry[key]
+            else:
+                # NaN-seeded ring: the first in-scan solve degrades to a
+                # +inf proposal and the clamp keeps the host's T_t (the
+                # same degradation Temperature._update applies to empty
+                # records); real records take over from generation two
+                carry_in["rec_m"] = jnp.zeros((R,), jnp.int32)
+                carry_in["rec_theta"] = jnp.full(
+                    (R, self.dim), jnp.nan, jnp.float32)
+                carry_in["rec_dist"] = jnp.full((R,), jnp.nan,
+                                                jnp.float32)
+                carry_in["rec_loggen"] = jnp.zeros((R,), jnp.float32)
+        return carry_in
 
     def _block_max_rounds(self, n: int, B: int) -> int:
         """Per-generation round cap of a device block, derived from the
@@ -573,14 +782,49 @@ class ABCSMC:
         wire_m_bits = self.M <= 2
         eps_mode, alpha, mult, weighted = self._eps_device_config()
         max_rounds = self._block_max_rounds(n, B)
+        mode = self._block_mode()
+        sup_cap = self.fused_support_cap
+        record_rows = self._block_record_rows(B) if mode["stoch"] else 0
+        pdf_norm = 0.0
+        if mode["stoch"]:
+            # constant for the whole run under pdf_norm_from_kernel (the
+            # device_accept_ok precondition) — safe to bake; still keyed
+            # so a changed norm can never serve a stale program
+            norms = self.acceptor.pdf_norms
+            pdf_norm = float(norms.get(t, norms[max(norms)]
+                                       if norms else 0.0))
         # samp._uid: the compiled fn closes over the sampler's round
         # builder (for ShardedSampler that bakes in mesh + axis), so a
         # swapped sampler must never be served a stale program
-        cache_key = ("fused", self._kernel._uid, samp._uid, B,
+        cache_key = ("fused2", self._kernel._uid, samp._uid, B,
                      n, K, d, s_width, eps_mode, alpha, mult, weighted,
-                     wire_stats, wire_m_bits, max_rounds)
+                     wire_stats, wire_m_bits, max_rounds, sup_cap,
+                     mode["adaptive"], mode["stoch"], record_rows,
+                     pdf_norm)
 
         def build():
+            from .distance.kernel import SCALE_LIN
+            adaptive_cfg = None
+            if mode["adaptive"]:
+                dist = self.distance_function
+                adaptive_cfg = {
+                    "scale_fn": dist.scale_function,
+                    "distance_fn": dist.compute,
+                    "obs_flat": self._obs_flat,
+                    "max_weight_ratio": dist.max_weight_ratio,
+                    "normalize_weights": dist.normalize_weights,
+                    "factors": dist.factors,
+                }
+            stoch_cfg = None
+            if mode["stoch"]:
+                stoch_cfg = {
+                    "pdf_norm": pdf_norm,
+                    "target_rate": float(
+                        self.eps.schemes[0].target_rate),
+                    "lin_scale": (self.acceptor.kernel_scale
+                                  == SCALE_LIN),
+                    "record_rows": record_rows,
+                }
             return jit_compile(build_fused_generations(
                 kernel=self._kernel,
                 # the sampler's round builder: a ShardedSampler hands
@@ -597,9 +841,20 @@ class ABCSMC:
                 s=s_width,
                 eps_mode=eps_mode, eps_alpha=alpha, eps_multiplier=mult,
                 eps_weighted=weighted,
-                distance_params=jax.device_put(
-                    self.distance_function.get_params(t)),
-                wire_stats=wire_stats, wire_m_bits=wire_m_bits))
+                # an adaptive distance's params come from the in-scan
+                # refit (carry lane dist_w) — baking get_params(t) here
+                # would poison the t-independent cache
+                distance_params=(None if mode["adaptive"]
+                                 else jax.device_put(
+                                     self.distance_function
+                                     .get_params(t))),
+                wire_stats=wire_stats, wire_m_bits=wire_m_bits,
+                support_cap=sup_cap,
+                # a quantile schedule tightens eps each generation, so
+                # the carried EWMA rate over-predicts by ~alpha
+                rate_pred_factor=(alpha if eps_mode == "quantile"
+                                  else 1.0),
+                adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg))
 
         # block programs live in the sampler's CompiledLadder (one
         # bounded LRU for every per-generation executable; stale-owner
@@ -626,9 +881,9 @@ class ABCSMC:
 
         import jax.numpy as jnp
 
-        from .sampler.base import fetch_to_host
+        from .wire import StreamingIngest
         from .wire import transfer as _transfer
-        from .wire.ingest import batch_to_population, split_block_wire
+        from .wire.ingest import GenStream, batch_to_population
 
         carry = self._fused_carry
         self._fused_carry = None
@@ -640,24 +895,26 @@ class ABCSMC:
         if carry["theta"].shape[0] != n:
             return 0, 0, None  # population size changed: sequential
         B = samp.choose_batch(n)
+        mode = self._block_mode()
         eps_mode = self._eps_device_config()[0]
+        carry_in = self._seed_block_carry(
+            t, carry, B, samp._rate_est,
+            samp._tuner.safety(samp.safety_factor))
+        if carry_in is None:
+            return 0, 0, None  # seed can't reproduce the chain state
         fn = self._get_block_fn(t, n, B, K)
 
         t0_block = _time.perf_counter()
         tr0_block = _transfer.snapshot()
         cc0_block = _compile_counters()
-        carry_in = {
-            "m": carry["m"], "theta": carry["theta"],
-            "log_weight": carry["log_weight"],
-            "distance": carry["distance"], "count": carry["count"],
-            "eps": jnp.float32(self.eps(t) if eps_mode == "constant"
-                               else 0.0),
-        }
+        args = (carry_in, self._split())
+        if mode["stoch"]:
+            args += (self._final_mask(t, K),)
         try:
             with profile_generation(t), \
                     _spans.span("fused.dispatch", gen=t, k=K):
                 carry_out, wires = self._retry.call(
-                    fn, _faults.SITE_DISPATCH, carry_in, self._split())
+                    fn, _faults.SITE_DISPATCH, *args)
         except _retry.RetryExhausted as err:
             # the carry is NOT donated, so the inputs survived every
             # failed attempt — degrade to the per-generation sequential
@@ -668,78 +925,107 @@ class ABCSMC:
             self._fault_fused_off = True
             return 0, 0, None
         dispatch_s = _time.perf_counter() - t0_block
-        # ONE transaction for all K gens, split + widened through the
-        # SHARED wire decoder (wire/ingest.py)
-        with _spans.span("fused.ingest", gen=t, k=K):
-            per_gen, counts, rounds, eps_vals = split_block_wire(
-                fetch_to_host(wires), K, n)
-
-        # every executed generation's evaluations count against the
-        # simulation budget — including any the ingest below discards
-        # (undershoot tails ran on the device regardless); mirror them
-        # onto the sampler's counter so fused runs don't undercount vs
-        # the History totals
-        sims_added = int(rounds.sum()) * B
-        samp.nr_evaluations_ += sims_added
+        # streamed per-generation fetch (wire/GenStream): generation
+        # k+1's d2h drains on the ingest worker while k is decoded and
+        # appended here — a fused block overlaps its fetch with its own
+        # ingest instead of the old single K-generation transaction
+        engine = StreamingIngest(depth=self.ingest_depth)
+        stream = GenStream(engine, wires, K, n, label=f"fused@t={t}")
         written = 0
         stop_reason = None
         append_s_total = 0.0
+        rounds_seen = 0
         gen_meta = []  # (eps, accepted, evals, rounds) per written gen
-        for k in range(K):
-            t_k = t + k
-            if t_k >= t_max:
-                break
-            count_k = int(counts[k])
-            if count_k < n:
+        pop_k = None
+        try:
+            for k in range(K):
+                t_k = t + k
+                if t_k >= t_max:
+                    break
+                with _spans.span("fused.ingest", gen=t_k):
+                    batch_k, count_k, rounds_k, eps_raw = stream.result()
+                rounds_seen += rounds_k
+                if count_k < n:
+                    logger.info(
+                        "fused block undershot at t=%d (%d/%d accepted): "
+                        "falling back to the sequential path",
+                        t_k, count_k, n)
+                    break
+                evals_k = rounds_k * B
+                pop_k = batch_to_population(batch_k)
+                if pop_k is None:
+                    logger.warning(
+                        "fused block produced degenerate weights "
+                        "at t=%d: sequential fallback", t_k)
+                    break
+                # constant mode: take the HOST value — the f32 device
+                # round-trip of eps would defeat `eps <= minimum_epsilon`
+                eps_k = (float(self.eps(t_k)) if eps_mode == "constant"
+                         else float(eps_raw))
+                acc_rate = count_k / max(evals_k, 1)
+                logger.info("t: %d, eps: %.8g (fused)", t_k, eps_k)
+                append_mark = _time.perf_counter()
+                with _spans.span("gen.append", gen=t_k):
+                    self.history.append_population(
+                        t_k, eps_k, pop_k, evals_k,
+                        [m.name for m in self.models],
+                        self._param_names(),
+                        stat_spec=self.spec.shapes)
+                append_s_total += _time.perf_counter() - append_mark
+                gen_meta.append((eps_k, count_k, evals_k, rounds_k))
+                # host schedule bookkeeping: the device-decided eps/T is
+                # the durable schedule entry
+                if eps_mode == "quantile":
+                    self.eps._look_up[t_k] = eps_k
+                elif eps_mode == "temperature":
+                    self.eps.temperatures[t_k] = eps_k
                 logger.info(
-                    "fused block undershot at t=%d (%d/%d accepted): "
-                    "falling back to the sequential path", t_k, count_k, n)
-                break
-            evals_k = int(rounds[k]) * B
-            pop_k = batch_to_population(per_gen[k])
-            if pop_k is None:
-                logger.warning("fused block produced degenerate weights "
-                               "at t=%d: sequential fallback", t_k)
-                break
-            # constant mode: take the HOST value — the f32 device
-            # round-trip of eps would defeat `eps <= minimum_epsilon`
-            eps_k = (float(self.eps(t_k)) if eps_mode == "constant"
-                     else float(eps_vals[k]))
-            acc_rate = count_k / max(evals_k, 1)
-            logger.info("t: %d, eps: %.8g (fused)", t_k, eps_k)
-            append_mark = _time.perf_counter()
-            with _spans.span("gen.append", gen=t_k):
-                self.history.append_population(
-                    t_k, eps_k, pop_k, evals_k,
-                    [m.name for m in self.models], self._param_names(),
-                    stat_spec=self.spec.shapes)
-            append_s_total += _time.perf_counter() - append_mark
-            gen_meta.append((eps_k, count_k, evals_k, int(rounds[k])))
-            if eps_mode == "quantile":
-                self.eps._look_up[t_k] = eps_k
-            logger.info(
-                "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
-                t_k, acc_rate,
-                float(effective_sample_size(pop_k.weight)), evals_k)
-            written += 1
-            # stopping criteria, sequential order (run loop below)
-            if eps_k <= self.minimum_epsilon:
-                stop_reason = "Stopping: minimum epsilon reached"
-            elif (self.stop_if_only_single_model_alive
-                    and pop_k.nr_of_models_alive() <= 1 and self.M > 1):
-                stop_reason = "Stopping: single model alive"
-            elif acc_rate < self.min_acceptance_rate:
-                stop_reason = "Stopping: acceptance rate too low"
-            elif (total_sims + int(rounds[:k + 1].sum()) * B
-                    >= max_total_nr_simulations):
-                stop_reason = "Stopping: simulation budget exhausted"
-            if stop_reason:
-                break
+                    "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
+                    t_k, acc_rate,
+                    float(effective_sample_size(pop_k.weight)), evals_k)
+                written += 1
+                # stopping criteria, sequential order (run loop below)
+                if eps_mode == "temperature":
+                    if eps_k <= 1.0:
+                        stop_reason = "Stopping: temperature reached 1"
+                elif eps_k <= self.minimum_epsilon:
+                    stop_reason = "Stopping: minimum epsilon reached"
+                if stop_reason is None:
+                    if (self.stop_if_only_single_model_alive
+                            and pop_k.nr_of_models_alive() <= 1
+                            and self.M > 1):
+                        stop_reason = "Stopping: single model alive"
+                    elif acc_rate < self.min_acceptance_rate:
+                        stop_reason = "Stopping: acceptance rate too low"
+                    elif (total_sims + rounds_seen * B
+                            >= max_total_nr_simulations):
+                        stop_reason = ("Stopping: simulation budget "
+                                       "exhausted")
+                if stop_reason:
+                    break
+        finally:
+            # every executed generation's evaluations count against the
+            # simulation budget — including any the ingest above
+            # discarded (undershoot/stop tails ran on the device
+            # regardless); mirror them onto the sampler's counter so
+            # fused runs don't undercount vs the History totals
+            rounds_seen += stream.drain_rounds()
+            engine.close()
+        sims_added = rounds_seen * B
+        samp.nr_evaluations_ += sims_added
 
         if written:
             block_dt = _time.perf_counter() - t0_block
             tr_delta = _transfer.delta(tr0_block)
             cc_delta = _compile_delta(cc0_block)
+            at_scale = n > self.PROBE_MIN_POP
+            if at_scale and self._engine_choice is None:
+                # at-scale probe: this block's measured steady-state
+                # s/gen against the sequential baseline decides the
+                # engine for the rest of the run
+                self._decide_engine(
+                    (block_dt - cc_delta["compile_s"]) / written)
+            engine_lbl = self._engine_choice if at_scale else None
             for k in range(written):
                 self.generation_wall_clock[t + k] = block_dt / written
                 self.generation_transfer[t + k] = {
@@ -759,7 +1045,8 @@ class ABCSMC:
                     # the block compiles (at most) once — charge the
                     # block's first generation, not a smeared fraction
                     compile_s=(cc_delta["compile_s"] if k == 0 else 0.0),
-                    n_compiles=(cc_delta["n_compiles"] if k == 0 else 0))
+                    n_compiles=(cc_delta["n_compiles"] if k == 0 else 0),
+                    engine=engine_lbl)
                 _metrics.record_generation(
                     evals_k, count_k, count_k / max(evals_k, 1),
                     rounds=rounds_k, wall_s=block_dt / written)
@@ -780,6 +1067,13 @@ class ABCSMC:
                     # ON device (f32, no re-upload) exactly like the
                     # sequential loop's Sample.device_population
                     prep.device_population = dict(carry_out)
+                    if mode["adaptive"]:
+                        # pre-seed the host schedule with the in-scan
+                        # refit's weights for t+K — update() then
+                        # short-circuits to "changed" and the eps update
+                        # sees distances under them (sequential parity)
+                        self.distance_function.weights[t + written] = \
+                            np.asarray(carry_out["dist_w"], np.float32)
                 else:
                     prep.device_population = None
                 self._prepare_next_iteration(
@@ -825,12 +1119,12 @@ class ABCSMC:
         from .sampler.base import fetch_to_host
         from .wire import transfer as _transfer
         from .wire import StreamingIngest
-        from .wire.ingest import (batch_to_population, split_block_wire,
+        from .wire.ingest import (GenStream, batch_to_population,
                                   split_single_wire)
 
         samp = self.sampler
+        mode = self._block_mode()
         eps_mode = self._eps_device_config()[0]
-        fused_K = self.fuse_generations if self._fused_eligible() else 1
         ingest = StreamingIngest(depth=self.ingest_depth)
         inflight = deque()
         st = {
@@ -865,7 +1159,9 @@ class ABCSMC:
             abandoned = 0
             while inflight:
                 blk = inflight.pop()
-                if blk["ticket"] is not None:
+                if blk.get("stream") is not None:
+                    blk["stream"].abandon()
+                elif blk["ticket"] is not None:
                     blk["ticket"].abandon()
                 abandoned += blk["K"]
             if abandoned:
@@ -881,20 +1177,27 @@ class ABCSMC:
             if carry["theta"].shape[0] != n:
                 st["carry"] = None  # population size changed: sequential
                 return False
+            # live eligibility: the at-scale engine probe may retire
+            # fusion mid-run (K drops to 1, the pipeline keeps streaming)
+            fused_K = (self.fuse_generations if self._fused_eligible()
+                       else 1)
             K = (fused_K if (fused_K > 1 and t_d + fused_K <= t_max)
                  else 1)
             if t_d + K > t_max:
                 return False
             B = samp._round_to_valid_batch(
                 n / max(st["rate_disp"], 1e-6) * st["safety_disp"])
+            carry_in = self._seed_block_carry(
+                t_d, carry, B, st["rate_disp"], st["safety_disp"])
+            if carry_in is None:
+                # host component state can't seed this mode's chain yet
+                # (e.g. nothing prepared for t_d): sequential rebuild
+                st["carry"] = None
+                return False
             fn = self._get_block_fn(t_d, n, B, K)
-            carry_in = {
-                "m": carry["m"], "theta": carry["theta"],
-                "log_weight": carry["log_weight"],
-                "distance": carry["distance"], "count": carry["count"],
-                "eps": jnp.float32(self.eps(t_d)
-                                   if eps_mode == "constant" else 0.0),
-            }
+            args = (carry_in, self._split())
+            if mode["stoch"]:
+                args += (self._final_mask(t_d, K),)
             disp_mark = _time.perf_counter()
             with profile_generation(t_d), \
                     _spans.span("pipeline.dispatch", gen=t_d, k=K):
@@ -902,11 +1205,14 @@ class ABCSMC:
                 # back to the sequential path and resumes from the
                 # History (everything durable is per-generation there)
                 carry_out, wires = self._retry.call(
-                    fn, _faults.SITE_DISPATCH, carry_in, self._split())
-                ticket = ingest.submit(
-                    lambda: split_block_wire(fetch_to_host(wires), K, n),
-                    label=f"block@t={t_d}")
-            inflight.append({"kind": "block", "ticket": ticket,
+                    fn, _faults.SITE_DISPATCH, *args)
+                # one-ticket-ahead stream per block: composes with the
+                # engine's depth backpressure (never holds more than one
+                # slot), and gen k+1's fetch drains while k is appended
+                stream = GenStream(ingest, wires, K, n,
+                                   label=f"block@t={t_d}")
+            inflight.append({"kind": "block", "ticket": None,
+                             "stream": stream,
                              "t0": t_d, "K": K, "B": B, "n": n,
                              "carry_out": carry_out,
                              "dispatch_s": (_time.perf_counter()
@@ -991,92 +1297,122 @@ class ABCSMC:
         def harvest_one():
             blk = inflight.popleft()
             base_sims = st["total_sims"]
-            with _spans.span("pipeline.harvest", gen=blk["t0"],
-                             k=blk["K"]):
-                if blk["kind"] == "pop":
-                    gens, counts, rounds = None, [blk["n"]], None
-                else:
-                    gens, counts, rounds, eps_vals = \
-                        blk["ticket"].result()
-            if blk["kind"] == "block":
-                # block sims count at harvest (abandoned speculative
-                # blocks never count); mirrored onto the sampler's
-                # counter like the fused path
-                sims = int(rounds.sum()) * blk["B"]
-                st["total_sims"] += sims
-                samp.nr_evaluations_ += sims
+            stream = blk.get("stream")
+            gens = counts = eps_vals = None
+            if blk["kind"] == "seq":
+                with _spans.span("pipeline.harvest", gen=blk["t0"], k=1):
+                    gens, counts, _, eps_vals = blk["ticket"].result()
             n, K = blk["n"], blk["K"]
             written = 0
             fallback = False
+            rounds_seen = 0
             append_s_total = 0.0
             gen_meta = []  # (eps, accepted, evals, rounds) per written
-            for k in range(K):
-                t_k = blk["t0"] + k
-                count_k = int(counts[k])
-                if count_k < n:
+            try:
+                for k in range(K):
+                    t_k = blk["t0"] + k
+                    rounds_k = None
+                    if blk["kind"] == "block":
+                        # streamed per-generation fetch: gen k+1's d2h
+                        # drains on the worker while k is appended here
+                        with _spans.span("pipeline.harvest", gen=t_k,
+                                         k=K):
+                            batch_k, count_k, rounds_k, eps_raw = \
+                                stream.result()
+                        rounds_seen += rounds_k
+                    elif blk["kind"] == "seq":
+                        count_k = int(counts[k])
+                    else:
+                        count_k = n
+                    if count_k < n:
+                        logger.info(
+                            "pipelined block undershot at t=%d (%d/%d "
+                            "accepted): sequential fallback", t_k,
+                            count_k, n)
+                        fallback = True
+                        break
+                    if blk["kind"] == "pop":
+                        pop_k = blk["pop"]
+                    elif blk["kind"] == "seq":
+                        pop_k = batch_to_population(gens[k])
+                    else:
+                        pop_k = batch_to_population(batch_k)
+                    if pop_k is None:
+                        logger.warning(
+                            "pipelined block produced degenerate weights "
+                            "at t=%d: sequential fallback", t_k)
+                        fallback = True
+                        break
+                    if blk["kind"] == "block":
+                        evals_k = rounds_k * blk["B"]
+                        eps_k = (float(self.eps(t_k))
+                                 if eps_mode == "constant"
+                                 else float(eps_raw))
+                        acc_rate = count_k / max(evals_k, 1)
+                        logger.info("t: %d, eps: %.8g (pipelined)", t_k,
+                                    eps_k)
+                        if eps_mode == "quantile":
+                            self.eps._look_up[t_k] = eps_k
+                        elif eps_mode == "temperature":
+                            self.eps.temperatures[t_k] = eps_k
+                    else:
+                        evals_k = blk["evals"]
+                        eps_k = blk["eps"]
+                        acc_rate = blk["acc_rate"]
+                    append_mark = _time.perf_counter()
+                    with _spans.span("gen.append", gen=t_k):
+                        self.history.append_population(
+                            t_k, eps_k, pop_k, evals_k,
+                            [m.name for m in self.models],
+                            self._param_names(),
+                            stat_spec=self.spec.shapes)
+                    append_s_total += _time.perf_counter() - append_mark
+                    gen_meta.append((eps_k, count_k, evals_k, rounds_k))
                     logger.info(
-                        "pipelined block undershot at t=%d (%d/%d "
-                        "accepted): sequential fallback", t_k, count_k, n)
-                    fallback = True
-                    break
-                if blk["kind"] == "pop":
-                    pop_k = blk["pop"]
-                else:
-                    pop_k = batch_to_population(gens[k])
-                if pop_k is None:
-                    logger.warning(
-                        "pipelined block produced degenerate weights at "
-                        "t=%d: sequential fallback", t_k)
-                    fallback = True
-                    break
-                if blk["kind"] == "block":
-                    evals_k = int(rounds[k]) * blk["B"]
-                    eps_k = (float(self.eps(t_k))
-                             if eps_mode == "constant"
-                             else float(eps_vals[k]))
-                    acc_rate = count_k / max(evals_k, 1)
-                    logger.info("t: %d, eps: %.8g (pipelined)", t_k,
-                                eps_k)
-                    if eps_mode == "quantile":
-                        self.eps._look_up[t_k] = eps_k
-                else:
-                    evals_k = blk["evals"]
-                    eps_k = blk["eps"]
-                    acc_rate = blk["acc_rate"]
-                append_mark = _time.perf_counter()
-                with _spans.span("gen.append", gen=t_k):
-                    self.history.append_population(
-                        t_k, eps_k, pop_k, evals_k,
-                        [m.name for m in self.models],
-                        self._param_names(),
-                        stat_spec=self.spec.shapes)
-                append_s_total += _time.perf_counter() - append_mark
-                gen_meta.append(
-                    (eps_k, count_k, evals_k,
-                     int(rounds[k]) if blk["kind"] == "block" else None))
-                logger.info(
-                    "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
-                    t_k, acc_rate,
-                    float(effective_sample_size(pop_k.weight)), evals_k)
-                written += 1
-                st["t"] = t_k + 1
-                st["last_pop"] = pop_k
-                # stopping criteria, sequential order (classic loop)
-                sims_so_far = (
-                    base_sims + int(rounds[:k + 1].sum()) * blk["B"]
-                    if blk["kind"] == "block" else st["total_sims"])
-                if eps_k <= self.minimum_epsilon:
-                    st["stop"] = "Stopping: minimum epsilon reached"
-                elif (self.stop_if_only_single_model_alive
-                        and pop_k.nr_of_models_alive() <= 1
-                        and self.M > 1):
-                    st["stop"] = "Stopping: single model alive"
-                elif acc_rate < self.min_acceptance_rate:
-                    st["stop"] = "Stopping: acceptance rate too low"
-                elif sims_so_far >= max_total_nr_simulations:
-                    st["stop"] = "Stopping: simulation budget exhausted"
-                if st["stop"]:
-                    break
+                        "t: %d, acceptance rate: %.4g, ESS: %.4g, "
+                        "evals: %d",
+                        t_k, acc_rate,
+                        float(effective_sample_size(pop_k.weight)),
+                        evals_k)
+                    written += 1
+                    st["t"] = t_k + 1
+                    st["last_pop"] = pop_k
+                    # stopping criteria, sequential order (classic loop)
+                    sims_so_far = (
+                        base_sims + rounds_seen * blk["B"]
+                        if blk["kind"] == "block" else st["total_sims"])
+                    if eps_mode == "temperature":
+                        if eps_k <= 1.0:
+                            st["stop"] = ("Stopping: temperature "
+                                          "reached 1")
+                    elif eps_k <= self.minimum_epsilon:
+                        st["stop"] = "Stopping: minimum epsilon reached"
+                    if not st["stop"]:
+                        if (self.stop_if_only_single_model_alive
+                                and pop_k.nr_of_models_alive() <= 1
+                                and self.M > 1):
+                            st["stop"] = "Stopping: single model alive"
+                        elif acc_rate < self.min_acceptance_rate:
+                            st["stop"] = ("Stopping: acceptance rate "
+                                          "too low")
+                        elif sims_so_far >= max_total_nr_simulations:
+                            st["stop"] = ("Stopping: simulation budget "
+                                          "exhausted")
+                    if st["stop"]:
+                        break
+            finally:
+                if stream is not None:
+                    # a stopped/undershot block's tail generations still
+                    # simulated — drain their round counts so the budget
+                    # accounting matches the device work (abandoned
+                    # SPECULATIVE blocks behind this one never count:
+                    # rewind_to_frontier drops them unread).  Harvested
+                    # block sims count here, mirrored onto the sampler's
+                    # counter like the fused path.
+                    rounds_seen += stream.drain_rounds()
+                    sims = rounds_seen * blk["B"]
+                    st["total_sims"] += sims
+                    samp.nr_evaluations_ += sims
             if written:
                 now = _time.perf_counter()
                 block_dt = now - st["gen_mark"]
@@ -1085,6 +1421,18 @@ class ABCSMC:
                 st["tr_mark"] = _transfer.snapshot()
                 cc_delta = _compile_delta(st["cc_mark"])
                 st["cc_mark"] = _compile_counters()
+                at_scale = n > self.PROBE_MIN_POP
+                if blk["kind"] != "block":
+                    # feed the engine probe's sequential baseline (t=0's
+                    # prior round would bias it low — skip it)
+                    if blk["t0"] > 0:
+                        self._note_sequential_gen_s(
+                            block_dt, cc_delta["compile_s"])
+                elif (at_scale and blk["K"] > 1
+                        and self._engine_choice is None):
+                    self._decide_engine(
+                        (block_dt - cc_delta["compile_s"]) / written)
+                engine_lbl = self._engine_choice if at_scale else None
                 for k in range(written):
                     self.generation_wall_clock[blk["t0"] + k] = \
                         block_dt / written
@@ -1110,7 +1458,8 @@ class ABCSMC:
                         compile_s=(cc_delta["compile_s"]
                                    if k == 0 else 0.0),
                         n_compiles=(cc_delta["n_compiles"]
-                                    if k == 0 else 0))
+                                    if k == 0 else 0),
+                        engine=engine_lbl)
                     _metrics.record_generation(
                         evals_k, count_k, count_k / max(evals_k, 1),
                         rounds=rounds_k, wall_s=block_dt / written)
@@ -1124,6 +1473,15 @@ class ABCSMC:
                 if blk["kind"] == "block":
                     st["last_dp"] = (dict(blk["carry_out"])
                                      if written == K else None)
+                    if written == K and mode["adaptive"]:
+                        # pre-seed the host-side weight schedule with the
+                        # in-scan refit for t0+K so update(t0+K) short-
+                        # circuits (no d2h of the stats) and a later
+                        # sequential generation runs with the fused
+                        # chain's weights
+                        self.distance_function.weights[blk["t0"] + K] = \
+                            np.asarray(blk["carry_out"]["dist_w"],
+                                       np.float32)
                 else:
                     st["last_dp"] = blk.get("dp")
             if fallback or st["stop"]:
@@ -1412,7 +1770,6 @@ class ABCSMC:
             self.history.done()
             return self.history
 
-        fused_ok = self._fused_eligible()
         ckpt_every = self.checkpoint_every_rounds
         if ckpt_every:
             # SIGTERM -> flag; the sampler flushes its ledger at the
@@ -1434,7 +1791,7 @@ class ABCSMC:
             # enter a fused block only when ALL K generations fit before
             # t_max — the compiled program always executes K, so a tail
             # block would burn device work on discarded generations
-            if fused_ok and not self._fault_fused_off \
+            if self._fused_eligible() \
                     and self._fused_carry is not None \
                     and t + self.fuse_generations <= t_max:
                 written, sims, stop_reason = self._run_fused_block(
@@ -1541,7 +1898,14 @@ class ABCSMC:
                 eps=current_eps, accepted=sample.raw_accepted,
                 total=sample.nr_evaluations,
                 overlap_s=tr_t["overlap_s"],
-                compile_s=cc_t["compile_s"], n_compiles=cc_t["n_compiles"])
+                compile_s=cc_t["compile_s"], n_compiles=cc_t["n_compiles"],
+                engine=(self._engine_choice
+                        if n > self.PROBE_MIN_POP else None))
+            # feed the engine probe's sequential baseline (t=0's all-
+            # accepted prior round would bias it low — skip it)
+            if t > 0:
+                self._note_sequential_gen_s(
+                    self.generation_wall_clock[t], cc_t["compile_s"])
             _metrics.record_generation(
                 sample.nr_evaluations, sample.raw_accepted,
                 acceptance_rate, wall_s=self.generation_wall_clock[t])
@@ -1551,7 +1915,7 @@ class ABCSMC:
             tuner = getattr(self.sampler, "_tuner", None)
             if tuner is not None:
                 tuner.observe_timing(tr_t["compute_s"], tr_t["overlap_s"])
-            if fused_ok:
+            if self._fused_eligible():
                 # accepted buffers of THIS generation stay device-resident
                 # as the next fused block's carry
                 dp = getattr(sample, "device_population", None)
@@ -1665,7 +2029,15 @@ class ABCSMC:
 
         def get_all_stats_dict():
             flat = sample.get_all_stats()
-            return self.spec.unflatten(jnp.asarray(flat))
+            arr = jnp.asarray(flat)
+            if (arr.ndim != 2 or arr.shape[0] == 0
+                    or arr.shape[-1] != self.spec.total_size):
+                # a carry-seeded continuation Sample may have no
+                # addressable stats (e.g. stats wire disabled): hand the
+                # adaptive distance an empty batch so update() declines
+                # instead of crashing on a ragged unflatten
+                arr = jnp.zeros((0, self.spec.total_size), jnp.float32)
+            return self.spec.unflatten(arr)
 
         changed = self.distance_function.update(t, get_all_stats_dict)
         if changed:
@@ -1679,22 +2051,21 @@ class ABCSMC:
             dev = getattr(sample, "device_population", None)
             if dev is not None and "stats" in dev:
                 n_rows = len(population)
-                if self._jit_dist_compute is None:
-                    # one compiled program instead of an eager op-chain
-                    # (each eager op pays the relay submission constant)
-                    self._jit_dist_compute = jit_compile(
-                        lambda s, o, p: self.distance_function.compute(
-                            s, o, p))
-                d_new = np.asarray(self._jit_dist_compute(
+                d_new = np.asarray(self._dist_compute_fn()(
                     dev["stats"], self._obs_flat, new_params))[:n_rows]
                 population = Population(
                     population.m, population.theta, population.weight,
                     d_new.astype(np.float32), population.sum_stats,
                     population.accepted)
-            else:
+            elif "__flat__" in population.sum_stats:
                 population = population.update_distances(
                     lambda ss: self.distance_function.compute(
                         ss["__flat__"], self._obs_flat, new_params))
+            else:
+                logger.debug(
+                    "distance changed at t=%d but no stats available to "
+                    "re-evaluate the population; keeping stored "
+                    "distances", t)
 
         def get_weighted_distances():
             return (np.asarray(population.distance),

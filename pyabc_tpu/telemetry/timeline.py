@@ -36,14 +36,17 @@ class GenerationTimeline:
                stages: Optional[dict] = None, eps: Optional[float] = None,
                accepted: Optional[int] = None, total: Optional[int] = None,
                overlap_s: float = 0.0, compile_s: float = 0.0,
-               n_compiles: int = 0):
+               n_compiles: int = 0, engine: Optional[str] = None):
         """Add one generation's row.  ``stages`` maps a subset of
         :data:`STAGES` to seconds; unknown keys raise so a typo can't
         silently vanish from the table.  ``compile_s``/``n_compiles``
         (the generation's XLA compile-counter delta, autotune/ladder.py)
         are attribution columns like ``overlap_s``, NOT stages: compile
         time overlaps ``dispatch``, so folding it into the stage sum
-        would break stage-sum == wall."""
+        would break stage-sum == wall.  ``engine`` records the
+        probe-based fused-vs-sequential selection in force when the
+        generation ran (``ABCSMC._decide_engine``); None below the probe
+        population or before the probe decides."""
         stages = dict(stages or {})
         unknown = set(stages) - set(STAGES)
         if unknown:
@@ -63,6 +66,7 @@ class GenerationTimeline:
         row["eps"] = None if eps is None else float(eps)
         row["accepted"] = None if accepted is None else int(accepted)
         row["total"] = None if total is None else int(total)
+        row["engine"] = engine
         with self._lock:
             if len(self._rows) < self._max_rows:
                 self._rows.append(row)
@@ -92,6 +96,12 @@ class GenerationTimeline:
                                               + vals[n // 2]) / 2
             return round(mid, 6)
 
+        # last recorded engine decision (rows carry None until the probe
+        # decides; older rows may predate the engine column entirely)
+        engine = None
+        for r in rows:
+            if r.get("engine") is not None:
+                engine = r["engine"]
         return {
             "generations": len(rows),
             "wall_s_med": med("wall_s"),
@@ -101,6 +111,7 @@ class GenerationTimeline:
             "overlap_frac_med": med("overlap_frac"),
             "compile_s_med": med("compile_s"),
             "n_compiles_total": int(sum(r["n_compiles"] for r in rows)),
+            "engine_decision": engine,
         }
 
     def render_ascii(self) -> str:

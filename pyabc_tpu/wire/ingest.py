@@ -42,6 +42,97 @@ def split_block_wire(wires: dict, K: int, n: int):
     return gens, counts, rounds, eps_vals
 
 
+def split_gen_wire(out: dict, n: int):
+    """Decode ONE generation's slice of a fused block wire
+    (``sampler.device_loop.slice_block_wire``) into
+    ``(batch, count, rounds, eps)`` — the per-``k`` unit of
+    :func:`split_block_wire`, for streamed per-generation block fetch.
+    ``eps`` is None when the wire carries no eps lane."""
+    from ..sampler.base import widen_wire
+
+    batch = widen_wire({key: v for key, v in out.items()
+                        if key not in _SCALAR_KEYS}, n)
+    count = int(np.asarray(out["count"]))
+    rounds = int(np.asarray(out["rounds"]))
+    eps = (float(np.asarray(out["eps"], dtype=np.float64))
+           if "eps" in out else None)
+    return batch, count, rounds, eps
+
+
+class GenStream:
+    """Streamed per-generation fetch of one K-generation block wire.
+
+    At most ONE sub-ticket is in flight per block: :meth:`result`
+    resolves generation ``k`` and immediately submits generation
+    ``k+1``'s fetch+decode, so the next generation's d2h drains on the
+    ingest worker while the caller decodes/appends the current one (and,
+    in the pipelined path, while later blocks compute on device).  The
+    one-ahead discipline is what makes the streams composable with
+    ``StreamingIngest``'s depth backpressure: a block never holds more
+    than one of the engine's depth slots, so ``depth`` blocks can stream
+    concurrently without the submit() semaphore deadlocking against its
+    own unharvested tickets.
+    """
+
+    def __init__(self, engine, wires: dict, K: int, n: int, label: str):
+        self._engine = engine
+        self._wires = wires
+        self._K = K
+        self._n = n
+        self._label = label
+        self._next = 0
+        self._ticket = None
+        self._submit()
+
+    def _submit(self):
+        if self._next >= self._K:
+            self._ticket = None
+            self._wires = None  # release the device block reference
+            return
+        from ..sampler.device_loop import slice_block_wire
+        k = self._next
+        gw = slice_block_wire(self._wires, k)
+        self._ticket = self._engine.submit(
+            lambda: _fetch_gen(gw, self._n),
+            label=f"{self._label}+{k}")
+        self._next += 1
+
+    def result(self):
+        """Resolve the next generation's ``(batch, count, rounds, eps)``
+        and queue the following one."""
+        out = self._ticket.result()
+        self._submit()
+        return out
+
+    def drain_rounds(self) -> int:
+        """Resolve every remaining generation for its ``rounds`` scalar
+        only — exact simulation accounting after an early stop inside
+        the block (the stopped-past generations still simulated)."""
+        total = 0
+        while self._ticket is not None:
+            try:
+                _, _, rounds, _ = self._ticket.result()
+                total += int(rounds)
+            except Exception:
+                pass  # a failed tail fetch only loses accounting
+            self._submit()
+        return total
+
+    def abandon(self):
+        """Drop the stream (pipelined rewind): the in-flight ticket is
+        abandoned, unsubmitted generations never fetch."""
+        if self._ticket is not None:
+            self._ticket.abandon()
+            self._ticket = None
+        self._wires = None
+
+
+def _fetch_gen(gen_wire: dict, n: int):
+    from ..sampler.base import fetch_to_host
+
+    return split_gen_wire(fetch_to_host(gen_wire), n)
+
+
 def split_single_wire(out: dict, n: int):
     """Decode a single-generation deferred wire (the per-generation
     sampler's finalize payload) into the same shape as

@@ -84,16 +84,27 @@ def test_fused_eligibility_gating():
     # fuse_generations=1: off
     abc1, _ = _abc(fuse=1, eps=pt.ConstantEpsilon(0.2))
     assert abc1._fused_eligible() is False
-    # adaptive distance: host consumer -> sequential
+    # adaptive distance with a blessed scale function: the refit runs
+    # IN-SCAN now -> eligible
     models, priors, _, observed, _ = make_two_gaussians_problem()
     abc2 = pt.ABCSMC(models, priors, pt.AdaptivePNormDistance(),
                      population_size=200,
                      sampler=pt.VectorizedSampler(),
                      fuse_generations=3, seed=0)
     abc2.new("sqlite://", observed)
-    assert abc2._fused_eligible() is False
-    abc2.run(max_nr_populations=3)  # still runs, sequentially
-    assert abc2.history.max_t == 2
+    assert abc2._fused_eligible() is True
+    # ... but a CUSTOM scale function has no device twin -> sequential
+    abc2b = pt.ABCSMC(models, priors,
+                      pt.AdaptivePNormDistance(
+                          scale_function=lambda data, x_0=None:
+                          np.nanstd(np.asarray(data), axis=0)),
+                      population_size=200,
+                      sampler=pt.VectorizedSampler(),
+                      fuse_generations=3, seed=0)
+    abc2b.new("sqlite://", observed)
+    assert abc2b._fused_eligible() is False
+    abc2b.run(max_nr_populations=3)  # still runs, sequentially
+    assert abc2b.history.max_t == 2
     # sharded sampler on a single-process mesh: eligible (the
     # shard_mapped round runs inside the fused scan)
     abc3 = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
@@ -129,12 +140,19 @@ def test_fused_eligibility_gating():
     abc6.new("sqlite://", observed5)
     assert abc6._fused_eligible() is True
     # mid-size pops (>= 2^14, engages the device pdf-grid compression)
-    # stay eligible; transfer-dominated huge pops fall back — measured
-    # same-session, fused was ~25 % slower than sequential at 1e6
+    # stay eligible
     abc7, _ = _abc(fuse=3, pop=1 << 17, eps=pt.ConstantEpsilon(0.2))
     assert abc7._fused_eligible() is True
+    # huge pops: no longer a static cutoff — fused until the runtime
+    # engine probe (measured fused vs sequential s/gen) says otherwise
     abc8, _ = _abc(fuse=3, pop=1_000_000, eps=pt.ConstantEpsilon(0.2))
+    assert abc8._fused_eligible() is True
+    abc8._engine_choice = "sequential"  # as the probe would set it
     assert abc8._fused_eligible() is False
+    # the probe only governs ABOVE the probe population: a mid-size run
+    # ignores a (stale) sequential decision
+    abc7._engine_choice = "sequential"
+    assert abc7._fused_eligible() is True
 
 
 def test_device_grid_compression_guards():
@@ -364,3 +382,166 @@ def test_fused_simulation_budget_stop():
     # stopped once the budget tripped — well before 12 generations
     assert h.max_t < 11
     assert sims.sum() >= 4000
+
+
+def test_systematic_weighted_choice_unit():
+    """ops.choice.systematic_weighted_choice (the capped-support
+    resampler): index bounds, O(1/n) weighted-moment preservation, and
+    point-mass degeneracy."""
+    import jax
+    import jax.numpy as jnp
+
+    from pyabc_tpu.ops.choice import systematic_weighted_choice
+
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=4096).astype(np.float32)
+    w = rng.gamma(1.0, size=4096)
+    w /= w.sum()
+    log_w = jnp.asarray(np.log(w).astype(np.float32))
+    idx = np.asarray(systematic_weighted_choice(
+        jax.random.PRNGKey(0), log_w, 1024))
+    assert idx.shape == (1024,)
+    assert idx.min() >= 0 and idx.max() < 4096
+    # stratified inverse-CDF: the resampled mean tracks the weighted
+    # mean within resampling noise (i.i.d. sigma/sqrt(n) ~ 0.03; allow
+    # 4 sigma)
+    mu_w = float(np.sum(w * vals))
+    mu_r = float(vals[idx].mean())
+    assert abs(mu_r - mu_w) < 0.12
+    # a point mass gets every draw
+    lw_point = jnp.where(jnp.arange(4096) == 7, 0.0, -jnp.inf)
+    idx_p = np.asarray(systematic_weighted_choice(
+        jax.random.PRNGKey(1), lw_point, 64))
+    assert np.all(idx_p == 7)
+
+
+def test_capped_support_below_cap_bit_identical():
+    """The capped-support branch is trace-time gated on
+    ``n_target > cap``: below the cap the compiled program is the exact
+    refit — SAME program, SAME RNG stream, bit-identical History."""
+    abc_a, _ = _abc(fuse=3, pop=400, eps=pt.ConstantEpsilon(0.2), seed=6)
+    assert abc_a.fused_support_cap is not None  # default cap, > pop
+    h_a = abc_a.run(max_nr_populations=5)
+    abc_b, _ = _abc(fuse=3, pop=400, eps=pt.ConstantEpsilon(0.2), seed=6)
+    abc_b.fused_support_cap = None  # exact refit, no cap anywhere
+    h_b = abc_b.run(max_nr_populations=5)
+    for t in range(5):
+        df_a, w_a = h_a.get_distribution(m=1, t=t)
+        df_b, w_b = h_b.get_distribution(m=1, t=t)
+        np.testing.assert_array_equal(df_a["mu"].to_numpy(),
+                                      df_b["mu"].to_numpy())
+        np.testing.assert_array_equal(w_a, w_b)
+
+
+def test_capped_support_refit_posterior_parity():
+    """Above the cap the refit runs on a systematic-resampled fixed-size
+    support; the posterior must match the exact-support refit to MC
+    noise (cap 256 << pop 2000 exercises the resampler hard)."""
+    pop = 2000
+    abc_c, posterior_fn = _abc(fuse=3, pop=pop,
+                               eps=pt.ConstantEpsilon(0.2), seed=7)
+    abc_c.fused_support_cap = 256  # binding: pop > cap
+    h_c = abc_c.run(max_nr_populations=5)
+    abc_e, _ = _abc(fuse=3, pop=pop, eps=pt.ConstantEpsilon(0.2), seed=7)
+    abc_e.fused_support_cap = None
+    h_e = abc_e.run(max_nr_populations=5)
+    p_c = float(h_c.get_model_probabilities().iloc[-1][1])
+    p_e = float(h_e.get_model_probabilities().iloc[-1][1])
+    assert abs(p_c - posterior_fn(1.0)) < 0.08
+    assert abs(p_c - p_e) < 0.06
+    df_c, w_c = h_c.get_distribution(m=1)
+    df_e, w_e = h_e.get_distribution(m=1)
+    mu_c = float(df_c["mu"].to_numpy() @ w_c)
+    mu_e = float(df_e["mu"].to_numpy() @ w_e)
+    assert abs(mu_c - mu_e) < 0.05
+
+
+def test_adaptive_distance_fused_matches_sequential():
+    """AdaptivePNormDistance through the fused engine (in-scan scale
+    refit): no sequential fallback, the host weight schedule is fed by
+    the scan, and the posterior matches the sequential engine."""
+    models, priors, _, observed, posterior_fn = \
+        make_two_gaussians_problem()
+
+    def make(fuse):
+        abc = pt.ABCSMC(models, priors, pt.AdaptivePNormDistance(),
+                        population_size=600,
+                        eps=pt.ConstantEpsilon(0.25),
+                        sampler=pt.VectorizedSampler(),
+                        fuse_generations=fuse, seed=8)
+        abc.new("sqlite://", observed)
+        return abc
+
+    abc_f = make(4)
+    assert abc_f._fused_eligible() is True
+    h_f = abc_f.run(max_nr_populations=6)
+    rows = abc_f.timeline.to_rows()
+    # the fused engine actually ran (no silent sequential fallback)
+    assert any(r["path"] == "fused" for r in rows), \
+        [r["path"] for r in rows]
+    # the block exit fed the host weight schedule with the in-scan refit
+    # (interior generations' weights live only in the device carry)
+    k_exit = 1 + abc_f.fuse_generations
+    assert k_exit in abc_f.distance_function.weights
+    w_exit = abc_f.distance_function.weights[k_exit]
+    assert np.all(np.isfinite(w_exit)) and np.all(w_exit >= 0)
+    abc_s = make(1)
+    h_s = abc_s.run(max_nr_populations=6)
+    p_f = float(h_f.get_model_probabilities().iloc[-1][1])
+    p_s = float(h_s.get_model_probabilities().iloc[-1][1])
+    assert abs(p_f - p_s) < 0.1
+    df_f, w_f = h_f.get_distribution(m=1)
+    df_s, w_s = h_s.get_distribution(m=1)
+    mu_f = float(df_f["mu"].to_numpy() @ w_f)
+    mu_s = float(df_s["mu"].to_numpy() @ w_s)
+    assert abs(mu_f - mu_s) < 0.1
+
+
+def test_stochastic_triple_fused_matches_sequential():
+    """The exact-likelihood triple (StochasticKernel + acceptance-rate
+    Temperature + StochasticAcceptor) through the fused engine: the
+    in-scan record-ring temperature solve must anneal like the host
+    solve and leave the same posterior."""
+    import jax
+
+    def model(key, theta):
+        return {"y": theta[:, 0]
+                + 0.2 * jax.random.normal(key, theta.shape[:1])}
+
+    def make(fuse):
+        abc = pt.ABCSMC(
+            pt.SimpleModel(model),
+            pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+            pt.IndependentNormalKernel(var=0.1 ** 2),
+            population_size=400,
+            eps=pt.Temperature(schemes=[pt.AcceptanceRateScheme()]),
+            # kernel-derived pdf_norm: constant for the whole run, which
+            # is what makes the acceptor device-computable (the default
+            # max-found method tracks realized maxima on the host)
+            acceptor=pt.StochasticAcceptor(
+                pdf_norm_method=pt.pdf_norm_from_kernel),
+            sampler=pt.VectorizedSampler(),
+            fuse_generations=fuse, seed=9)
+        abc.new("sqlite://", {"y": 0.5})
+        return abc
+
+    abc_f = make(3)
+    assert abc_f._fused_eligible() is True
+    h_f = abc_f.run(max_nr_populations=6)
+    rows = abc_f.timeline.to_rows()
+    assert any(r["path"] == "fused" for r in rows), \
+        [r["path"] for r in rows]
+    pops = h_f.get_all_populations()
+    temps = pops[pops.t >= 0].epsilon.to_numpy()
+    # temperatures anneal monotonically and the final generation is
+    # pinned to exactly 1 (enforce_exact_final_temperature)
+    assert np.all(np.diff(temps) <= 1e-6), temps
+    assert temps[-1] == pytest.approx(1.0)
+    abc_s = make(1)
+    h_s = abc_s.run(max_nr_populations=6)
+    df_f, w_f = h_f.get_distribution()
+    df_s, w_s = h_s.get_distribution()
+    mu_f = float(df_f["mu"].to_numpy() @ w_f)
+    mu_s = float(df_s["mu"].to_numpy() @ w_s)
+    assert abs(mu_f - mu_s) < 0.1
+    assert abs(mu_f - 0.5) < 0.15

@@ -1,0 +1,102 @@
+"""Tier-1 wrapper for tools/check_fused_eligibility.py: the fused-chain
+eligibility decision must stay driven by the component capability flags
+(defined at their owner files, consulted by ``_device_chain_eligible``)
+and the at-scale probe threshold must stay the named ``PROBE_MIN_POP``
+attribute — and the lint must actually catch drift when planted."""
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "check_fused_eligibility.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_fused_eligibility", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_tree_is_clean():
+    mod = _load()
+    assert mod.check() == []
+
+
+def test_detects_dropped_flag_at_owner(tmp_path):
+    """An owner file that loses its capability flag is a violation."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "acceptor").mkdir(parents=True)
+    (pkg / "acceptor" / "acceptor.py").write_text(
+        "class Acceptor:\n"
+        "    pass  # flag got renamed away\n")
+    got = mod.check(root=str(pkg))
+    assert [(p, msg.split("'")[1]) for p, _, msg in got] == [
+        ("acceptor/acceptor.py", "device_accept_ok")]
+
+
+def test_detects_eligibility_drift(tmp_path):
+    """An eligibility body that reverts to isinstance checks (dropping
+    a flag) or re-hardcodes the retired population cutoff is caught."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "smc.py").write_text(
+        "class ABCSMC:\n"
+        "    def _device_chain_eligible(self):\n"
+        "        ok = getattr(self.acceptor, 'device_accept_ok', False)\n"
+        "        ok &= getattr(self.eps, 'device_schedule_ok', False)\n"
+        "        ok &= getattr(d, 'device_refit_ok', False)\n"
+        "        # device_solve_ok is consulted via device_schedule_ok\n"
+        "        ok &= getattr(tr, 'device_support_ok', False)\n"
+        "        return ok\n"
+        "    def _fused_eligible(self):\n"
+        "        if self.population_strategy(0) > (1 << 17):\n"
+        "            return False\n"
+        "        return self._device_chain_eligible()\n")
+    got = mod.check(root=str(pkg))
+    msgs = [msg for _, _, msg in got]
+    # _fused_eligible dropped PROBE_MIN_POP and hardcodes 1 << 17
+    assert any("PROBE_MIN_POP" in m and "_fused_eligible" in m
+               for m in msgs)
+    assert any("1 << 17" in m for m in msgs)
+    # the chain body mentions every flag (the comment counts as
+    # consulting on purpose: the lint is textual, suppression is the
+    # escape hatch) — so no chain-flag violations here
+    assert not any("_device_chain_eligible() no longer consults" in m
+                   for m in msgs)
+
+
+def test_detects_missing_functions_and_suppression(tmp_path):
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "smc.py").write_text("class ABCSMC:\n    pass\n")
+    got = mod.check(root=str(pkg))
+    assert {msg for _, _, msg in got} == {
+        "_device_chain_eligible() not found",
+        "_fused_eligible() not found"}
+    # suppression marker silences a deliberate deviation
+    (pkg / "smc.py").write_text(
+        "class ABCSMC:\n"
+        "    def _device_chain_eligible(self):\n"
+        "        return False  # eligibility-ok\n"
+        "    def _fused_eligible(self):\n"
+        "        return False  # eligibility-ok\n")
+    assert mod.check(root=str(pkg)) == []
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    mod = _load()
+    assert mod.main([]) == 0  # the real tree
+    assert "clean" in capsys.readouterr().out
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "smc.py").write_text(
+        "def _fused_eligible(self):\n"
+        "    return True\n")
+    assert mod.main([str(pkg)]) == 1
+    out = capsys.readouterr().out
+    assert "PROBE_MIN_POP" in out

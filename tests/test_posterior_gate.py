@@ -10,6 +10,8 @@ supports) is caught by the ordinary test suite.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
 
@@ -21,3 +23,16 @@ def test_gate_smoke_small_pop():
     assert out["posterior_gate_ok"], out
     # epsilon must actually have annealed (the gate exercises refits)
     assert out["posterior_gate_final_eps"] < 0.1, out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_gate_multi_seed_pop_1e5(seed):
+    """Driver-grade seed sweep: the full 11-generation gate at pop 1e5
+    across >= 4 seeds.  Four independent passes at 1/sqrt(pop)-scaled
+    tolerance make a systematic bias in the fast paths (fused blocks,
+    capped-support refit, wire narrowing, deferred proposal) detectable
+    where the single-seed smoke above could ride seed weather."""
+    out = run_gate(pop=100_000, gens=11, seed=seed)
+    assert out["posterior_gate_ok"], out
+    assert out["posterior_gate_final_eps"] < 0.05, out
