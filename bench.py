@@ -203,6 +203,7 @@ def bench_primary():
     # timeline.summary() are scalars, so they survive into the compact
     # line; the row list and registry dict ride the full line only.
     from pyabc_tpu.telemetry import REGISTRY
+    from pyabc_tpu.wire import transfer as _wt
     cc = compile_delta(cc0)
     n_gens = max(len(abc.timeline), 1)
     telemetry = {
@@ -223,6 +224,11 @@ def bench_primary():
             "resilience_retries_total", 0)),
         "checkpoint_s_per_gen": round(REGISTRY.to_dict().get(
             "resilience_checkpoint_seconds_total", 0.0) / n_gens, 4),
+        # d2h egress attribution (wire/transfer.py): on a healthy bench
+        # run nearly all egress is population bytes; growth in the other
+        # subsystems means the hot loop started paying for side traffic
+        **{f"telemetry_egress_{name}_mb": round(v / 1e6, 3)
+           for name, v in _wt.egress_breakdown().items()},
     }
     return rate, times, evals_ps, transfer, telemetry
 

@@ -115,12 +115,22 @@ def info(run_dir):
 @click.option("--run-dir", default=None,
               help="shared run dir — export every worker's heartbeat "
                    "metrics; omit for this process's own registry")
-def metrics(run_dir):
+@click.option("--fleet", is_flag=True, default=False,
+              help="with --run-dir: export the cross-host rollup "
+                   "(pyabc_tpu_fleet_* sum/max/p50/p99) from the "
+                   "telemetry snapshots instead of raw heartbeats")
+def metrics(run_dir, fleet):
     """Prometheus text exposition of the telemetry registry: with
     --run-dir, one ``pyabc_tpu_worker_*`` sample per worker heartbeat
-    metric (labeled by host/pid); without, this process's own registry —
-    scrape-ready either way."""
+    metric (labeled by host/pid) — or the aggregated
+    ``pyabc_tpu_fleet_*`` rollup with --fleet; without, this process's
+    own registry — scrape-ready either way."""
     if run_dir:
+        if fleet:
+            from ..telemetry import aggregate
+
+            click.echo(aggregate.render_prometheus(run_dir), nl=False)
+            return
         from . import health
         from ..telemetry.metrics import render_worker_prometheus
 
@@ -130,6 +140,106 @@ def metrics(run_dir):
     from ..telemetry.metrics import REGISTRY
 
     click.echo(REGISTRY.render_prometheus(), nl=False)
+
+
+def _render_top(run_dir) -> str:
+    """One frame of the fleet view: header totals, per-host rows, and
+    the recent-generation tail (merged across hosts)."""
+    from . import health
+    from ..telemetry import aggregate
+
+    status = {(e.get("host"), e.get("pid")): e
+              for e in health.worker_status(run_dir)}
+    snaps = aggregate.read_snapshots(run_dir)
+    lines = []
+    tot = {"generations": 0, "evaluations": 0, "accepted": 0,
+           "d2h_mb": 0.0, "retries": 0, "degrades": 0, "checkpoints": 0,
+           "faults": 0, "flights": 0}
+    rows = []
+    engine = None
+    for s in snaps:
+        hb = s.get("heartbeat") or {}
+        m = s.get("metrics") or {}
+        for key in ("generations", "evaluations", "accepted", "retries",
+                    "degrades", "checkpoints"):
+            tot[key] += int(hb.get(key, 0))
+        tot["d2h_mb"] += float(hb.get("d2h_mb", 0.0))
+        tot["faults"] += int(m.get("resilience_faults_injected_total", 0))
+        tot["flights"] += int(m.get("flight_dumps_total", 0))
+        live = status.get((s.get("host"), s.get("pid")))
+        state = ("alive" if live and live.get("alive")
+                 else "STALE" if live else "?")
+        evals = hb.get("evaluations", 0)
+        uptime = max(hb.get("uptime_s", 0.0), 1e-9)
+        rows.append(
+            f"  {s['host']}:{s['pid']} {state} "
+            f"gens={hb.get('generations', 0)} "
+            f"evals={evals} ({evals / uptime:.1f}/s) "
+            f"acc={hb.get('acceptance_rate', 0.0):.4g} "
+            f"d2h={hb.get('d2h_mb', 0.0):.2f}MB"
+            f"@{hb.get('d2h_mb_per_s', 0.0):.2f}MB/s "
+            f"retries={hb.get('retries', 0)} "
+            f"degrades={hb.get('degrades', 0)}")
+        for r in s.get("trajectory") or []:
+            if r.get("engine") is not None:
+                engine = r["engine"]
+    acc_rate = (tot["accepted"] / tot["evaluations"]
+                if tot["evaluations"] else 0.0)
+    lines.append(
+        f"fleet: hosts={len(snaps)} gens={tot['generations']} "
+        f"evals={tot['evaluations']} acc_rate={acc_rate:.4g} "
+        f"d2h={tot['d2h_mb']:.2f}MB engine={engine or '-'}")
+    lines.append(
+        f"resilience: retries={tot['retries']} "
+        f"degrades={tot['degrades']} checkpoints={tot['checkpoints']} "
+        f"faults={tot['faults']} flight_dumps={tot['flights']}")
+    lines.extend(rows or ["  (no telemetry snapshots yet)"])
+    # recent generations across the fleet, newest last
+    tail = []
+    for s in snaps:
+        for r in (s.get("timeline_tail") or [])[-8:]:
+            tail.append((r.get("gen", -1), s["host"], r))
+    tail.sort(key=lambda x: x[0])
+    if tail:
+        lines.append("recent generations:")
+        for gen, host, r in tail[-10:]:
+            eps = r.get("eps")
+            lines.append(
+                f"  t={gen} [{host}] {r.get('path', '?')} "
+                f"wall={r.get('wall_s', 0.0):.3f}s "
+                f"eps={'-' if eps is None else format(eps, '.4g')} "
+                f"acc={r.get('accepted', '-')}/{r.get('total', '-')} "
+                f"engine={r.get('engine') or '-'}")
+    return "\n".join(lines)
+
+
+@click.command("abc-top")
+@click.option("--run-dir", required=True,
+              help="shared run dir the workers publish telemetry into")
+@click.option("--watch", default=0.0, type=float,
+              help="refresh every N seconds (0 = print once and exit)")
+@click.option("--trace", is_flag=True, default=False,
+              help="also write the merged fleet Chrome trace "
+                   "(telemetry/fleet_trace.json) before rendering")
+def top(run_dir, watch, trace):
+    """Live fleet view over a run directory: per-host throughput,
+    resilience ledger, engine decision and the recent generation tail —
+    the ``top(1)`` of an ABC fleet."""
+    from ..telemetry import aggregate
+
+    while True:
+        if trace:
+            path = aggregate.write_merged_trace(run_dir)
+            click.echo(f"merged trace: {path}")
+        click.echo(_render_top(run_dir))
+        if not watch:
+            return
+        import time as _time
+        _time.sleep(watch)
+        click.clear()
+
+
+manage.add_command(top)
 
 
 @manage.command()

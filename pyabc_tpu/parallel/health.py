@@ -22,10 +22,12 @@ from __future__ import annotations
 
 import json
 import os
-import socket
+import socket  # noqa: F401  (re-exported for callers that patch it)
 import threading
 import time
 from typing import Dict, List, Optional
+
+from ..telemetry.aggregate import SCHEMA_VERSION, host_id
 
 RUN_DIR_ENV = "PYABC_TPU_RUN_DIR"
 STOP_SENTINEL = "STOP"
@@ -77,9 +79,11 @@ class Heartbeat:
             from ..telemetry.metrics import heartbeat_summary
             metrics_fn = heartbeat_summary
         self.metrics_fn = metrics_fn
+        # host_id() (not the raw hostname) so heartbeats, telemetry
+        # snapshots and span files all key the same fleet identity —
+        # overridable via $PYABC_TPU_HOST_ID (containers, tests)
         self.path = os.path.join(
-            directory, f"{_HB_PREFIX}{socket.gethostname()}_{os.getpid()}"
-                       ".json")
+            directory, f"{_HB_PREFIX}{host_id()}_{os.getpid()}.json")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -90,10 +94,17 @@ class Heartbeat:
         fault_point(SITE_HEARTBEAT)
         os.makedirs(self.directory, exist_ok=True)
         payload = {
-            "host": socket.gethostname(),
+            # same schema version as the telemetry snapshots: the fleet
+            # aggregator and `abc-distributed-manager info` consume both
+            # record kinds without format sniffing
+            "schema_version": SCHEMA_VERSION,
+            "host": host_id(),
             "pid": os.getpid(),
             "process_index": self.process_index,
             "ts": time.time(),
+            # wall minus monotonic: lets any reader translate this
+            # host's monotonic stamps to its wall clock
+            "monotonic_offset_s": time.time() - time.monotonic(),
         }
         try:
             payload["metrics"] = self.metrics_fn()

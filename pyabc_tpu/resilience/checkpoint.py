@@ -75,6 +75,19 @@ def install_signal_handlers() -> bool:
 
     def _handler(signum, frame):
         _PREEMPT.set()
+        # Evidence first: flush the trace tail and note the preemption
+        # in the flight recorder NOW — the sampler loop will exit via
+        # Preempted at the next device-call boundary, but if the kill
+        # timeout races the unwind, the spans and the flight note are
+        # the only record of what the run was doing when it died.
+        try:
+            from ..telemetry import spans
+            from ..telemetry.flight import RECORDER
+            RECORDER.note("preempt", signal="SIGTERM")
+            RECORDER.dump(reason="SIGTERM")
+            spans.TRACER.flush()
+        except Exception:
+            pass  # a handler must never turn a preemption into a crash
         if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
             prev(signum, frame)
 

@@ -215,6 +215,9 @@ class FaultPlan:
                     self.fired[key] = self.fired.get(key, 0) + 1
         for spec in actions:
             _counter("resilience_faults_injected_total").inc()
+            from ..telemetry.flight import RECORDER
+            RECORDER.note("fault", site=site, action=spec.action,
+                          visit=visit)
             if spec.action == "delay":
                 time.sleep(spec.action_arg)
             elif spec.action == "sigterm":

@@ -173,7 +173,16 @@ class RetryPolicy:
                 _counter("resilience_retries_total").inc()
                 _counter("resilience_retry_"
                          + site.replace(".", "_")).inc()
+                from ..telemetry.flight import RECORDER
+                RECORDER.note("retry", site=site, attempt=failures,
+                              err=f"{type(err).__name__}: {err}")
                 if failures >= self.max_attempts:
+                    # dump at the RAISE, not where the exception lands:
+                    # the orchestrator may absorb this into a degradation
+                    # and the evidence must survive the recovery
+                    RECORDER.note("retry_exhausted", site=site,
+                                  attempts=failures)
+                    RECORDER.dump(reason=f"RetryExhausted:{site}")
                     raise RetryExhausted(site, failures) from err
                 backoff = self.delay_s(failures)
                 logger.warning(
@@ -198,10 +207,13 @@ def shared_policy() -> RetryPolicy:
     return _SHARED
 
 
-def record_degrade():
+def record_degrade(kind: str = ""):
     """Count one graceful-degradation step (batch-rung drop or engine
-    fallback) in the telemetry registry."""
+    fallback) in the telemetry registry, and note it in the flight
+    recorder so a dump explains WHY throughput changed mid-run."""
     _counter("resilience_degrade_total").inc()
+    from ..telemetry.flight import RECORDER
+    RECORDER.note("degrade", degradation=kind or "unspecified")
 
 
 def retry_counters() -> dict:

@@ -519,8 +519,11 @@ class Sample:
         # ONE bundled host transfer for all requested columns of all
         # batches (per-column np.asarray would pay the relay's
         # per-transaction constant keys x batches times)
-        fetched = fetch_to_host([{k: b[k] for k in keys}
-                                 for b in self._rec])
+        from ..wire.transfer import egress
+
+        with egress("summary"):
+            fetched = fetch_to_host([{k: b[k] for k in keys}
+                                     for b in self._rec])
         out = {}
         for k in keys:
             parts = [np.asarray(f[k])[:b["__count"]]

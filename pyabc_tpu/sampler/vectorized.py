@@ -36,6 +36,7 @@ from ..autotune import (BatchAutotuner, CompiledLadder, aot_compile,
                         avals_like, jit_compile)
 from ..resilience import faults as _faults
 from ..resilience import retry as _retry
+from ..wire.transfer import egress as _egress
 from .base import Sample, Sampler, SamplingError, fetch_to_host, widen_wire
 from .device_loop import build_stateful_loop
 
@@ -239,7 +240,7 @@ class VectorizedSampler(Sampler):
             return None
         self.max_batch_size = max(self.max_batch_size // 2,
                                   self.min_batch_size)
-        _retry.record_degrade()
+        _retry.record_degrade("batch_rung_drop")
         logger.warning(
             "degrading batch ceiling to %d after repeated dispatch "
             "failure", self.max_batch_size)
@@ -407,8 +408,9 @@ class VectorizedSampler(Sampler):
                 state, wire_dev, out_dev = self._dispatch(
                     step_finalize, sub, params, state)
                 if defer_wire:
-                    scalars = fetch_to_host([wire_dev["count"],
-                                             wire_dev["rounds"]])
+                    with _egress("control"):
+                        scalars = fetch_to_host([wire_dev["count"],
+                                                 wire_dev["rounds"]])
                     count, rounds = int(scalars[0]), int(scalars[1])
                     pending = (wire_dev, out_dev)
                 else:
@@ -440,7 +442,8 @@ class VectorizedSampler(Sampler):
                     scalars = [state["count"], state["rounds"]]
                     if rec is not None:
                         scalars.append(rec["rec_count"])
-                    scalars = fetch_to_host(scalars)
+                    with _egress("control"):
+                        scalars = fetch_to_host(scalars)
                     count, rounds = int(scalars[0]), int(scalars[1])
                     if rec is not None:
                         rec["rec_count_host"] = int(scalars[2])
@@ -461,7 +464,8 @@ class VectorizedSampler(Sampler):
                     # not buffer-donating, so a mid-loop call leaves the
                     # carry intact for the rounds that follow
                     wire_ck, _ = self._dispatch(finalize, state, params)
-                    out_ck = fetch_to_host(wire_ck)
+                    with _egress("checkpoint"):
+                        out_ck = fetch_to_host(wire_ck)
                     take = min(count, out_ck["theta"].shape[0])
                     ck.flush(widen_wire(out_ck, take), rounds=rounds,
                              nr_evaluations=rounds * B)

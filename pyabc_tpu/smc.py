@@ -41,8 +41,9 @@ from .sampler.base import Sample, Sampler
 from .sampler.rounds import RoundKernel
 from .storage.history import PRE_TIME, History
 from .sumstat import SumStatSpec
-from .telemetry import GenerationTimeline, metrics as _metrics, \
-    profile_generation, spans as _spans
+from .telemetry import GenerationTimeline, aggregate as _aggregate, \
+    flight as _flight, metrics as _metrics, profile_generation, \
+    spans as _spans
 from .transition import MultivariateNormalTransition, Transition
 from .weighted_statistics import effective_sample_size
 
@@ -205,6 +206,10 @@ class ABCSMC:
         #: per-generation stage-duration rows (telemetry/timeline.py),
         #: fed by every run path at generation boundaries
         self.timeline = GenerationTimeline()
+        #: fleet telemetry publisher (telemetry/aggregate.py), created
+        #: at run start when PYABC_TPU_RUN_DIR is advertised; None keeps
+        #: the per-generation cost to one attribute check
+        self._fleet = None
         #: persistent XLA compile-cache directory (autotune/cache.py):
         #: explicit argument wins, else $PYABC_TPU_COMPILE_CACHE, else
         #: off.  Armed here so every program this instance compiles —
@@ -1054,6 +1059,8 @@ class ABCSMC:
                     count_k, evals_k, rounds=rounds_k,
                     compute_s=tr_delta["compute_s"] / written,
                     overlap_s=tr_delta["overlap_s"] / written)
+            if self._fleet is not None:
+                self._fleet.publish(self.timeline)
             last_pop = pop_k
             if stop_reason is None and t + written < t_max:
                 # keep the chain hot: device carry for the next block
@@ -1470,6 +1477,8 @@ class ABCSMC:
                             count_k, evals_k, rounds=rounds_k,
                             compute_s=tr_delta["compute_s"] / written,
                             overlap_s=tr_delta["overlap_s"] / written)
+                if self._fleet is not None:
+                    self._fleet.publish(self.timeline)
                 if blk["kind"] == "block":
                     st["last_dp"] = (dict(blk["carry_out"])
                                      if written == K else None)
@@ -1660,11 +1669,23 @@ class ABCSMC:
     def _configure_telemetry(self):
         """Arm the span tracer for this run: an explicit ``trace_path``
         wins, else the ``PYABC_TPU_TRACE`` env var (no-op when neither
-        is set — the tracer stays a one-boolean-check no-op)."""
+        is set — the tracer stays a one-boolean-check no-op).
+
+        Fleet publishing piggybacks on the same call: when a run
+        directory is advertised (``PYABC_TPU_RUN_DIR``), every host
+        publishes snapshots + spans into it for the aggregator
+        (telemetry/aggregate.py); otherwise ``self._fleet`` is None and
+        the per-generation cost is one attribute check.  The flight
+        recorder is pointed at this run's identity/timeline so a dump
+        from ANY trigger site carries the run context."""
         if self.trace_path:
             _spans.TRACER.configure(trace_path=self.trace_path)
         else:
             _spans.TRACER.configure_from_env()
+        self._fleet = _aggregate.publisher_from_env()
+        _flight.RECORDER.set_timeline(self.timeline)
+        if self.history is not None:
+            _flight.RECORDER.set_run_id(getattr(self.history, "id", None))
 
     def run(self,
             minimum_epsilon: float = 0.0,
@@ -1683,8 +1704,17 @@ class ABCSMC:
                 return self._run_master(
                     minimum_epsilon, max_nr_populations,
                     min_acceptance_rate, max_total_nr_simulations)
+        except BaseException as err:
+            # crash evidence before unwind: the flight dump is the
+            # post-hoc diagnosis surface for pod-scale failures
+            # (RetryExhausted already dumped at its raise site; this
+            # overwrite adds the run-level timeline context)
+            _flight.RECORDER.dump(reason=type(err).__name__)
+            raise
         finally:
             _spans.TRACER.flush()
+            if self._fleet is not None:
+                self._fleet.publish(self.timeline, force=True)
             if len(self.timeline):
                 logger.debug("generation timeline:\n%s",
                              self.timeline.render_ascii())
@@ -1909,6 +1939,8 @@ class ABCSMC:
             _metrics.record_generation(
                 sample.nr_evaluations, sample.raw_accepted,
                 acceptance_rate, wall_s=self.generation_wall_clock[t])
+            if self._fleet is not None:
+                self._fleet.publish(self.timeline)
             # the sampler observed its acceptance rate per device call;
             # the compute/overlap split (wire ledger) is only visible
             # here — close the autotuner's timing loop

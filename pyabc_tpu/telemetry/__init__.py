@@ -10,6 +10,12 @@ registry, and the per-generation run timeline.
   fed by the orchestrator at generation boundaries.
 - :func:`profile_generation` — optional ``jax.profiler`` hook for a
   single generation (``PYABC_TPU_PROFILE_GEN=<t>``).
+- :mod:`.aggregate` — cross-host fleet layer over the shared run
+  directory: per-host snapshot/span publishing, the clock-aligned
+  merged trace, sum/max/p50/p99 rollups and the fleet Prometheus
+  endpoint (``abc-top`` / ``abc-server`` read through it).
+- :mod:`.flight` — always-on bounded flight recorder dumping
+  ``flight_<runid>.json`` on crash / ``RetryExhausted`` / SIGTERM.
 
 See docs/observability.md for the operator guide.
 """
@@ -19,7 +25,8 @@ from __future__ import annotations
 import contextlib
 import os
 
-from . import metrics, spans, timeline
+from . import aggregate, flight, metrics, spans, timeline
+from .flight import RECORDER
 from .metrics import REGISTRY
 from .spans import TRACER, begin, end, span
 from .timeline import GenerationTimeline
@@ -51,6 +58,7 @@ def profile_generation(t: int):
 
 
 __all__ = [
-    "GenerationTimeline", "REGISTRY", "TRACER", "begin", "end",
-    "metrics", "profile_generation", "span", "spans", "timeline",
+    "GenerationTimeline", "RECORDER", "REGISTRY", "TRACER", "aggregate",
+    "begin", "end", "flight", "metrics", "profile_generation", "span",
+    "spans", "timeline",
 ]

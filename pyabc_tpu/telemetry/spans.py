@@ -32,6 +32,7 @@ by wrapping the lines into the JSON array form::
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -161,6 +162,15 @@ class SpanTracer:
     def capacity(self) -> int:
         return self._ring.maxlen
 
+    def t0_unix(self) -> float:
+        """Wall-clock (unix) instant of trace ``ts == 0``.
+
+        Span ``ts`` values are microseconds since the tracer's
+        ``perf_counter`` origin; publishing this anchor next to each
+        host's span file lets the fleet aggregator shift every host onto
+        one common timebase (``telemetry/aggregate.py``)."""
+        return time.time() - (time.perf_counter() - self._t0)
+
     # -- recording -----------------------------------------------------
     def begin(self, name: str, gen=None, **attrs) -> Span:
         return Span(self, name, gen, attrs)
@@ -216,6 +226,12 @@ class SpanTracer:
 
 #: the process-global tracer every instrumentation site uses
 TRACER = SpanTracer()
+
+# A preempted or crashing process must not lose the buffered tail of its
+# trace — that tail is usually the part that explains the exit.  flush()
+# is a no-op when no sink is configured or the buffer is empty, so this
+# costs nothing in the disabled default.
+atexit.register(TRACER.flush)
 
 
 def span(name: str, gen=None, **attrs):

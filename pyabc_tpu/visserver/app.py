@@ -8,6 +8,13 @@ API and this page renders it with inline-SVG charts — run/model/
 parameter selectors, a generation slider with play-through animation of
 the posterior, epsilon/acceptance trajectories and model-probability
 bars, all live without page reloads.
+
+When the server is started with ``--run-dir`` a LIVE fleet card appears
+on top, polling ``/api/fleet`` every 2 s while the run is in flight:
+per-host throughput, wire MB/s, retries/degrades/checkpoints, compile
+counts, the fused-vs-sequential engine decision and an eps/acceptance
+trajectory fed from the telemetry snapshots (not the History, which
+only learns a generation once it is appended).
 """
 
 PAGE = """<!doctype html>
@@ -25,6 +32,13 @@ PAGE = """<!doctype html>
  td,th{border:1px solid #ddd;padding:.15em .5em;text-align:right}
 </style></head><body>
 <h1>pyabc_tpu — ABC-SMC runs</h1>
+<div class=card id=livecard style="display:none;margin-bottom:1em">
+ <h2>live run <span id=liveinfo class=lbl></span></h2>
+ <div class=row>
+  <div><div id=livehosts></div></div>
+  <svg id=livetraj width=340 height=180></svg>
+ </div>
+</div>
 <div>
  run <select id=run></select>
  model <select id=model></select>
@@ -108,6 +122,22 @@ $('play').onclick=()=>{
  S.timer=setInterval(async()=>{let t=+$('tslider').value;
   if(t>=S.meta.max_t){clearInterval(S.timer);S.timer=null;$('play').innerHTML='&#9654; play';return}
   $('tslider').value=t+1;await drawKde()},600)};
+async function pollFleet(){
+ let d;try{d=await j('/api/fleet')}catch(e){return}
+ if(!d.enabled)return;
+ $('livecard').style.display='';
+ $('liveinfo').textContent=`engine=${d.engine||'-'} | ${d.hosts.length} host(s)`;
+ let html='<table><tr><th>host</th><th>state</th><th>gens</th><th>evals</th><th>acc</th><th>d2h MB/s</th><th>compiles</th><th>retries</th><th>degrades</th><th>ckpts</th><th>flights</th></tr>';
+ for(const h of d.hosts)html+=`<tr><td>${h.host}:${h.pid}</td><td>${h.alive==null?'?':h.alive?'alive':'STALE'}</td><td>${h.generations}</td><td>${h.evaluations}</td><td>${(+h.acceptance_rate).toFixed(4)}</td><td>${(+h.d2h_mb_per_s).toFixed(2)}</td><td>${h.n_compiles}</td><td>${h.retries}</td><td>${h.degrades}</td><td>${h.checkpoints}</td><td>${h.flight_dumps}</td></tr>`;
+ $('livehosts').innerHTML=html+'</table>';
+ const T=d.trajectory.filter(r=>r.eps!=null);
+ if(T.length>1){
+  line($('livetraj'),T.map(r=>r.gen),T.map(r=>Math.log10(Math.max(r.eps,1e-12))),{color:'#1667c0',label:'log10 eps'});
+  const A=d.trajectory.filter(r=>r.accepted!=null&&r.total);
+  if(A.length>1)line($('livetraj'),A.map(r=>r.gen),A.map(r=>r.accepted/r.total),{keep:true,color:'#2a9d3a',label:'acc rate',li:1,ymin:0,ymax:1});
+ }
+}
+pollFleet();setInterval(pollFleet,2000);
 loadRuns();
 </script></body></html>
 """

@@ -33,6 +33,9 @@ _PAGE = """<!doctype html><html><head><title>pyabc_tpu</title>
 
 class _Handler(BaseHTTPRequestHandler):
     db_path: str = ""
+    #: shared run directory for the LIVE fleet view (--run-dir); empty
+    #: = post-hoc History browsing only, the pre-fleet behavior
+    run_dir: str = ""
 
     def _send(self, content, ctype="text/html"):
         data = content if isinstance(content, bytes) else content.encode()
@@ -71,6 +74,8 @@ class _Handler(BaseHTTPRequestHandler):
                                     int(parts[5]))
         if parts[0] == "plot" and len(parts) == 4:
             return self._kde_png(int(parts[1]), int(parts[2]), int(parts[3]))
+        if parts == ["metrics"]:
+            return self._metrics()
         self._send(_PAGE.format(body="<p>not found</p>"))
 
     def _spa(self):
@@ -95,8 +100,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _metrics(self):
+        """Fleet Prometheus endpoint (needs --run-dir): the same text
+        `abc-distributed-manager metrics --fleet` prints, served over
+        HTTP so the dashboard host doubles as the scrape target."""
+        if not self.run_dir:
+            return self._send("# no --run-dir configured\n",
+                              ctype="text/plain")
+        from ..telemetry import aggregate
+
+        self._send(aggregate.render_prometheus(self.run_dir),
+                   ctype="text/plain")
+
     def _api(self, parts, query):
-        """JSON API: runs / run metadata / per-(m, t, parameter) KDE."""
+        """JSON API: runs / run metadata / per-(m, t, parameter) KDE /
+        live fleet state."""
+        if parts == ["fleet"]:
+            return self._json(self._fleet_state())
         if parts == ["runs"]:
             h = History(self.db_path, abc_id=1)
             runs = h.all_runs()
@@ -148,6 +168,52 @@ class _Handler(BaseHTTPRequestHandler):
                                "density": [float(d) for d in dens],
                                "n": int(len(df))})
         self._json({"error": "unknown api route"}, status=404)
+
+    def _fleet_state(self) -> dict:
+        """Live per-run view from the telemetry snapshots in the run
+        directory: eps/acceptance trajectory, engine decision, compile
+        counts, wire MB/s, resilience ledger — refreshing while the run
+        is in flight (the History only learns a generation at append
+        time, and nothing mid-generation)."""
+        if not self.run_dir:
+            return {"enabled": False}
+        from ..parallel import health
+        from ..telemetry import aggregate
+
+        snaps = aggregate.read_snapshots(self.run_dir)
+        alive = {(e.get("host"), e.get("pid")): bool(e.get("alive"))
+                 for e in health.worker_status(self.run_dir)}
+        hosts = []
+        trajectory = []
+        engine = None
+        for s in snaps:
+            hb = s.get("heartbeat") or {}
+            m = s.get("metrics") or {}
+            hosts.append({
+                "host": s["host"], "pid": s["pid"],
+                "alive": alive.get((s["host"], s["pid"])),
+                "generations": hb.get("generations", 0),
+                "evaluations": hb.get("evaluations", 0),
+                "acceptance_rate": hb.get("acceptance_rate", 0.0),
+                "d2h_mb": hb.get("d2h_mb", 0.0),
+                "d2h_mb_per_s": hb.get("d2h_mb_per_s", 0.0),
+                "retries": hb.get("retries", 0),
+                "degrades": hb.get("degrades", 0),
+                "checkpoints": hb.get("checkpoints", 0),
+                "n_compiles": int(m.get("xla_compiles_total", 0)),
+                "flight_dumps": int(m.get("flight_dumps_total", 0)),
+                "egress": s.get("egress") or {},
+                "written_unix": s.get("written_unix"),
+            })
+            for r in s.get("trajectory") or []:
+                row = dict(r)
+                row["host"] = s["host"]
+                trajectory.append(row)
+                if r.get("engine") is not None:
+                    engine = r["engine"]
+        trajectory.sort(key=lambda r: (r.get("gen", -1), r["host"]))
+        return {"enabled": True, "hosts": hosts,
+                "trajectory": trajectory, "engine": engine}
 
     def _index(self):
         h = History(self.db_path, abc_id=1)
@@ -202,9 +268,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def run_app(db: str, port: int = 8765, host: str = "127.0.0.1",
-            blocking: bool = True):
-    """Start the server (reference visserver/server.py:198-202)."""
+            blocking: bool = True, run_dir: str = ""):
+    """Start the server (reference visserver/server.py:198-202).
+    ``run_dir`` additionally enables the live fleet view (``/api/fleet``
+    + ``/metrics``) over a shared telemetry run directory."""
     _Handler.db_path = db
+    _Handler.run_dir = run_dir or ""
     httpd = ThreadingHTTPServer((host, port), _Handler)
     if blocking:
         print(f"serving {db} on http://{host}:{port}")
@@ -219,8 +288,11 @@ def main():
     @click.option("--db", required=True)
     @click.option("--port", default=8765, type=int)
     @click.option("--host", default="127.0.0.1")
-    def cli(db, port, host):
-        run_app(db, port, host)
+    @click.option("--run-dir", default="",
+                  help="shared telemetry run dir — enables the live "
+                       "fleet view (/api/fleet, /metrics)")
+    def cli(db, port, host, run_dir):
+        run_app(db, port, host, run_dir=run_dir)
 
     cli()
 

@@ -82,25 +82,42 @@ class GenStream:
         self._label = label
         self._next = 0
         self._ticket = None
+        self._span = None
         self._submit()
 
     def _submit(self):
+        from ..telemetry import spans
         if self._next >= self._K:
             self._ticket = None
+            self._span = None
             self._wires = None  # release the device block reference
             return
         from ..sampler.device_loop import slice_block_wire
         k = self._next
         gw = slice_block_wire(self._wires, k)
+        # one stream.gen span per in-flight generation, explicitly ended
+        # on EVERY resolution path (result/drain_rounds/abandon) so a
+        # Perfetto trace of an early-stopped or rewound block has no
+        # dangling begins (tools/check_span_pairs.py)
+        self._span = spans.begin("stream.gen", gen=k, label=self._label)
         self._ticket = self._engine.submit(
             lambda: _fetch_gen(gw, self._n),
             label=f"{self._label}+{k}")
         self._next += 1
 
+    def _end_span(self, outcome: str):
+        from ..telemetry import spans
+        if self._span is not None:
+            spans.end(self._span.set(outcome=outcome))
+            self._span = None
+
     def result(self):
         """Resolve the next generation's ``(batch, count, rounds, eps)``
         and queue the following one."""
-        out = self._ticket.result()
+        try:
+            out = self._ticket.result()
+        finally:
+            self._end_span("resolved")
         self._submit()
         return out
 
@@ -115,6 +132,7 @@ class GenStream:
                 total += int(rounds)
             except Exception:
                 pass  # a failed tail fetch only loses accounting
+            self._end_span("drained")
             self._submit()
         return total
 
@@ -124,6 +142,7 @@ class GenStream:
         if self._ticket is not None:
             self._ticket.abandon()
             self._ticket = None
+        self._end_span("abandoned")
         self._wires = None
 
 

@@ -45,8 +45,10 @@ pyabc/sampler/redis_eps/sampler.py result pipelines).
 
 from __future__ import annotations
 
+import threading
 import time
 from collections.abc import Mapping
+from contextlib import contextmanager
 
 from ..telemetry.metrics import REGISTRY
 
@@ -81,6 +83,48 @@ _METRIC = {
 #: the registry lock — held by ``snapshot()`` reads and counter writes
 _lock = REGISTRY._lock
 
+#: d2h egress subsystems — every fetched byte is attributed to exactly
+#: one (the measurement ROADMAP #3 "kill the wire" needs before
+#: inverting the dataflow).  ``population`` is the thread-default
+#: because the ingest worker threads only ever fetch population wires;
+#: the other callers label themselves inline with :func:`egress`.
+#: ``history`` is reserved for device-resident History lazy fetches.
+EGRESS_SUBSYSTEMS = ("population", "history", "checkpoint", "summary",
+                     "control", "other")
+
+_EGRESS_DEFAULT = "population"
+_egress_tls = threading.local()
+
+
+def current_egress() -> str:
+    """The subsystem the calling thread's next d2h bytes are booked to."""
+    return getattr(_egress_tls, "label", _EGRESS_DEFAULT)
+
+
+@contextmanager
+def egress(subsystem: str):
+    """Attribute d2h bytes recorded by this thread inside the block to
+    ``subsystem``.  Unknown names book to ``other`` rather than raising:
+    attribution must never break a fetch."""
+    if subsystem not in EGRESS_SUBSYSTEMS:
+        subsystem = "other"
+    prev = getattr(_egress_tls, "label", _EGRESS_DEFAULT)
+    _egress_tls.label = subsystem
+    try:
+        yield
+    finally:
+        _egress_tls.label = prev
+
+
+def egress_breakdown() -> dict:
+    """Cumulative d2h bytes per subsystem.  Sums to ``d2h_bytes`` by
+    construction — every ``record_d2h`` books the bytes to exactly one
+    subsystem counter (``tests/test_fleet_telemetry.py`` asserts the
+    100 % invariant)."""
+    with _lock:
+        return {name: int(_c(f"wire_egress_{name}_bytes_total").value)
+                for name in EGRESS_SUBSYSTEMS}
+
 
 def _tree_nbytes(tree) -> int:
     import jax.tree_util as tu
@@ -94,6 +138,7 @@ def record_d2h(nbytes: int, seconds: float):
         _c("wire_d2h_bytes_total").inc(int(nbytes))
         _c("wire_fetch_seconds_total").inc(float(seconds))
         _c("wire_d2h_calls_total").inc()
+        _c(f"wire_egress_{current_egress()}_bytes_total").inc(int(nbytes))
 
 
 def record_h2d(nbytes: int):

@@ -1,0 +1,131 @@
+"""Tier-1 wrapper for tools/bench_sentinel.py: the regression sentinel
+must pass on the recorded fixture capture, catch a synthetic 20 %
+regression, tolerate missing rows (a crashed sub-bench must not mask or
+fake a regression), and fail loudly on an unreadable capture."""
+
+import importlib.util
+import json
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "bench_sentinel.py")
+_FIXTURES = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                         "fixtures")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("bench_sentinel", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _capture_path():
+    return os.path.join(_FIXTURES, "bench_capture_ok.txt")
+
+
+def test_self_check_mode():
+    """`bench_sentinel.py --check` is the recorded-fixture round trip:
+    fixture capture passes, synthetic regression is caught."""
+    mod = _load()
+    assert mod.main(["--check"]) == 0
+
+
+def test_fixture_capture_passes_against_fixture_trajectory(capsys):
+    mod = _load()
+    assert mod.main([_capture_path(), _FIXTURES]) == 0
+    out = capsys.readouterr().out
+    assert "no regression" in out
+
+
+def test_twenty_percent_regression_fails():
+    """A 20 % drop on any throughput row must exceed its tolerance —
+    the sentinel's reason to exist."""
+    mod = _load()
+    new = mod.load_capture(_capture_path())
+    ref = mod.reference_row(mod.load_trajectory(_FIXTURES))
+    for key in ("value", "northstar_pop1e6_accepted_per_sec"):
+        bad = dict(new)
+        bad[key] = bad[key] * 0.80
+        fails = mod.compare(bad, ref)
+        assert any(k == key for k, *_ in fails), key
+    # and seconds-per-gen fails HIGH, not low
+    bad = dict(new)
+    bad["fused_northstar_s_per_gen"] *= 1.30
+    assert any(k == "fused_northstar_s_per_gen"
+               for k, *_ in mod.compare(bad, ref))
+
+
+def test_direction_awareness():
+    """Faster is never a regression: throughput up and seconds down must
+    both pass."""
+    mod = _load()
+    new = mod.load_capture(_capture_path())
+    ref = mod.reference_row(mod.load_trajectory(_FIXTURES))
+    better = dict(new)
+    better["value"] *= 1.5
+    better["fused_northstar_s_per_gen"] *= 0.5
+    assert mod.compare(better, ref) == []
+
+
+def test_missing_rows_are_skipped_not_fatal():
+    """A crashed sub-bench drops its rows from the capture; the sentinel
+    keeps checking what's there."""
+    mod = _load()
+    new = mod.load_capture(_capture_path())
+    ref = mod.reference_row(mod.load_trajectory(_FIXTURES))
+    partial = {k: v for k, v in new.items()
+               if not k.startswith(("northstar_", "fused_northstar_"))}
+    assert mod.compare(partial, ref) == []
+    partial["value"] *= 0.5  # the primary row still guards
+    assert mod.compare(partial, ref) != []
+
+
+def test_retries_must_be_zero():
+    mod = _load()
+    new = mod.load_capture(_capture_path())
+    bad = dict(new)
+    bad["resilience_retries"] = 3
+    fails = mod.compare(bad, mod.reference_row(
+        mod.load_trajectory(_FIXTURES)))
+    assert any(k == "resilience_retries" for k, *_ in fails)
+
+
+def test_baseline_floor():
+    """Falling below the measured reference-sampler rate is always a
+    regression, trajectory or not."""
+    mod = _load()
+    new = mod.load_capture(_capture_path())
+    new["value"] = 100.0
+    fails = mod.compare(new, {}, baseline_rate=675.0)
+    assert [(k, d) for k, _, _, d in fails] == [
+        ("value", "below BASELINE_MEASURED.json floor")]
+
+
+def test_capture_parsing(tmp_path):
+    """The LAST parseable record wins (bench prints full line then
+    compact line); log noise and truncation are handled."""
+    mod = _load()
+    cap = tmp_path / "cap.txt"
+    cap.write_text(
+        "bench: primary\n"
+        + json.dumps({"value": 111.0, "extra": {"stale": True}}) + "\n"
+        + json.dumps({"value": 222.0,
+                      "extra": {"primary_evals_per_sec": 5.0}}) + "\n")
+    flat = mod.load_capture(str(cap))
+    assert flat["value"] == 222.0
+    assert flat["primary_evals_per_sec"] == 5.0
+    empty = tmp_path / "empty.txt"
+    empty.write_text("no json here\n")
+    assert mod.main([str(empty)]) == 2
+
+
+def test_median_of_three_resists_one_outlier(tmp_path):
+    """One noisy prior capture cannot move the reference: the median of
+    {fast, normal, slow-outlier} stays the normal run."""
+    mod = _load()
+    for i, v in enumerate((560000.0, 1000.0, 565000.0)):
+        (tmp_path / f"BENCH_r{i}.json").write_text(
+            json.dumps({"value": v, "extra": {}}))
+    ref = mod.reference_row(mod.load_trajectory(str(tmp_path)))
+    assert ref["value"] == 560000.0

@@ -1,0 +1,80 @@
+"""Tier-1 wrapper for tools/check_span_pairs.py: every explicit
+``spans.begin()`` in the package must assign its token and pass it to a
+``spans.end()`` in the same file — leaked begins produce open-ended
+tracks in the (fleet-merged) Chrome trace — and the lint must actually
+catch a violation when one is planted."""
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "check_span_pairs.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_span_pairs", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_tree_is_clean():
+    """Every explicit begin() in pyabc_tpu/ is paired — the invariant
+    that keeps traces closed no matter which path ends a generation."""
+    mod = _load()
+    assert mod.check() == []
+
+
+def test_detects_dropped_token(tmp_path):
+    """A bare spans.begin() call discards the only handle that can
+    close the span."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "leaky.py").write_text(
+        "spans.begin('gen.work', gen=t)\n"
+        "tok = spans.begin('gen.fetch', gen=t)\n"
+        "spans.end(tok)\n")
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [("leaky.py", 1)]
+
+
+def test_detects_unended_token(tmp_path):
+    """An assigned token that never reaches spans.end() in the file is
+    still a leak; attribute tokens match across receiver objects."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ticket.py").write_text(
+        "self._q_span = spans.begin('ingest.queued', label=label)\n"
+        "self._w_span = spans.begin('ingest.work', label=label)\n"
+        "spans.end(ticket._q_span)\n")
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [("ticket.py", 2)]
+
+
+def test_suppress_and_exemptions(tmp_path):
+    """# span-ok silences a deliberate open span; telemetry/spans.py
+    (the API definition) is exempt; `with span(...)` never matches."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "telemetry").mkdir(parents=True)
+    (pkg / "telemetry" / "spans.py").write_text(
+        "spans.begin('would-be-violation')\n")
+    (pkg / "fine.py").write_text(
+        "spans.begin('run.forever')  # span-ok\n"
+        "with span('gen.sample', gen=t):\n"
+        "    pass\n")
+    assert mod.check(root=str(pkg)) == []
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    mod = _load()
+    assert mod.main([]) == 0  # the real tree
+    assert "clean" in capsys.readouterr().out
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "leaky.py").write_text("spans.begin('gen.work')\n")
+    assert mod.main([str(pkg)]) == 1
+    assert "leaky.py:1" in capsys.readouterr().out

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: fail loudly when the hot path slows down.
+
+``bench.py`` prints a compact JSON record as its LAST stdout line
+(scalars only — see bench.py:main).  This tool compares a fresh capture
+of that line against
+
+1. the repo's measured floor (``BASELINE_MEASURED.json`` — the
+   reference-equivalent CPU sampler; dropping below it means the TPU
+   path is slower than the thing it replaced), and
+2. the recent trajectory: the median of up to the last 3 prior
+   ``BENCH_*.json`` captures in the repo root (median-of-3 so one noisy
+   run can't move the reference), with a per-row, direction-aware
+   tolerance (throughput fails LOW, seconds-per-gen fails HIGH).
+
+Rows missing from either side are skipped — sub-benches run in their
+own process and a crashed sub-bench must not mask a primary-row
+regression (its absence is reported, not fatal).  With no prior
+captures at all, only the measured floor applies.
+
+Usage::
+
+    python tools/bench_sentinel.py CAPTURE            # check a capture
+    python tools/bench_sentinel.py --check            # fixture self-test
+
+``CAPTURE`` is any file whose last parseable-JSON line is a bench
+record — a raw ``bench.py`` stdout log works as-is.  ``--check`` runs
+the sentinel against the recorded fixture capture under
+``tools/fixtures/`` and then against a synthetic 20 % regression of the
+same capture, asserting pass/fail respectively — the tier-1 wrapper
+``tests/test_bench_sentinel.py`` drives this mode.
+
+Exit codes: 0 = no regression, 1 = regression (or self-test failure),
+2 = capture unreadable.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+#: (key, direction, relative tolerance).  Direction "higher" = bigger is
+#: better (fails when new < ref*(1-tol)); "lower" = smaller is better
+#: (fails when new > ref*(1+tol)); "zero" = any nonzero value fails.
+#: Tolerances sit strictly below 20 % on the throughput rows so a 20 %
+#: regression always trips, while staying loose enough that
+#: shared-hardware scheduler jitter (single-digit %) never does.
+WATCHED = (
+    ("value", "higher", 0.15),                               # primary acc/s
+    ("primary_evals_per_sec", "higher", 0.15),
+    ("northstar_pop1e6_accepted_per_sec", "higher", 0.18),
+    ("northstar_pop1e6_wallclock_s_per_gen", "lower", 0.25),
+    ("fused_northstar_s_per_gen", "lower", 0.25),
+    ("telemetry_compile_s_per_gen", "lower", 0.50),
+    ("resilience_retries", "zero", 0.0),
+)
+
+#: seconds-per-gen rows below this are timer noise, not signal
+_SECONDS_FLOOR = 0.05
+
+#: prior captures: newest-last glob in the repo root
+_TRAJECTORY_GLOB = "BENCH_*.json"
+_N_PRIOR = 3
+
+
+def _repo_root(root=None) -> str:
+    if root is not None:
+        return root
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flatten(rec: dict) -> dict:
+    """Header scalars + the ``extra`` dict as one flat row."""
+    flat = {k: v for k, v in rec.items() if not isinstance(v, (list, dict))}
+    for k, v in (rec.get("extra") or {}).items():
+        if not isinstance(v, (list, dict)):
+            flat[k] = v
+    return flat
+
+
+def load_capture(path: str) -> dict:
+    """Last parseable JSON-object line of ``path``, flattened.
+
+    Raises ``ValueError`` when no line parses — a truncated capture
+    must fail the sentinel, not silently pass it.
+    """
+    with open(path, encoding="utf-8", errors="replace") as f:
+        lines = f.read().splitlines()
+    for line in reversed(lines):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "value" in rec:
+            return _flatten(rec)
+    raise ValueError(f"no bench record found in {path}")
+
+
+def load_trajectory(root=None) -> list:
+    """Up to the last ``_N_PRIOR`` prior captures, oldest first."""
+    root = _repo_root(root)
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, _TRAJECTORY_GLOB))):
+        try:
+            rows.append(load_capture(path))
+        except (OSError, ValueError):
+            continue  # an unreadable prior shrinks the median window
+    return rows[-_N_PRIOR:]
+
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    return (vals[n // 2] if n % 2
+            else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+
+
+def reference_row(trajectory: list) -> dict:
+    """Per-key median over the prior captures (keys present anywhere)."""
+    ref = {}
+    for key, _, _ in WATCHED:
+        vals = [r[key] for r in trajectory
+                if isinstance(r.get(key), (int, float))]
+        if vals:
+            ref[key] = _median(vals)
+    return ref
+
+
+def compare(new: dict, ref: dict, baseline_rate=None) -> list:
+    """Regressions as ``[(key, new, limit, detail), ...]`` (empty = ok)."""
+    fails = []
+    for key, direction, tol in WATCHED:
+        nv = new.get(key)
+        if not isinstance(nv, (int, float)):
+            continue  # crashed sub-bench: row absent, not a regression
+        if direction == "zero":
+            if nv > 0:
+                fails.append((key, nv, 0,
+                              "must be 0 on a healthy bench run"))
+            continue
+        rv = ref.get(key)
+        if not isinstance(rv, (int, float)):
+            continue  # no trajectory for this row yet
+        if direction == "lower":
+            if rv < _SECONDS_FLOOR:
+                continue  # sub-noise-floor timings carry no signal
+            limit = rv * (1.0 + tol)
+            if nv > limit:
+                fails.append((key, nv, round(limit, 4),
+                              f"> median-of-{_N_PRIOR} ref {rv:.4g} "
+                              f"+{tol:.0%}"))
+        else:
+            limit = rv * (1.0 - tol)
+            if nv < limit:
+                fails.append((key, nv, round(limit, 4),
+                              f"< median-of-{_N_PRIOR} ref {rv:.4g} "
+                              f"-{tol:.0%}"))
+    # absolute floor: the TPU path must never be slower than the
+    # reference CPU sampler it replaced
+    if baseline_rate and isinstance(new.get("value"), (int, float)):
+        if new["value"] < baseline_rate:
+            fails.append(("value", new["value"], baseline_rate,
+                          "below BASELINE_MEASURED.json floor"))
+    return fails
+
+
+def baseline_rate(root=None):
+    path = os.path.join(_repo_root(root), "BASELINE_MEASURED.json")
+    try:
+        with open(path) as f:
+            return float(json.load(f)["accepted_particles_per_sec"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def run(capture_path: str, root=None) -> int:
+    try:
+        new = load_capture(capture_path)
+    except (OSError, ValueError) as err:
+        print(f"bench sentinel: cannot read capture: {err}")
+        return 2
+    trajectory = load_trajectory(root)
+    ref = reference_row(trajectory)
+    fails = compare(new, ref, baseline_rate(root))
+    watched_present = sum(
+        1 for key, _, _ in WATCHED
+        if isinstance(new.get(key), (int, float)))
+    if fails:
+        print(f"bench sentinel: {len(fails)} REGRESSION(S) "
+              f"(vs {len(trajectory)} prior capture(s)):")
+        for key, nv, limit, detail in fails:
+            print(f"  {key}: {nv} {detail} (limit {limit})")
+        return 1
+    print(f"bench sentinel: ok — {watched_present} watched row(s), "
+          f"{len(trajectory)} prior capture(s), no regression")
+    return 0
+
+
+def _self_test() -> int:
+    """Fixture round-trip: the recorded capture must pass against the
+    fixture trajectory; a synthetic 20 % regression of it must fail."""
+    fixtures = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "fixtures")
+    capture = os.path.join(fixtures, "bench_capture_ok.txt")
+    new = load_capture(capture)
+    trajectory = load_trajectory(fixtures)
+    if not trajectory:
+        print("bench sentinel --check: no fixture trajectory")
+        return 1
+    ref = reference_row(trajectory)
+    ok_fails = compare(new, ref, baseline_rate())
+    if ok_fails:
+        print(f"bench sentinel --check: fixture capture should pass, "
+              f"got {ok_fails}")
+        return 1
+    # synthetic regression: throughput -20 %, seconds +25 %
+    bad = dict(new)
+    for key, direction, _ in WATCHED:
+        if not isinstance(bad.get(key), (int, float)):
+            continue
+        if direction == "higher":
+            bad[key] = bad[key] * 0.80
+        elif direction == "lower":
+            bad[key] = bad[key] * 1.30
+    bad_fails = compare(bad, ref, baseline_rate())
+    if not bad_fails:
+        print("bench sentinel --check: synthetic 20% regression "
+              "was NOT caught")
+        return 1
+    print(f"bench sentinel --check: ok (fixture passes, synthetic "
+          f"regression caught on {len(bad_fails)} row(s))")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "--check":
+        return _self_test()
+    if not argv:
+        print(__doc__.strip().splitlines()[0])
+        print("usage: bench_sentinel.py CAPTURE | --check")
+        return 2
+    root = argv[1] if len(argv) > 1 else None
+    return run(argv[0], root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
