@@ -233,6 +233,13 @@ def bench_primary():
     return rate, times, evals_ps, transfer, telemetry
 
 
+def _egress_mb():
+    """Cumulative per-process d2h attribution (wire/transfer.py) in MB;
+    diff two snapshots to bill one run inside a multi-run sub-bench."""
+    from pyabc_tpu.wire import transfer as _wt
+    return {k: v / 1e6 for k, v in _wt.egress_breakdown().items()}
+
+
 def bench_northstar():
     """Config #2 at 1e6 particles/generation (BASELINE.md north star)."""
     import pyabc_tpu as pt
@@ -268,10 +275,15 @@ def bench_northstar():
     # IS the overlap-default north star
     rate, s_per_gen, times, evals_ps, transfer = _timed_generations(
         abc, NORTHSTAR_POP, 3, TIMED_GENERATIONS)
+    eg = _egress_mb()
     out = {"northstar_pop1e6_accepted_per_sec": round(rate, 1),
            "northstar_pop1e6_wallclock_s_per_gen": round(s_per_gen, 2),
            "northstar_pop1e6_gen_times_s": times,
            "northstar_pop1e6_evals_per_sec": round(evals_ps, 1),
+           "northstar_pop1e6_history_mode": abc.history_mode,
+           **{f"northstar_pop1e6_egress_{k}_mb": round(v, 3)
+              for k, v in eg.items() if k in ("population", "history",
+                                              "summary")},
            **{f"northstar_pop1e6_{k}": v for k, v in transfer.items()}}
     # sequential-ingest control row in the SAME capture: the overlap win
     # (transfer_s_per_gen ratio) must be visible within one JSON line,
@@ -287,14 +299,24 @@ def bench_northstar():
                                          max_rounds_per_call=16),
             stores_sum_stats=False,
             ingest_mode="sequential",
+            # eager control: the pre-store dataflow (full population
+            # d2h every generation) in the SAME capture, so the lazy
+            # row's population-egress drop is a within-line ratio, not
+            # a cross-capture diff
+            history_mode="eager",
             seed=0)
         abc_seq.new("sqlite://", observed)
         s_rate, s_spg, s_times, s_evals, s_tr = _timed_generations(
             abc_seq, NORTHSTAR_POP, 2, 3)
+        eg_seq = {k: v - eg.get(k, 0.0) for k, v in _egress_mb().items()}
         out.update({
             "northstar_seq_pop1e6_accepted_per_sec": round(s_rate, 1),
             "northstar_seq_pop1e6_wallclock_s_per_gen": round(s_spg, 2),
             "northstar_seq_pop1e6_gen_times_s": s_times,
+            "northstar_seq_pop1e6_history_mode": abc_seq.history_mode,
+            **{f"northstar_seq_pop1e6_egress_{k}_mb": round(v, 3)
+               for k, v in eg_seq.items() if k in ("population",
+                                                   "history", "summary")},
             **{f"northstar_seq_pop1e6_{k}": v for k, v in s_tr.items()}})
     except Exception as err:  # never lose the overlapped row
         out["northstar_seq_pop1e6_error"] = (
@@ -346,9 +368,11 @@ def bench_fused_northstar():
     # the fused program's compile; block 2 is the steady sample)
     abc_f = build(K)
     abc_f._note_sequential_gen_s(seq_spg)
+    eg0 = _egress_mb()
     cc0 = compile_counters()
     abc_f.run(max_nr_populations=1 + 2 * K)
     cc = compile_delta(cc0)
+    eg_f = {k: v - eg0.get(k, 0.0) for k, v in _egress_mb().items()}
     fused_ts = sorted(r["gen"] for r in abc_f.timeline.to_rows()
                       if r["path"] == "fused")
     steady = [abc_f.generation_wall_clock[t] for t in fused_ts if t > K]
@@ -369,6 +393,10 @@ def bench_fused_northstar():
         "seq_northstar_s_per_gen": round(seq_spg, 2),
         "fused_northstar_engine_decision": decision,
         "fused_northstar_fuse_generations": K,
+        "fused_northstar_history_mode": abc_f.history_mode,
+        **{f"fused_northstar_egress_{k}_mb": round(v, 3)
+           for k, v in eg_f.items() if k in ("population", "history",
+                                             "summary")},
         "fused_northstar_gen_times_s": [
             round(abc_f.generation_wall_clock[t], 2) for t in fused_ts],
         "seq_northstar_gen_times_s": seq_times,

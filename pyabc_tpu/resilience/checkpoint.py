@@ -156,10 +156,25 @@ class GenCheckpointer:
         self._base_batch: Optional[dict] = None
         self._base_evals = 0
         self.flushes = 0
+        #: lazy-History mode: a callable returning the DeviceRunStore
+        #: manifest.  When set, steady-state cadence flushes write a
+        #: manifest-only ledger row (no finalize dispatch, no raw d2h);
+        #: the raw batch ships only when :meth:`raw_required` — an
+        #: actual preemption/stop, or a resume splice base that must
+        #: stay durable.
+        self.manifest_source = None
 
     def set_base(self, batch: dict, nr_evaluations: int):
         self._base_batch = batch
         self._base_evals = int(nr_evaluations)
+
+    def raw_required(self) -> bool:
+        """Whether the NEXT flush must ship the raw accepted batch even
+        in manifest mode: a preemption or stop is in progress (this is
+        the 'actual preemption' the ledger exists for), or the ledger
+        carries resume-splice base rows that only exist host-side."""
+        return (preempt_requested() or _local_stop_requested()
+                or self._base_batch is not None)
 
     def should_flush(self, rounds: int) -> bool:
         if rounds - self._last_flush_rounds >= self.every_rounds:
@@ -193,11 +208,49 @@ class GenCheckpointer:
             "sub-checkpoint t=%d: %d accepted rows through round %d "
             "(%.3gs)", self.t, int(batch["m"].shape[0]), rounds, dt)
 
+    def flush_manifest(self, rounds: int, nr_evaluations: int):
+        """Manifest-only ledger heartbeat (lazy-History steady state):
+        records progress + the device-store manifest with ZERO raw
+        bytes.  A resumed run cannot splice from it (nothing host-side
+        existed), but at most one flush interval is lost on a hard kill
+        — same bound as the raw ledger — while the common case (no
+        preemption) never pays the finalize fetch."""
+        t0 = time.perf_counter()
+        manifest = None
+        if self.manifest_source is not None:
+            try:
+                manifest = self.manifest_source()
+            except Exception:
+                logger.exception("store manifest snapshot failed; "
+                                 "writing a bare heartbeat row")
+        self.history.save_sub_checkpoint(
+            self.t, None, rounds=rounds,
+            nr_evaluations=int(nr_evaluations), eps=self.eps,
+            manifest=manifest)
+        self._last_flush_rounds = rounds
+        self.flushes += 1
+        dt = time.perf_counter() - t0
+        _counter("resilience_checkpoints_total").inc()
+        _counter("resilience_checkpoint_seconds_total").inc(dt)
+        logger.info(
+            "sub-checkpoint t=%d: manifest-only through round %d "
+            "(%.3gs)", self.t, rounds, dt)
+
     def maybe_raise_preempted(self):
         """After a flush: if a preemption signal arrived, stop NOW —
         the ledger is durable, finishing the generation would race the
         platform's kill timeout."""
         if preempt_requested():
+            # lazy-History runs: previous generations may still be
+            # device-resident summary rows — anchor them (newest first)
+            # before the process exits, or the resume purges them
+            persist = getattr(self.history, "persist_lazy_tail", None)
+            if persist is not None:
+                try:
+                    persist()
+                except Exception:
+                    logger.exception("lazy-tail persist on preemption "
+                                     "failed; resume will regenerate")
             raise Preempted(
                 f"preemption signal during generation {self.t}; "
                 f"sub-checkpoint flushed through round "

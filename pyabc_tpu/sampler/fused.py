@@ -238,7 +238,8 @@ def build_fused_generations(
         support_cap: Optional[int] = None,
         rate_pred_factor: float = 1.0,
         adaptive_cfg: Optional[dict] = None,
-        stoch_cfg: Optional[dict] = None):
+        stoch_cfg: Optional[dict] = None,
+        summary_lanes: bool = False):
     """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
     for K generations.  ``carry`` = the previous generation's accepted
     population on device: dict(m[i32 n], theta[f32 n,d], log_weight
@@ -282,6 +283,7 @@ def build_fused_generations(
     (``Temperature._update``'s final-generation rule).
     """
     from ..autotune.tuner import EWMA_ALPHA
+    from ..wire.store import summary_wire_lanes as _summary_wire_lanes
     from .device_loop import narrow_wire
 
     M = kernel.M
@@ -558,6 +560,12 @@ def build_fused_generations(
         wire["count"] = count1
         wire["rounds"] = rounds1
         wire["eps"] = eps_t
+        if summary_lanes:
+            # O(KB) posterior summary riding the same wire: the lazy-
+            # History ingest fetches ONLY these + the scalars and leaves
+            # the population lanes device-resident (wire/store.py)
+            wire.update(_summary_wire_lanes(
+                m1, theta1, dist1, lw1, valid1, M))
         return new_carry, wire
 
     def fused(carry, key, final_mask=None):

@@ -460,15 +460,23 @@ class VectorizedSampler(Sampler):
             ck = self.checkpointer
             if ck is not None and count < n:
                 if ck.should_flush(rounds):
-                    # flush the CUMULATIVE accepted ledger: finalize is
-                    # not buffer-donating, so a mid-loop call leaves the
-                    # carry intact for the rounds that follow
-                    wire_ck, _ = self._dispatch(finalize, state, params)
-                    with _egress("checkpoint"):
-                        out_ck = fetch_to_host(wire_ck)
-                    take = min(count, out_ck["theta"].shape[0])
-                    ck.flush(widen_wire(out_ck, take), rounds=rounds,
-                             nr_evaluations=rounds * B)
+                    if (ck.manifest_source is not None
+                            and not ck.raw_required()):
+                        # lazy-History steady state: a manifest-only
+                        # heartbeat — no finalize dispatch, no raw d2h
+                        ck.flush_manifest(rounds=rounds,
+                                          nr_evaluations=rounds * B)
+                    else:
+                        # flush the CUMULATIVE accepted ledger: finalize
+                        # is not buffer-donating, so a mid-loop call
+                        # leaves the carry intact for rounds that follow
+                        wire_ck, _ = self._dispatch(finalize, state,
+                                                    params)
+                        with _egress("checkpoint"):
+                            out_ck = fetch_to_host(wire_ck)
+                        take = min(count, out_ck["theta"].shape[0])
+                        ck.flush(widen_wire(out_ck, take), rounds=rounds,
+                                 nr_evaluations=rounds * B)
                 # the ledger is durable: a preemption signal now exits
                 # cleanly (Preempted) instead of racing the kill timeout
                 ck.maybe_raise_preempted()

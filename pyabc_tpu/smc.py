@@ -16,6 +16,7 @@ generations and across alive/dead model sets.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -46,6 +47,7 @@ from .telemetry import GenerationTimeline, aggregate as _aggregate, \
     spans as _spans
 from .transition import MultivariateNormalTransition, Transition
 from .weighted_statistics import effective_sample_size
+from .wire import store as _wire_store
 
 logger = logging.getLogger("ABC")
 
@@ -114,6 +116,7 @@ class ABCSMC:
                  trace_path: Optional[str] = None,
                  compile_cache: Optional[str] = None,
                  checkpoint_every_rounds: Optional[int] = None,
+                 history_mode: Optional[str] = None,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -191,6 +194,23 @@ class ABCSMC:
         #: this many generation blocks in flight, so host memory stays
         #: O(depth x pop); 0 runs the same pipeline synchronously inline
         self.ingest_depth = int(ingest_depth)
+        if history_mode is None:
+            history_mode = os.environ.get(
+                _wire_store.HISTORY_MODE_ENV, "lazy")
+        if history_mode not in ("lazy", "eager"):
+            raise ValueError(
+                "history_mode must be 'lazy' or 'eager' "
+                f"(got {history_mode!r})")
+        #: population-egress discipline (wire/store.py tentpole):
+        #: "lazy" parks each accepted generation's wire in a device-
+        #: resident ring and appends an O(KB) posterior summary row,
+        #: hydrating full populations on demand under
+        #: ``egress("history")``; "eager" keeps the fetch-everything-
+        #: per-generation path byte-identically.  None defers to
+        #: ``$PYABC_TPU_HISTORY_MODE`` (default lazy).
+        self.history_mode = history_mode
+        #: the bound run's DeviceRunStore (lazy mode; built in _bind())
+        self._store: Optional[_wire_store.DeviceRunStore] = None
         self.key = jax.random.PRNGKey(seed)
         #: per-generation wall-clock seconds, keyed by t — measured
         #: append-to-append like the DB-timestamp diffs, but available
@@ -206,6 +226,7 @@ class ABCSMC:
         #: per-generation stage-duration rows (telemetry/timeline.py),
         #: fed by every run path at generation boundaries
         self.timeline = GenerationTimeline()
+        self.timeline.history_mode = self.history_mode
         #: fleet telemetry publisher (telemetry/aggregate.py), created
         #: at run start when PYABC_TPU_RUN_DIR is advertised; None keeps
         #: the per-generation cost to one attribute check
@@ -310,6 +331,10 @@ class ABCSMC:
                                stores_sum_stats=self.stores_sum_stats)
         self.x_0 = self._coerce_stats(self.history.observed_sum_stat())
         self._bind()
+        # summary-only rows from a previous process lost their device
+        # arrays with it: drop them so max_t anchors on durable blobs
+        # and the resumed loop regenerates from there
+        self.history.purge_stale_lazy()
         return self.history
 
     def _bind(self):
@@ -337,6 +362,20 @@ class ABCSMC:
             dim=self.dim,
             nr_samples_per_parameter=getattr(
                 self.population_strategy, "nr_samples_per_parameter", 1))
+        # lazy-History egress: one device-resident store per bound run;
+        # the History drains the store's spill queue on ITS (sqlite
+        # writer) thread, deposits come from ingest workers
+        if self.history is not None and self.history_mode == "lazy":
+            self._store = _wire_store.DeviceRunStore()
+            self.history.attach_store(self._store)
+        else:
+            self._store = None
+
+    @property
+    def _lazy_active(self) -> bool:
+        """Lazy-History egress is armed for the bound run (wire/store.py
+        tentpole): populations stay device-resident, summaries ship."""
+        return self._store is not None and self.history is not None
 
     # ------------------------------------------------------------------
     # transition fitting with fixed-shape padding
@@ -775,11 +814,44 @@ class ABCSMC:
                 np.ceil(n / (self.min_acceptance_rate * B)), 1, 16))
         return 16
 
-    def _get_block_fn(self, t: int, n: int, B: int, K: int):
+    def _lazy_gen_fetch(self, t0: int, n: int):
+        """Build a ``GenStream`` fetch for lazy-History blocks: deposit
+        the full per-generation wire slice into the DeviceRunStore and
+        ship only the ``sm_*`` summary lanes + scalars d2h — O(KB)
+        instead of the full population (wire/store.py tentpole).  Runs
+        on the ingest worker thread, so the egress label is set INSIDE
+        the callable (the ledger reads the calling thread's label)."""
+        from .sampler.base import fetch_to_host
+        from .wire import transfer as _transfer
+
+        store = self._store
+
+        def fetch(k, gen_wire, n_rows):
+            small = {key: gen_wire[key]
+                     for key in _wire_store.SUMMARY_LANE_KEYS
+                     if key in gen_wire}
+            for key in ("count", "rounds", "eps"):
+                if key in gen_wire:
+                    small[key] = gen_wire[key]
+            with _transfer.egress("summary"):
+                out = fetch_to_host(small)
+            count = int(np.asarray(out["count"]))
+            rounds = int(np.asarray(out["rounds"]))
+            eps = (float(np.asarray(out["eps"], dtype=np.float64))
+                   if "eps" in out else None)
+            store.deposit(t0 + k, gen_wire, n=n_rows, count=count,
+                          eps=eps, norm="stream")
+            return _wire_store.summary_from_lanes(out), count, rounds, eps
+
+        return fetch
+
+    def _get_block_fn(self, t: int, n: int, B: int, K: int,
+                      summary: bool = False):
         """Build (or serve cached) the jitted K-generation device block
         for the current configuration — shared by ``_run_fused_block``
         and the overlapped pipeline (which uses K=1 blocks at
-        transfer-bound sizes)."""
+        transfer-bound sizes).  ``summary`` adds the in-scan ``sm_*``
+        posterior-summary wire lanes (lazy-History mode)."""
         from .sampler.fused import build_fused_generations
         samp = self.sampler
         d, s_width = self.dim, self.spec.total_size
@@ -805,7 +877,7 @@ class ABCSMC:
                      n, K, d, s_width, eps_mode, alpha, mult, weighted,
                      wire_stats, wire_m_bits, max_rounds, sup_cap,
                      mode["adaptive"], mode["stoch"], record_rows,
-                     pdf_norm)
+                     pdf_norm, bool(summary))
 
         def build():
             from .distance.kernel import SCALE_LIN
@@ -859,7 +931,8 @@ class ABCSMC:
                 # the carried EWMA rate over-predicts by ~alpha
                 rate_pred_factor=(alpha if eps_mode == "quantile"
                                   else 1.0),
-                adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg))
+                adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
+                summary_lanes=bool(summary)))
 
         # block programs live in the sampler's CompiledLadder (one
         # bounded LRU for every per-generation executable; stale-owner
@@ -907,7 +980,8 @@ class ABCSMC:
             samp._tuner.safety(samp.safety_factor))
         if carry_in is None:
             return 0, 0, None  # seed can't reproduce the chain state
-        fn = self._get_block_fn(t, n, B, K)
+        lazy = self._lazy_active
+        fn = self._get_block_fn(t, n, B, K, summary=lazy)
 
         t0_block = _time.perf_counter()
         tr0_block = _transfer.snapshot()
@@ -935,7 +1009,9 @@ class ABCSMC:
         # appended here — a fused block overlaps its fetch with its own
         # ingest instead of the old single K-generation transaction
         engine = StreamingIngest(depth=self.ingest_depth)
-        stream = GenStream(engine, wires, K, n, label=f"fused@t={t}")
+        stream = GenStream(engine, wires, K, n, label=f"fused@t={t}",
+                           fetch=(self._lazy_gen_fetch(t, n)
+                                  if lazy else None))
         written = 0
         stop_reason = None
         append_s_total = 0.0
@@ -948,7 +1024,8 @@ class ABCSMC:
                 if t_k >= t_max:
                     break
                 with _spans.span("fused.ingest", gen=t_k):
-                    batch_k, count_k, rounds_k, eps_raw = stream.result()
+                    payload_k, count_k, rounds_k, eps_raw = \
+                        stream.result()
                 rounds_seen += rounds_k
                 if count_k < n:
                     logger.info(
@@ -957,12 +1034,30 @@ class ABCSMC:
                         t_k, count_k, n)
                     break
                 evals_k = rounds_k * B
-                pop_k = batch_to_population(batch_k)
-                if pop_k is None:
-                    logger.warning(
-                        "fused block produced degenerate weights "
-                        "at t=%d: sequential fallback", t_k)
-                    break
+                summary_k = None
+                if lazy:
+                    # the O(KB) summary packet — the full wire stayed on
+                    # device (DeviceRunStore deposit by the fetch)
+                    summary_k = payload_k
+                    pop_k = None
+                    ess_k = float(summary_k["ess"])
+                    alive_k = sum(1 for x in summary_k["model_w"]
+                                  if x > 0)
+                    if not (np.isfinite(ess_k) and ess_k > 0):
+                        logger.warning(
+                            "fused block produced degenerate weights "
+                            "at t=%d: sequential fallback", t_k)
+                        self._store.drop(t_k)
+                        break
+                else:
+                    pop_k = batch_to_population(payload_k)
+                    if pop_k is None:
+                        logger.warning(
+                            "fused block produced degenerate weights "
+                            "at t=%d: sequential fallback", t_k)
+                        break
+                    ess_k = float(effective_sample_size(pop_k.weight))
+                    alive_k = pop_k.nr_of_models_alive()
                 # constant mode: take the HOST value — the f32 device
                 # round-trip of eps would defeat `eps <= minimum_epsilon`
                 eps_k = (float(self.eps(t_k)) if eps_mode == "constant"
@@ -971,11 +1066,18 @@ class ABCSMC:
                 logger.info("t: %d, eps: %.8g (fused)", t_k, eps_k)
                 append_mark = _time.perf_counter()
                 with _spans.span("gen.append", gen=t_k):
-                    self.history.append_population(
-                        t_k, eps_k, pop_k, evals_k,
-                        [m.name for m in self.models],
-                        self._param_names(),
-                        stat_spec=self.spec.shapes)
+                    if lazy:
+                        self.history.append_population_lazy(
+                            t_k, eps_k, evals_k, summary=summary_k,
+                            model_names=[m.name for m in self.models],
+                            param_names=self._param_names(),
+                            stat_spec=self.spec.shapes)
+                    else:
+                        self.history.append_population(
+                            t_k, eps_k, pop_k, evals_k,
+                            [m.name for m in self.models],
+                            self._param_names(),
+                            stat_spec=self.spec.shapes)
                 append_s_total += _time.perf_counter() - append_mark
                 gen_meta.append((eps_k, count_k, evals_k, rounds_k))
                 # host schedule bookkeeping: the device-decided eps/T is
@@ -986,8 +1088,7 @@ class ABCSMC:
                     self.eps.temperatures[t_k] = eps_k
                 logger.info(
                     "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
-                    t_k, acc_rate,
-                    float(effective_sample_size(pop_k.weight)), evals_k)
+                    t_k, acc_rate, ess_k, evals_k)
                 written += 1
                 # stopping criteria, sequential order (run loop below)
                 if eps_mode == "temperature":
@@ -997,7 +1098,7 @@ class ABCSMC:
                     stop_reason = "Stopping: minimum epsilon reached"
                 if stop_reason is None:
                     if (self.stop_if_only_single_model_alive
-                            and pop_k.nr_of_models_alive() <= 1
+                            and alive_k <= 1
                             and self.M > 1):
                         stop_reason = "Stopping: single model alive"
                     elif acc_rate < self.min_acceptance_rate:
@@ -1018,6 +1119,10 @@ class ABCSMC:
             engine.close()
         sims_added = rounds_seen * B
         samp.nr_evaluations_ += sims_added
+        if lazy:
+            # undershoot/stop tails deposited wires for generations that
+            # were never written — no durable row exists, drop them
+            self._store.drop_from(t + written)
 
         if written:
             block_dt = _time.perf_counter() - t0_block
@@ -1063,6 +1168,13 @@ class ABCSMC:
                 self._fleet.publish(self.timeline)
             last_pop = pop_k
             if stop_reason is None and t + written < t_max:
+                if lazy and last_pop is None:
+                    # hydrate ONLY the block's last written generation —
+                    # the host-side continuation (KDE fit, eps schedule)
+                    # needs real rows; earlier generations of the block
+                    # stay device-resident (1/K of the old egress)
+                    last_pop = self.history.hydrate_population(
+                        t + written - 1)
                 # keep the chain hot: device carry for the next block
                 # (only valid when the block completed all K gens), and
                 # host-side component state for a sequential continuation
@@ -1132,6 +1244,7 @@ class ABCSMC:
         samp = self.sampler
         mode = self._block_mode()
         eps_mode = self._eps_device_config()[0]
+        lazy = self._lazy_active
         ingest = StreamingIngest(depth=self.ingest_depth)
         inflight = deque()
         st = {
@@ -1177,6 +1290,11 @@ class ABCSMC:
                 _transfer.record_rewind(abandoned)
             st["carry"] = None
             st["t_disp"] = st["t"]
+            if lazy:
+                # speculative deposits past the frontier are invalid;
+                # a re-run re-deposits (same-t replace), so the late
+                # completion of an abandoned fetch is benign
+                self._store.drop_from(st["t"])
 
         def dispatch_block() -> bool:
             carry, t_d = st["carry"], st["t_disp"]
@@ -1201,7 +1319,7 @@ class ABCSMC:
                 # (e.g. nothing prepared for t_d): sequential rebuild
                 st["carry"] = None
                 return False
-            fn = self._get_block_fn(t_d, n, B, K)
+            fn = self._get_block_fn(t_d, n, B, K, summary=lazy)
             args = (carry_in, self._split())
             if mode["stoch"]:
                 args += (self._final_mask(t_d, K),)
@@ -1217,9 +1335,11 @@ class ABCSMC:
                 # engine's depth backpressure (never holds more than one
                 # slot), and gen k+1's fetch drains while k is appended
                 stream = GenStream(ingest, wires, K, n,
-                                   label=f"block@t={t_d}")
+                                   label=f"block@t={t_d}",
+                                   fetch=(self._lazy_gen_fetch(t_d, n)
+                                          if lazy else None))
             inflight.append({"kind": "block", "ticket": None,
-                             "stream": stream,
+                             "stream": stream, "lazy": lazy,
                              "t0": t_d, "K": K, "B": B, "n": n,
                              "carry_out": carry_out,
                              "dispatch_s": (_time.perf_counter()
@@ -1241,6 +1361,11 @@ class ABCSMC:
                 # exactly like the fused path's continuation
                 prep = Sample()
                 prep.device_population = st["last_dp"]
+                if lazy and st["last_pop"] is None:
+                    # lazy blocks appended summary rows only — bring the
+                    # previous generation's rows back for the host fit
+                    st["last_pop"] = self.history.hydrate_population(
+                        t - 1)
                 self._prepare_next_iteration(
                     t, prep, st["last_pop"], samp._rate_est)
                 st["prepared_t"] = t
@@ -1324,7 +1449,7 @@ class ABCSMC:
                         # drains on the worker while k is appended here
                         with _spans.span("pipeline.harvest", gen=t_k,
                                          k=K):
-                            batch_k, count_k, rounds_k, eps_raw = \
+                            payload_k, count_k, rounds_k, eps_raw = \
                                 stream.result()
                         rounds_seen += rounds_k
                     elif blk["kind"] == "seq":
@@ -1338,18 +1463,38 @@ class ABCSMC:
                             count_k, n)
                         fallback = True
                         break
+                    summary_k = None
                     if blk["kind"] == "pop":
                         pop_k = blk["pop"]
                     elif blk["kind"] == "seq":
                         pop_k = batch_to_population(gens[k])
+                    elif blk.get("lazy"):
+                        # O(KB) summary packet; the wire stayed on
+                        # device (DeviceRunStore deposit by the fetch)
+                        summary_k = payload_k
+                        pop_k = None
                     else:
-                        pop_k = batch_to_population(batch_k)
-                    if pop_k is None:
+                        pop_k = batch_to_population(payload_k)
+                    if summary_k is not None:
+                        ess_k = float(summary_k["ess"])
+                        alive_k = sum(1 for x in summary_k["model_w"]
+                                      if x > 0)
+                        if not (np.isfinite(ess_k) and ess_k > 0):
+                            logger.warning(
+                                "pipelined block produced degenerate "
+                                "weights at t=%d: sequential fallback",
+                                t_k)
+                            fallback = True
+                            break
+                    elif pop_k is None:
                         logger.warning(
                             "pipelined block produced degenerate weights "
                             "at t=%d: sequential fallback", t_k)
                         fallback = True
                         break
+                    else:
+                        ess_k = float(effective_sample_size(pop_k.weight))
+                        alive_k = pop_k.nr_of_models_alive()
                     if blk["kind"] == "block":
                         evals_k = rounds_k * blk["B"]
                         eps_k = (float(self.eps(t_k))
@@ -1368,19 +1513,25 @@ class ABCSMC:
                         acc_rate = blk["acc_rate"]
                     append_mark = _time.perf_counter()
                     with _spans.span("gen.append", gen=t_k):
-                        self.history.append_population(
-                            t_k, eps_k, pop_k, evals_k,
-                            [m.name for m in self.models],
-                            self._param_names(),
-                            stat_spec=self.spec.shapes)
+                        if summary_k is not None:
+                            self.history.append_population_lazy(
+                                t_k, eps_k, evals_k, summary=summary_k,
+                                model_names=[m.name
+                                             for m in self.models],
+                                param_names=self._param_names(),
+                                stat_spec=self.spec.shapes)
+                        else:
+                            self.history.append_population(
+                                t_k, eps_k, pop_k, evals_k,
+                                [m.name for m in self.models],
+                                self._param_names(),
+                                stat_spec=self.spec.shapes)
                     append_s_total += _time.perf_counter() - append_mark
                     gen_meta.append((eps_k, count_k, evals_k, rounds_k))
                     logger.info(
                         "t: %d, acceptance rate: %.4g, ESS: %.4g, "
                         "evals: %d",
-                        t_k, acc_rate,
-                        float(effective_sample_size(pop_k.weight)),
-                        evals_k)
+                        t_k, acc_rate, ess_k, evals_k)
                     written += 1
                     st["t"] = t_k + 1
                     st["last_pop"] = pop_k
@@ -1396,7 +1547,7 @@ class ABCSMC:
                         st["stop"] = "Stopping: minimum epsilon reached"
                     if not st["stop"]:
                         if (self.stop_if_only_single_model_alive
-                                and pop_k.nr_of_models_alive() <= 1
+                                and alive_k <= 1
                                 and self.M > 1):
                             st["stop"] = "Stopping: single model alive"
                         elif acc_rate < self.min_acceptance_rate:
@@ -1712,6 +1863,15 @@ class ABCSMC:
             _flight.RECORDER.dump(reason=type(err).__name__)
             raise
         finally:
+            if self._lazy_active:
+                # error-unwind safety net: anchor device-resident
+                # summary rows newest-first (no-op after a clean done(),
+                # which already flushed the store)
+                try:
+                    self.history.persist_lazy_tail()
+                except Exception:
+                    logger.exception(
+                        "lazy-tail persist at run exit failed")
             _spans.TRACER.flush()
             if self._fleet is not None:
                 self._fleet.publish(self.timeline, force=True)
@@ -1869,13 +2029,21 @@ class ABCSMC:
                                            eps=current_eps)
                 if splice:
                     ck.set_base(splice["batch"], splice["nr_evaluations"])
+                if self._lazy_active:
+                    # steady-state cadence flushes become manifest-only
+                    # heartbeat rows (zero raw d2h); the raw ledger
+                    # ships only on an actual preemption/stop or a
+                    # splice base (GenCheckpointer.raw_required)
+                    ck.manifest_source = self._store.manifest
                 self.sampler.checkpointer = ck
             try:
                 with profile_generation(t), \
                         _spans.span("gen.sample", gen=t):
                     if n_req > 0:
                         sample = self._sample_generation(
-                            n_req, round_fn, params, max_eval)
+                            n_req, round_fn, params, max_eval,
+                            defer=(self._lazy_active
+                                   and not self._distance_is_adaptive()))
                     else:
                         sample = Sample()  # the splice already covers n
             finally:
@@ -1893,20 +2061,59 @@ class ABCSMC:
                     "Stopping: acceptance rate fell below min_acceptance_rate"
                     " (%d/%d accepted)", sample.n_accepted, n)
                 break
-            population = sample.get_accepted_population(n)
+            # lazy-History gate (wire/store.py tentpole): the deferred
+            # wire must still be device-resident, with no host-side rows
+            # (splice/record paths resolved it already) and an
+            # addressable device view for the O(KB) summary dispatch
+            lazy_gen = (self._lazy_active and splice is None
+                        and sample.pending_wire is not None
+                        and not sample._acc
+                        and sample.device_population is not None)
+            summary_t = None
+            if lazy_gen:
+                # park the wire (device stays the system of record) and
+                # summarize on device — the only steady-state egress of
+                # this generation's population is the summary packet
+                self._store.deposit(
+                    t, sample.take_pending_wire(), n=n,
+                    count=sample._pending_count, eps=current_eps,
+                    norm="sample")
+                summary_t = _wire_store.summarize_device_population(
+                    sample.device_population, self.M)
+            else:
+                population = sample.get_accepted_population(n)
             total_sims += sample.nr_evaluations
             # ALL acceptances (incl. over-provisioned beyond n) so the
             # rate is unbiased by the batch ladder's rounding
             acceptance_rate = sample.acceptance_rate
-            ess = float(effective_sample_size(population.weight))
             append_mark = _time.perf_counter()
             with _spans.span("gen.append", gen=t):
-                self.history.append_population(
-                    t, current_eps, population, sample.nr_evaluations,
-                    [m.name for m in self.models], self._param_names(),
-                    stat_spec=self.spec.shapes)
+                if lazy_gen:
+                    self.history.append_population_lazy(
+                        t, current_eps, sample.nr_evaluations,
+                        summary=summary_t,
+                        model_names=[m.name for m in self.models],
+                        param_names=self._param_names(),
+                        stat_spec=self.spec.shapes,
+                        summary_grid=_wire_store.maybe_summary_grid(
+                            sample.device_population))
+                else:
+                    self.history.append_population(
+                        t, current_eps, population,
+                        sample.nr_evaluations,
+                        [m.name for m in self.models],
+                        self._param_names(),
+                        stat_spec=self.spec.shapes)
+            append_s = _time.perf_counter() - append_mark
+            if lazy_gen:
+                # the host adaptation (KDE fit, eps update) still needs
+                # real rows: hydrate through the store — bit-identical
+                # to the eager decode, booked under egress("history"),
+                # with the durable blobs written as a side effect
+                with _spans.span("gen.hydrate", gen=t):
+                    population = self.history.hydrate_population(t)
+            ess = float(effective_sample_size(population.weight))
             now = _time.perf_counter()
-            append_s = now - append_mark
             self.generation_wall_clock[t] = now - gen_mark
             gen_mark = now
             tr_t = _transfer.delta(tr_mark)
@@ -1993,7 +2200,7 @@ class ABCSMC:
     _MAX_GEN_RESTARTS = 2
 
     def _sample_generation(self, n_req: int, round_fn, params,
-                           max_eval) -> Sample:
+                           max_eval, defer: bool = False) -> Sample:
         """One generation's sampling with graceful degradation: a
         retry-exhausted device dispatch drops the sampler one batch
         rung (``degrade_rung``) and restarts the generation on a fresh
@@ -2008,7 +2215,7 @@ class ABCSMC:
             try:
                 return self.sampler.sample_until_n_accepted(
                     n_req, round_fn, self._split(), params,
-                    max_eval=max_eval)
+                    max_eval=max_eval, defer_wire_fetch=defer)
             except _retry.RetryExhausted as err:
                 degrade = getattr(self.sampler, "degrade_rung", None)
                 if degrade is None or restarts >= self._MAX_GEN_RESTARTS:

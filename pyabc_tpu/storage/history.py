@@ -63,6 +63,9 @@ CREATE TABLE IF NOT EXISTS populations (
     epsilon REAL,
     nr_samples INTEGER,
     population_end_time TEXT,
+    lazy INTEGER DEFAULT 0,
+    summary TEXT,
+    summary_grid BLOB,
     PRIMARY KEY (abc_smc_id, t)
 );
 CREATE TABLE IF NOT EXISTS model_populations (
@@ -100,18 +103,34 @@ CREATE TABLE IF NOT EXISTS sub_checkpoints (
     log_weight BLOB,
     stats BLOB,
     created TEXT,
+    manifest TEXT,
     PRIMARY KEY (abc_smc_id, t)
 );
 """
 
 
 def _pack(arr: np.ndarray) -> bytes:
+    """Array -> blob.  Routed through the wire codec (delta + zlib,
+    ``wire/transfer.py``) unless ``$PYABC_TPU_WIRE_CODEC=raw``; falls
+    back to plain ``np.save`` for anything the codec refuses."""
+    arr = np.asarray(arr)
+    from ..wire import transfer as _transfer
+    if _transfer.wire_codec() != "raw":
+        try:
+            return _transfer.encode_array(arr)
+        except (ValueError, TypeError):
+            pass
     buf = io.BytesIO()
-    np.save(buf, np.asarray(arr), allow_pickle=False)
+    np.save(buf, arr, allow_pickle=False)
     return buf.getvalue()
 
 
 def _unpack(blob: bytes) -> np.ndarray:
+    """Blob -> array; sniffs the codec magic so databases written with
+    either packing (or by older versions) stay readable."""
+    if bytes(blob[:4]) == b"PTW1":
+        from ..wire import transfer as _transfer
+        return _transfer.decode_array(blob)
     return np.load(io.BytesIO(blob), allow_pickle=False)
 
 
@@ -154,6 +173,9 @@ class History:
         self._migrate()
         self._conn.commit()
         self.id = abc_id
+        #: device-resident population store (wire/store.py) this run's
+        #: lazy generations live in; attached by the orchestrator
+        self._store = None
 
     def _migrate(self):
         """In-place schema upgrades for databases written by older
@@ -166,6 +188,23 @@ class History:
             self._conn.execute(
                 "ALTER TABLE observed_data ADD COLUMN tag TEXT "
                 "DEFAULT 'npy'")
+        pop_cols = [r[1] for r in self._conn.execute(
+            "PRAGMA table_info(populations)").fetchall()]
+        if "lazy" not in pop_cols:
+            self._conn.execute(
+                "ALTER TABLE populations ADD COLUMN lazy INTEGER "
+                "DEFAULT 0")
+        if "summary" not in pop_cols:
+            self._conn.execute(
+                "ALTER TABLE populations ADD COLUMN summary TEXT")
+        if "summary_grid" not in pop_cols:
+            self._conn.execute(
+                "ALTER TABLE populations ADD COLUMN summary_grid BLOB")
+        ck_cols = [r[1] for r in self._conn.execute(
+            "PRAGMA table_info(sub_checkpoints)").fetchall()]
+        if "manifest" not in ck_cols:
+            self._conn.execute(
+                "ALTER TABLE sub_checkpoints ADD COLUMN manifest TEXT")
 
     # ---- run registration ------------------------------------------------
 
@@ -235,13 +274,17 @@ class History:
 
     def _append_population_once(self, t, current_epsilon, population,
                                 nr_simulations, model_names,
-                                param_names=None, stat_spec=None):
+                                param_names=None, stat_spec=None,
+                                summary_json=None, summary_grid=None):
         probs = np.asarray(population.get_model_probabilities(
             nr_models=len(model_names)))
         self._conn.execute(
-            "INSERT OR REPLACE INTO populations VALUES (?,?,?,?,?)",
+            "INSERT OR REPLACE INTO populations (abc_smc_id, t, epsilon,"
+            " nr_samples, population_end_time, lazy, summary,"
+            " summary_grid) VALUES (?,?,?,?,?,0,?,?)",
             (self.id, t, float(current_epsilon), int(nr_simulations),
-             datetime.datetime.now().isoformat()))
+             datetime.datetime.now().isoformat(), summary_json,
+             summary_grid))
         m_arr = np.asarray(population.m)
         theta = np.asarray(population.theta)
         w = np.asarray(population.weight)
@@ -259,7 +302,9 @@ class History:
                 continue
             names_m = (param_names[m] if per_model_names else param_names)
             self._conn.execute(
-                "INSERT OR REPLACE INTO model_populations VALUES "
+                "INSERT OR REPLACE INTO model_populations (abc_smc_id,"
+                " t, m, name, p_model, n_particles, theta, weight,"
+                " distance, stats, param_names, stat_spec) VALUES "
                 "(?,?,?,?,?,?,?,?,?,?,?,?)",
                 (self.id, t, m, model_names[m], float(probs[m]),
                  int(idx.size),
@@ -277,30 +322,44 @@ class History:
 
     # ---- mid-generation sub-checkpoints (resilience/checkpoint.py) -------
 
-    def save_sub_checkpoint(self, t: int, batch: Dict, rounds: int,
-                            nr_evaluations: int,
-                            eps: Optional[float] = None):
+    def save_sub_checkpoint(self, t: int, batch: Optional[Dict],
+                            rounds: int, nr_evaluations: int,
+                            eps: Optional[float] = None,
+                            manifest: Optional[dict] = None):
         """REPLACE the round-granular accepted-particle ledger for
         generation ``t``: the CUMULATIVE accepted rows through device
         round ``rounds`` (``batch`` is a ``widen_wire``-shaped host
         dict).  One row per generation — a crash between flushes loses
         at most one flush interval, and :meth:`append_population`
-        deletes the row once the full generation is durable."""
+        deletes the row once the full generation is durable.
+
+        In lazy-History mode, steady-state flushes pass ``batch=None``
+        plus a device-store ``manifest`` — a cadence heartbeat with no
+        raw bytes; the raw batch is re-shipped only when a preemption
+        is actually in progress (resilience/checkpoint.py)."""
         from ..resilience import faults as _faults
         from ..resilience import retry as _retry
 
         def _write():
             self._conn.execute(
-                "INSERT OR REPLACE INTO sub_checkpoints VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                "INSERT OR REPLACE INTO sub_checkpoints (abc_smc_id, t,"
+                " rounds, n_accepted, nr_evaluations, eps, m, theta,"
+                " distance, log_weight, stats, created, manifest)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (self.id, int(t), int(rounds),
-                 int(batch["m"].shape[0]), int(nr_evaluations),
+                 int(batch["m"].shape[0]) if batch is not None else 0,
+                 int(nr_evaluations),
                  float(eps) if eps is not None else None,
-                 _pack(batch["m"]), _pack(batch["theta"]),
-                 _pack(batch["distance"]), _pack(batch["log_weight"]),
-                 _pack(batch["stats"]) if batch.get("stats") is not None
+                 _pack(batch["m"]) if batch is not None else None,
+                 _pack(batch["theta"]) if batch is not None else None,
+                 _pack(batch["distance"]) if batch is not None else None,
+                 _pack(batch["log_weight"]) if batch is not None
                  else None,
-                 datetime.datetime.now().isoformat()))
+                 _pack(batch["stats"])
+                 if batch is not None and batch.get("stats") is not None
+                 else None,
+                 datetime.datetime.now().isoformat(),
+                 json.dumps(manifest) if manifest is not None else None))
             self._conn.commit()
 
         _retry.shared_policy().call(_write, _faults.SITE_APPEND)
@@ -309,12 +368,14 @@ class History:
         """The flushed ledger for generation ``t``, or None.  Returns
         ``{rounds, nr_evaluations, eps, n_accepted, batch}`` with the
         batch in ``widen_wire`` layout, ready for
-        ``Sample.splice_front``."""
+        ``Sample.splice_front``.  Manifest-only rows (lazy mode's
+        steady-state heartbeat — no raw blobs) return None: there is
+        nothing to splice."""
         row = self._conn.execute(
             "SELECT rounds, n_accepted, nr_evaluations, eps, m, theta,"
             " distance, log_weight, stats FROM sub_checkpoints"
             " WHERE abc_smc_id=? AND t=?", (self.id, int(t))).fetchone()
-        if row is None:
+        if row is None or row[4] is None:
             return None
         batch = {"m": _unpack(row[4]), "theta": _unpack(row[5]),
                  "distance": _unpack(row[6]), "log_weight": _unpack(row[7])}
@@ -325,11 +386,280 @@ class History:
                 "eps": float(row[3]) if row[3] is not None else None,
                 "batch": batch}
 
+    def load_sub_checkpoint_manifest(self, t: int) -> Optional[dict]:
+        """The device-store manifest recorded with generation ``t``'s
+        ledger row (lazy mode), or None."""
+        row = self._conn.execute(
+            "SELECT manifest FROM sub_checkpoints WHERE abc_smc_id=?"
+            " AND t=?", (self.id, int(t))).fetchone()
+        if row is None or row[0] is None:
+            return None
+        return json.loads(row[0])
+
     def clear_sub_checkpoint(self, t: int):
         self._conn.execute(
             "DELETE FROM sub_checkpoints WHERE abc_smc_id=? AND t=?",
             (self.id, int(t)))
         self._conn.commit()
+
+    # ---- lazy mode: device-resident populations (wire/store.py) ----------
+    #
+    # In ``history_mode="lazy"`` the orchestrator attaches a
+    # DeviceRunStore and appends each generation as a SUMMARY row
+    # (``lazy=1`` + O(KB) posterior packet + NULL-blob model rows carrying
+    # counts/probabilities) while the full population stays on device.
+    # Every blob reader below calls ``_materialize`` first, so hydration
+    # is transparent: the first read fetches the wire (booked under
+    # ``egress("history")``), replays the exact eager decode, REPLACEs
+    # the row with real blobs, and drops the store entry.  Evicted
+    # entries arrive through the store's spill queue and are drained
+    # HERE — sqlite connections are thread-affine, and this object stays
+    # on the orchestrator thread while deposits happen on ingest workers.
+
+    def attach_store(self, store):
+        self._store = store
+
+    def append_population_lazy(self, t: int, current_epsilon: float,
+                               nr_simulations: int, *, summary: dict,
+                               model_names: List[str],
+                               param_names: Optional[List[str]] = None,
+                               stat_spec: Optional[dict] = None,
+                               summary_grid: Optional[dict] = None):
+        """Durable summary row for a device-resident generation: the
+        O(KB) counterpart of :meth:`append_population`.  ``summary`` is
+        the posterior summary packet (``wire.store.summary_from_lanes``);
+        per-model mass/counts come from its ``model_w``/``model_n``."""
+        from ..resilience import faults as _faults
+        from ..resilience import retry as _retry
+        _retry.shared_policy().call(
+            self._append_population_lazy_once, _faults.SITE_APPEND,
+            t, current_epsilon, nr_simulations, summary, model_names,
+            param_names, stat_spec, summary_grid)
+
+    def _append_population_lazy_once(self, t, current_epsilon,
+                                     nr_simulations, summary,
+                                     model_names, param_names, stat_spec,
+                                     summary_grid):
+        self._drain_spills()
+        grid_blob = None
+        if summary_grid:
+            grid_blob = _pack(np.stack(
+                [np.asarray(summary_grid["grid_centroid"]),
+                 np.asarray(summary_grid["grid_log_mass"])]))
+        self._conn.execute(
+            "INSERT OR REPLACE INTO populations (abc_smc_id, t, epsilon,"
+            " nr_samples, population_end_time, lazy, summary,"
+            " summary_grid) VALUES (?,?,?,?,?,1,?,?)",
+            (self.id, int(t), float(current_epsilon),
+             int(nr_simulations), datetime.datetime.now().isoformat(),
+             json.dumps(summary), grid_blob))
+        model_w = list(summary.get("model_w", []))
+        model_n = list(summary.get("model_n", []))
+        per_model_names = (param_names
+                           and isinstance(param_names[0], (list, tuple)))
+        for m in range(len(model_names)):
+            n_m = int(model_n[m]) if m < len(model_n) else 0
+            if n_m <= 0:
+                continue
+            names_m = (param_names[m] if per_model_names else param_names)
+            p_m = float(model_w[m]) if m < len(model_w) else 0.0
+            self._conn.execute(
+                "INSERT OR REPLACE INTO model_populations (abc_smc_id,"
+                " t, m, name, p_model, n_particles, theta, weight,"
+                " distance, stats, param_names, stat_spec) VALUES "
+                "(?,?,?,?,?,?,NULL,NULL,NULL,NULL,?,?)",
+                (self.id, int(t), m, model_names[m], p_m, n_m,
+                 json.dumps(list(names_m or [])),
+                 json.dumps({k: list(v) for k, v in stat_spec.items()})
+                 if stat_spec else None))
+        self._conn.execute(
+            "DELETE FROM sub_checkpoints WHERE abc_smc_id=? AND t=?",
+            (self.id, int(t)))
+        self._conn.commit()
+
+    def _lazy_flag(self, t: int) -> Optional[tuple]:
+        """(lazy, epsilon, nr_samples, summary) of generation ``t``'s
+        row, or None when absent."""
+        return self._conn.execute(
+            "SELECT lazy, epsilon, nr_samples, summary FROM populations"
+            " WHERE abc_smc_id=? AND t=?", (self.id, int(t))).fetchone()
+
+    def _materialize_pop(self, t: int, pop: Population, eps, nr,
+                         summary_json):
+        """REPLACE generation ``t``'s summary row with real blobs —
+        the exact eager write path, with names/spec recovered from the
+        lazy model rows, and the summary packet preserved."""
+        names = self.model_names()
+        rows = self._conn.execute(
+            "SELECT m, param_names, stat_spec FROM model_populations"
+            " WHERE abc_smc_id=? AND t=? ORDER BY m",
+            (self.id, int(t))).fetchall()
+        if not names:
+            m_arr = np.asarray(pop.m)
+            max_m = int(m_arr.max()) if m_arr.size else 0
+            names = [f"m{i}" for i in range(max_m + 1)]
+        pn = {m: (json.loads(p) if p else []) for m, p, _ in rows}
+        param_names = [pn.get(m, []) for m in range(len(names))]
+        spec = None
+        for _, _, s in rows:
+            if s:
+                spec = {k: tuple(v) for k, v in json.loads(s).items()}
+                break
+        grid_row = self._conn.execute(
+            "SELECT summary_grid FROM populations WHERE abc_smc_id=?"
+            " AND t=?", (self.id, int(t))).fetchone()
+        self._append_population_once(
+            int(t), eps, pop, nr, names, param_names, spec,
+            summary_json=summary_json,
+            summary_grid=grid_row[0] if grid_row else None)
+
+    def _drain_spills(self):
+        """Materialize entries the store's ring evicted (deposits happen
+        on ingest worker threads; the durable write happens here, on the
+        connection's thread)."""
+        store = self._store
+        if store is None:
+            return
+        from ..telemetry.metrics import REGISTRY
+        from ..wire.store import hydrate_entry
+        requeue = []
+        for entry in store.take_spills():
+            t = entry["t"]
+            row = self._lazy_flag(t)
+            if row is None:
+                # the one-ahead fetch worker can evict generation t+1
+                # into the spill queue BEFORE the harvest loop has
+                # appended its summary row — not stale, just early:
+                # keep it queued for the next drain
+                requeue.append(entry)
+                continue
+            if not row[0]:
+                continue  # stale spill: the row is already durable
+            pop = hydrate_entry(entry)
+            if pop is None:
+                continue
+            self._materialize_pop(t, pop, row[1], row[2], row[3])
+            REGISTRY.counter("wire_store_spills_total",
+                             "evicted store entries made durable").inc()
+        if requeue:
+            store.requeue_spills(requeue)
+
+    def _materialize(self, t: int) -> bool:
+        """Ensure generation ``t``'s row has real blobs.  True when the
+        row exists and is durable after the call; False when it stayed
+        summary-only (store evicted AND spill already lost, or no store
+        attached — readers then take their empty-result paths)."""
+        row = self._lazy_flag(t)
+        if row is None or not row[0]:
+            return row is not None
+        self._drain_spills()
+        row = self._lazy_flag(t)
+        if row is None or not row[0]:
+            return row is not None
+        store = self._store
+        if store is None or not store.has(int(t)):
+            return False
+        pop = store.hydrate(int(t))
+        if pop is None:
+            return False
+        self._materialize_pop(int(t), pop, row[1], row[2], row[3])
+        store.drop(int(t))
+        return True
+
+    def hydrate_population(self, t: int) -> Population:
+        """Round-order Population of generation ``t`` for in-run
+        consumers (transition fits, eps updates): decoded straight from
+        the store wire — bit-identical to what the eager mode handed
+        them — with the durable write done as a side effect.  Falls back
+        to the DB blobs (model-grouped order, as any resumed run sees)
+        when the generation is no longer device-resident."""
+        self._drain_spills()
+        store = self._store
+        row = self._lazy_flag(t)
+        if (store is not None and store.has(int(t)) and row is not None
+                and row[0]):
+            pop = store.hydrate(int(t))
+            if pop is not None:
+                self._materialize_pop(int(t), pop, row[1], row[2],
+                                      row[3])
+                store.drop(int(t))
+                return pop
+        self._materialize(t)
+        return self.get_population(t)
+
+    def flush_lazy(self, final_only: Optional[bool] = None,
+                   newest_first: bool = False):
+        """Materialize device-resident lazy generations (run end).  By
+        default ALL of them — the finished DB then has full blobs for
+        every generation, same as eager mode, just shipped off the
+        per-generation critical path.  ``$PYABC_TPU_LAZY_FINAL_ONLY=1``
+        keeps only the final generation's blobs (pure summary steady
+        state; intermediate generations stay summary rows)."""
+        if final_only is None:
+            final_only = os.environ.get(
+                "PYABC_TPU_LAZY_FINAL_ONLY", "0").lower() in (
+                "1", "true", "on")
+        self._drain_spills()
+        store = self._store
+        if store is None:
+            return
+        ts = store.resident_ts()
+        if final_only and ts:
+            for t in ts[:-1]:
+                store.drop(t)
+            ts = ts[-1:]
+        if newest_first:
+            ts = list(reversed(ts))
+        for t in ts:
+            self._materialize(t)
+        for t in store.resident_ts():
+            store.drop(t)
+
+    def persist_lazy_tail(self):
+        """Exit-path durability anchor: materialize newest-first so the
+        resume anchor (max t) goes durable even if a platform kill
+        timeout truncates the flush (resilience/checkpoint.py raises
+        Preempted through here before the process exits)."""
+        self.flush_lazy(newest_first=True)
+
+    def purge_stale_lazy(self) -> int:
+        """Drop summary-only generation rows whose device store died
+        with a previous process (resume path): ``max_t`` then anchors on
+        the last generation with durable blobs, and the run regenerates
+        from there.  Returns the number of generations purged."""
+        ts = [r[0] for r in self._conn.execute(
+            "SELECT t FROM populations WHERE abc_smc_id=? AND lazy=1",
+            (self.id,)).fetchall()]
+        live = set(self._store.resident_ts()) if self._store else set()
+        stale = [t for t in ts if t not in live]
+        for t in stale:
+            self._conn.execute(
+                "DELETE FROM populations WHERE abc_smc_id=? AND t=?",
+                (self.id, t))
+            self._conn.execute(
+                "DELETE FROM model_populations WHERE abc_smc_id=?"
+                " AND t=?", (self.id, t))
+        if stale:
+            self._conn.commit()
+            import logging
+            logging.getLogger("ABC.History").warning(
+                "purged %d summary-only generation(s) %s left by an "
+                "interrupted lazy run; resuming from the last durable "
+                "generation", len(stale), stale)
+        return len(stale)
+
+    def get_population_summary(self, t: Optional[int] = None
+                               ) -> Optional[dict]:
+        """The stored posterior summary packet of generation ``t``
+        (lazy appends always have one; materialization preserves it),
+        or None for eager-written generations."""
+        t = self.max_t if t is None else t
+        row = self._conn.execute(
+            "SELECT summary FROM populations WHERE abc_smc_id=? AND t=?",
+            (self.id, int(t))).fetchone()
+        if row is None or row[0] is None:
+            return None
+        return json.loads(row[0])
 
     # ---- queries (reference history.py:269-330, 732-780, 1004-1078) ------
 
@@ -369,10 +699,11 @@ class History:
         """(parameter DataFrame, normalized weights) — reference
         history.py:269-330."""
         t = self.max_t if t is None else t
+        self._materialize(t)
         row = self._conn.execute(
             "SELECT theta, weight, param_names FROM model_populations "
             "WHERE abc_smc_id=? AND t=? AND m=?", (self.id, t, m)).fetchone()
-        if row is None:
+        if row is None or row[0] is None:
             return pd.DataFrame(), np.zeros(0)
         theta, w = _unpack(row[0]), _unpack(row[1])
         names = json.loads(row[2]) or [f"p{i}" for i in range(theta.shape[1])]
@@ -394,9 +725,11 @@ class History:
 
     def get_weighted_distances(self, t: Optional[int] = None) -> pd.DataFrame:
         t = self.max_t if t is None else t
+        self._materialize(t)
         rows = self._conn.execute(
             "SELECT distance, weight FROM model_populations WHERE "
             "abc_smc_id=? AND t=?", (self.id, t)).fetchall()
+        rows = [r for r in rows if r[0] is not None]
         ds = np.concatenate([_unpack(r[0]) for r in rows]) if rows else np.zeros(0)
         ws = np.concatenate([_unpack(r[1]) for r in rows]) if rows else np.zeros(0)
         return pd.DataFrame({"distance": ds, "w": ws / max(ws.sum(), 1e-300)})
@@ -404,9 +737,15 @@ class History:
     def get_population(self, t: Optional[int] = None) -> Population:
         """Reconstruct the dense Population (reference history.py:1004-1078)."""
         t = self.max_t if t is None else t
+        self._materialize(t)
         rows = self._conn.execute(
             "SELECT m, theta, weight, distance, stats FROM model_populations "
             "WHERE abc_smc_id=? AND t=? ORDER BY m", (self.id, t)).fetchall()
+        rows = [r for r in rows if r[1] is not None]
+        if not rows:
+            return Population(
+                m=np.zeros(0, dtype=np.int32), theta=np.zeros((0, 0)),
+                weight=np.zeros(0), distance=np.zeros(0), sum_stats={})
         ms, thetas, ws, ds, stats = [], [], [], [], []
         dim = max((_unpack(r[1]).shape[1] for r in rows), default=0)
         for m, tb, wb, db, sb in rows:
@@ -436,6 +775,7 @@ class History:
         ``m`` (reference history.py:732-780 ``get_sum_stats``; the flat
         block + stored spec replace the row-per-statistic ORM)."""
         t = self.max_t if t is None else t
+        self._materialize(t)
         row = self._conn.execute(
             "SELECT stats, stat_spec FROM model_populations "
             "WHERE abc_smc_id=? AND t=? AND m=?", (self.id, t, m)).fetchone()
@@ -457,10 +797,11 @@ class History:
                                 ) -> Tuple[np.ndarray, List[Dict]]:
         """Un-normalized (weights, per-particle sum-stat dicts) of one
         model — shared by the all-models and per-model accessors."""
+        self._materialize(t)
         row = self._conn.execute(
             "SELECT weight FROM model_populations WHERE abc_smc_id=? "
             "AND t=? AND m=?", (self.id, t, m)).fetchone()
-        if row is None:
+        if row is None or row[0] is None:
             return np.zeros(0), []
         w = _unpack(row[0])
         keyed = self.get_sum_stats(t, m)
@@ -566,8 +907,10 @@ class History:
             if m is not None:
                 query += " AND m=?"
                 args.append(m)
+            self._materialize(ti)
             rows = self._conn.execute(query + " ORDER BY m",
                                       args).fetchall()
+            rows = [r for r in rows if r[1] is not None]
             for mi, tb, wb, db_, names_json in rows:
                 theta = _unpack(tb)
                 names = (json.loads(names_json)
@@ -604,6 +947,7 @@ class History:
         return to_reference_db(self, path, batch_stats=batch_stats)
 
     def done(self):
+        self.flush_lazy()
         self._conn.commit()
 
     def close(self):

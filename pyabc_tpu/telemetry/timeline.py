@@ -31,6 +31,10 @@ class GenerationTimeline:
         self._rows: list = []
         self._max_rows = max_rows
         self._lock = threading.Lock()
+        #: the run's History egress discipline ("lazy" | "eager"), set
+        #: by the orchestrator so bench/heartbeat consumers can tell
+        #: which dataflow produced the rows (wire/store.py)
+        self.history_mode: Optional[str] = None
 
     def record(self, t: int, *, path: str, wall_s: float,
                stages: Optional[dict] = None, eps: Optional[float] = None,
@@ -112,6 +116,7 @@ class GenerationTimeline:
             "compile_s_med": med("compile_s"),
             "n_compiles_total": int(sum(r["n_compiles"] for r in rows)),
             "engine_decision": engine,
+            "history_mode": self.history_mode,
         }
 
     def render_ascii(self) -> str:

@@ -74,12 +74,20 @@ class GenStream:
     own unharvested tickets.
     """
 
-    def __init__(self, engine, wires: dict, K: int, n: int, label: str):
+    def __init__(self, engine, wires: dict, K: int, n: int, label: str,
+                 fetch=None):
         self._engine = engine
         self._wires = wires
         self._K = K
         self._n = n
         self._label = label
+        #: optional replacement for the default fetch+decode, signature
+        #: ``fetch(k, gen_wire, n) -> (payload, count, rounds, eps)`` —
+        #: the lazy-History path uses it to deposit the full slice into
+        #: the DeviceRunStore and ship only the O(KB) summary lanes
+        #: (``payload`` is then the summary packet, not a batch).
+        #: ``drain_rounds``/``result`` only rely on the tuple layout.
+        self._fetch = fetch
         self._next = 0
         self._ticket = None
         self._span = None
@@ -100,9 +108,12 @@ class GenStream:
         # Perfetto trace of an early-stopped or rewound block has no
         # dangling begins (tools/check_span_pairs.py)
         self._span = spans.begin("stream.gen", gen=k, label=self._label)
-        self._ticket = self._engine.submit(
-            lambda: _fetch_gen(gw, self._n),
-            label=f"{self._label}+{k}")
+        if self._fetch is not None:
+            fn = (lambda f=self._fetch, k=k, gw=gw, n=self._n:
+                  f(k, gw, n))
+        else:
+            fn = (lambda gw=gw, n=self._n: _fetch_gen(gw, n))
+        self._ticket = self._engine.submit(fn, label=f"{self._label}+{k}")
         self._next += 1
 
     def _end_span(self, outcome: str):

@@ -1,0 +1,387 @@
+"""Device-resident population store: keep accepted generations on
+device, ship summaries.
+
+At the north star (pop 1e6) the hot loop computes a generation in
+~0.9 s but the ~6 MB accepted-population fetch crawls over a ~6-8 MB/s
+relay — and the resilience ledger plus ``History.append_population``
+used to re-ship the same bytes again.  :class:`DeviceRunStore` inverts
+the dataflow (the t5x device-resident-state shape): the fused and
+sequential engines **deposit** each generation's narrow wire — the
+bit-packed on-device payload that would have been fetched — into a
+bounded ring keyed by generation ``t``, and steady-state egress shrinks
+to a per-generation **posterior summary packet** (weighted moments,
+ESS, per-model mass, distance extremes) of O(KB), booked under
+``egress("summary")``.
+
+Full populations leave the device only on explicit request —
+:func:`hydrate_entry` replays the EXACT production decode path
+(``fetch_to_host`` → ``widen_wire`` → the same weight normalization the
+eager path used), booked under ``egress("history")``, so a hydrated
+population is bit-identical to what the eager mode would have built.
+Two decode flavors exist because the two engines normalize differently:
+
+- ``norm="sample"``  — sequential deferred wires; replayed through
+  ``Sample.get_accepted_population`` (f32 max-shift, f64 exp).
+- ``norm="stream"``  — fused block slices; replayed through
+  ``wire.ingest.split_gen_wire`` + ``batch_to_population`` (f64
+  max-shift).
+
+Eviction never loses data: entries pushed out of the ring land on a
+**spill queue** that ``storage/history.py`` drains on its own (sqlite
+writer) thread — deposits happen on ingest worker threads, so the
+store itself never touches the database.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("ABC.Wire")
+
+#: ring capacity (resident generations) — beyond it the oldest entry
+#: moves to the spill queue for durable materialization
+STORE_GENS_ENV = "PYABC_TPU_STORE_GENS"
+#: history mode A/B knob read by ``ABCSMC`` (lazy | eager)
+HISTORY_MODE_ENV = "PYABC_TPU_HISTORY_MODE"
+#: opt-in 2^14-cell pdf-grid compression in the summary packet (1-D)
+SUMMARY_GRID_ENV = "PYABC_TPU_SUMMARY_GRID"
+
+_HELP = "device-resident population store; see pyabc_tpu/wire/store.py"
+
+#: wire lanes carrying the in-scan summary packet (sampler/fused.py
+#: emits them when built with ``summary_lanes=True``); everything the
+#: steady-state egress needs, O(KB) regardless of population size
+SUMMARY_LANE_KEYS = ("sm_ess", "sm_mean", "sm_var", "sm_mw", "sm_mn",
+                     "sm_dmin", "sm_dmean")
+
+
+def default_max_gens() -> int:
+    """Ring capacity from ``$PYABC_TPU_STORE_GENS`` (default 12)."""
+    try:
+        return max(int(os.environ.get(STORE_GENS_ENV, "12")), 1)
+    except ValueError:
+        return 12
+
+
+def summary_grid_enabled() -> bool:
+    return os.environ.get(SUMMARY_GRID_ENV, "0").lower() in (
+        "1", "true", "on", "yes")
+
+
+def _counter(name: str):
+    from ..telemetry.metrics import REGISTRY
+    return REGISTRY.counter(name, _HELP)
+
+
+def _gauge(name: str):
+    from ..telemetry.metrics import REGISTRY
+    return REGISTRY.gauge(name, _HELP)
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+    return int(sum(getattr(x, "nbytes", 0)
+                   for x in jax.tree_util.tree_leaves(tree)))
+
+
+# ---------------------------------------------------------------- summary
+
+def summary_wire_lanes(m, theta, distance, log_weight, valid, M: int):
+    """Traceable posterior-summary lanes over one generation's accepted
+    buffer: the device half of the summary packet.  Reuses the fused
+    carry's weight-normalization pattern (max-shift in f32 over valid
+    finite rows) so the packet is consistent with what the engines
+    already compute.  Emitted inside the fused scan (``sm_*`` wire
+    lanes) and by :func:`summarize_device_population` for the
+    sequential deferred wire."""
+    import jax.numpy as jnp
+
+    mi = m.astype(jnp.int32)
+    lw = jnp.where(valid & jnp.isfinite(log_weight), log_weight, -jnp.inf)
+    lw_max = jnp.max(lw)
+    lw_max = jnp.where(jnp.isfinite(lw_max), lw_max, 0.0)
+    w_un = jnp.where(valid, jnp.exp(log_weight - lw_max), 0.0)
+    w = w_un / jnp.maximum(jnp.sum(w_un), 1e-38)
+    mean = jnp.sum(w[:, None] * theta, axis=0)
+    var = jnp.sum(w[:, None] * jnp.square(theta - mean[None, :]), axis=0)
+    ess = 1.0 / jnp.maximum(jnp.sum(w * w), 1e-38)
+    one_hot = mi[:, None] == jnp.arange(M, dtype=jnp.int32)[None, :]
+    mw = jnp.sum(jnp.where(one_hot, w[:, None], 0.0), axis=0)
+    mn = jnp.sum((one_hot & valid[:, None]).astype(jnp.int32), axis=0)
+    dmin = jnp.min(jnp.where(valid, distance, jnp.inf))
+    dmean = jnp.sum(w * distance)
+    return {
+        "sm_ess": ess.astype(jnp.float32),
+        "sm_mean": mean.astype(jnp.float32),
+        "sm_var": var.astype(jnp.float32),
+        "sm_mw": mw.astype(jnp.float32),
+        "sm_mn": mn.astype(jnp.int32),
+        "sm_dmin": dmin.astype(jnp.float32),
+        "sm_dmean": dmean.astype(jnp.float32),
+    }
+
+
+def summary_from_lanes(host: dict) -> dict:
+    """Host half: fetched ``sm_*`` lanes → the JSON-able summary packet.
+    Model masses are re-normalized in f64 on the host so a single-model
+    run stores exactly ``p_model == 1.0`` (matching the eager path's
+    bincount-over-sum)."""
+    mw = np.asarray(host["sm_mw"], dtype=np.float64).reshape(-1)
+    mw_sum = mw.sum()
+    if np.isfinite(mw_sum) and mw_sum > 0:
+        mw = mw / mw_sum
+    packet = {
+        "ess": float(np.asarray(host["sm_ess"])),
+        "mean": np.asarray(host["sm_mean"],
+                           dtype=np.float64).reshape(-1).tolist(),
+        "var": np.asarray(host["sm_var"],
+                          dtype=np.float64).reshape(-1).tolist(),
+        "model_w": mw.tolist(),
+        "model_n": np.asarray(host["sm_mn"],
+                              dtype=np.int64).reshape(-1).tolist(),
+        "dist_min": float(np.asarray(host["sm_dmin"])),
+        "dist_mean": float(np.asarray(host["sm_dmean"])),
+    }
+    return packet
+
+
+_SUMMARIZE_JIT = None
+
+
+def summarize_device_population(dp: dict, M: int) -> dict:
+    """Summary packet for a sequential deferred generation, computed on
+    device from the sampler's accepted buffer (``Sample.
+    device_population``) and fetched under ``egress("summary")`` —
+    O(KB) regardless of population size.  Compiles once per shape."""
+    global _SUMMARIZE_JIT
+    import jax
+
+    if _SUMMARIZE_JIT is None:
+        def _f(m, theta, log_weight, distance, count, M):
+            import jax.numpy as jnp
+            valid = jnp.arange(m.shape[0]) < count
+            return summary_wire_lanes(m, theta, distance, log_weight,
+                                      valid, M)
+        _SUMMARIZE_JIT = jax.jit(_f, static_argnames=("M",))
+
+    from ..sampler.base import fetch_to_host
+    from . import transfer
+
+    dev = _SUMMARIZE_JIT(dp["m"], dp["theta"], dp["log_weight"],
+                         dp["distance"], dp["count"], M=M)
+    with transfer.egress("summary"):
+        host = fetch_to_host(dev)
+    return summary_from_lanes(host)
+
+
+def maybe_summary_grid(dp: dict) -> Optional[dict]:
+    """Optional 2^14-cell pdf-grid compression of a 1-D posterior
+    (``sampler/fused.py:_compress_support_device``), shipped in the
+    summary packet when ``$PYABC_TPU_SUMMARY_GRID`` is on.  Returns
+    ``{"grid_centroid", "grid_log_mass"}`` host arrays or None (off,
+    or the parameter space is not 1-D)."""
+    if not summary_grid_enabled():
+        return None
+    theta = dp["theta"]
+    if getattr(theta, "ndim", 0) != 2 or theta.shape[1] != 1:
+        return None
+    import jax.numpy as jnp
+
+    from ..sampler.base import fetch_to_host
+    from ..sampler.fused import _compress_support_device
+    from . import transfer
+
+    valid = jnp.arange(theta.shape[0]) < dp["count"]
+    lw = jnp.where(valid & jnp.isfinite(dp["log_weight"]),
+                   dp["log_weight"], -jnp.inf)
+    lw_max = jnp.max(lw)
+    lw_max = jnp.where(jnp.isfinite(lw_max), lw_max, 0.0)
+    w_un = jnp.where(valid, jnp.exp(dp["log_weight"] - lw_max), 0.0)
+    w = w_un / jnp.maximum(jnp.sum(w_un), 1e-38)
+    sup, log_mass, _ = _compress_support_device(
+        theta, w, valid, jnp.ones((1, 1), jnp.float32))
+    with transfer.egress("summary"):
+        host = fetch_to_host({"grid_centroid": sup[:, 0],
+                              "grid_log_mass": log_mass})
+    return {k: np.asarray(v) for k, v in host.items()}
+
+
+# ---------------------------------------------------------------- decode
+
+def hydrate_entry(entry: dict):
+    """Materialize one deposited generation to the host: fetch the
+    narrow wire under ``egress("history")`` and replay the exact decode
+    path the eager mode would have used (selected by the entry's
+    ``norm`` tag), so the result is bit-identical to an eager run.
+    Returns a round-order :class:`~pyabc_tpu.population.Population`,
+    or None when the weights are degenerate."""
+    from ..sampler.base import Sample, fetch_to_host, widen_wire
+    from . import transfer
+    from .ingest import _SCALAR_KEYS, batch_to_population, split_gen_wire
+
+    wire = {key: v for key, v in entry["wire"].items()
+            if not key.startswith("sm_")}
+    with transfer.egress("history"):
+        out = fetch_to_host(wire)
+    if entry["norm"] == "sample":
+        batch = {key: v for key, v in out.items()
+                 if key not in _SCALAR_KEYS}
+        take = min(int(entry["count"]),
+                   int(np.asarray(batch["theta"]).shape[0]))
+        smp = Sample()
+        if take > 0:
+            smp._acc.append(widen_wire(batch, take))
+        return smp.get_accepted_population(entry["n"])
+    batch, _, _, _ = split_gen_wire(out, entry["n"])
+    return batch_to_population(batch)
+
+
+# ------------------------------------------------------------------ store
+
+class DeviceRunStore:
+    """Bounded ring of device-resident accepted generations.
+
+    ``deposit`` is thread-safe (ingest workers call it); everything the
+    ring pushes out lands on the spill queue, which the History drains
+    on ITS thread (sqlite connections are thread-affine).  ``hydrate``
+    fetches+decodes an entry without removing it — the owner decides
+    when to ``drop`` (after durable materialization) or ``drop_from``
+    (pipelined rewind of speculative generations).
+    """
+
+    def __init__(self, max_gens: Optional[int] = None):
+        self.max_gens = int(max_gens) if max_gens else default_max_gens()
+        self._entries: "OrderedDict[int, dict]" = OrderedDict()
+        self._spills: list = []
+        self._lock = threading.RLock()
+        self.deposits = 0
+        self.evictions = 0
+        self.hydrations = 0
+
+    def _update_gauges(self):
+        _gauge("wire_store_resident_entries").set(len(self._entries))
+        _gauge("wire_store_resident_bytes").set(
+            sum(e["nbytes"] for e in self._entries.values()))
+
+    def deposit(self, t: int, wire: dict, *, n: int, count: int,
+                eps: Optional[float] = None, norm: str = "stream"):
+        """Park generation ``t``'s narrow wire on device.  A repeat
+        deposit for the same ``t`` (pipelined re-run after a rewind)
+        replaces the stale entry."""
+        entry = {
+            "t": int(t), "wire": wire, "n": int(n), "count": int(count),
+            "eps": None if eps is None else float(eps),
+            "norm": str(norm), "nbytes": _tree_nbytes(wire),
+        }
+        with self._lock:
+            self._entries.pop(int(t), None)
+            self._entries[int(t)] = entry
+            self.deposits += 1
+            _counter("wire_store_deposits_total").inc()
+            while len(self._entries) > self.max_gens:
+                t_old, old = self._entries.popitem(last=False)
+                self._spills.append(old)
+                self.evictions += 1
+                _counter("wire_store_evictions_total").inc()
+                logger.info("device store: evicting gen %d to spill "
+                            "queue (%d resident)", t_old,
+                            len(self._entries))
+            self._update_gauges()
+
+    def has(self, t: int) -> bool:
+        with self._lock:
+            return int(t) in self._entries
+
+    def resident_ts(self) -> list:
+        with self._lock:
+            return sorted(self._entries)
+
+    def entry_meta(self, t: int) -> Optional[dict]:
+        with self._lock:
+            e = self._entries.get(int(t))
+            if e is None:
+                return None
+            return {k: e[k] for k in ("t", "n", "count", "eps", "norm",
+                                      "nbytes")}
+
+    def hydrate(self, t: int):
+        """Fetch+decode generation ``t`` (bit-identical to eager; books
+        ``egress("history")``).  The entry stays resident — drop it
+        once the result is durable."""
+        with self._lock:
+            entry = self._entries.get(int(t))
+        if entry is None:
+            return None
+        pop = hydrate_entry(entry)
+        with self._lock:
+            self.hydrations += 1
+            _counter("wire_store_hydrations_total").inc()
+        return pop
+
+    def take_spills(self) -> list:
+        """Hand the evicted entries to the caller (the History's
+        thread) for durable materialization; clears the queue."""
+        with self._lock:
+            spills, self._spills = self._spills, []
+            return spills
+
+    def requeue_spills(self, entries: list):
+        """Put back spill entries a drain could not materialize yet
+        (their summary rows haven't been appended — the one-ahead fetch
+        worker raced the harvest loop).  They rejoin at the FRONT: they
+        are older than anything evicted since."""
+        with self._lock:
+            self._spills = list(entries) + self._spills
+
+    def drop(self, t: int) -> bool:
+        with self._lock:
+            gone = self._entries.pop(int(t), None)
+            if gone is not None:
+                _counter("wire_store_drops_total").inc()
+                self._update_gauges()
+            return gone is not None
+
+    def drop_from(self, t: int) -> int:
+        """Drop every resident entry with generation >= ``t`` AND any
+        queued spill in that range (pipelined rewind: speculative
+        generations past the frontier are invalid)."""
+        with self._lock:
+            stale = [k for k in self._entries if k >= int(t)]
+            for k in stale:
+                self._entries.pop(k, None)
+            n_spill = len(self._spills)
+            self._spills = [e for e in self._spills if e["t"] < int(t)]
+            dropped = len(stale) + (n_spill - len(self._spills))
+            if dropped:
+                _counter("wire_store_drops_total").inc(dropped)
+                self._update_gauges()
+            return dropped
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._spills = []
+            self._update_gauges()
+
+    def manifest(self) -> dict:
+        """JSON-able snapshot for the sub-checkpoint ledger: enough for
+        a resumed run to know what was device-resident (and therefore
+        what a hard preemption lost vs what is durable)."""
+        with self._lock:
+            return {
+                "max_gens": self.max_gens,
+                "deposits": self.deposits,
+                "evictions": self.evictions,
+                "resident": [
+                    {k: e[k] for k in ("t", "n", "count", "eps", "norm",
+                                       "nbytes")}
+                    for e in self._entries.values()
+                ],
+                "spill_pending": [e["t"] for e in self._spills],
+            }

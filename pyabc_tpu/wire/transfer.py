@@ -45,10 +45,17 @@ pyabc/sampler/redis_eps/sampler.py result pipelines).
 
 from __future__ import annotations
 
+import json
+import os
+import struct
 import threading
 import time
+import zlib
 from collections.abc import Mapping
 from contextlib import contextmanager
+from typing import Optional
+
+import numpy as np
 
 from ..telemetry.metrics import REGISTRY
 
@@ -229,3 +236,76 @@ class timed_d2h:
     def commit(self, tree):
         record_d2h(_tree_nbytes(tree), self.seconds)
         return tree
+
+
+# ------------------------------------------------------------------ codec
+#
+# Entropy/delta coding for wire payloads that leave the process — the
+# remaining full-population hydrations and the final History flush
+# (storage/history.py blob packing routes through here).  The bit-packed
+# wire columns are already narrow (f16 + pow2 scales, bit-packed m), but
+# accepted buffers are written in round order, so adjacent rows are
+# drawn from the same proposal and their raw bit patterns correlate:
+# a wrapping integer delta along axis 0 turns that correlation into
+# long zero runs that zlib (level 1 — speed over ratio; this sits on
+# the append path) collapses.  The transform is exactly invertible in
+# modular arithmetic, so round-trips are bit-identical for every dtype
+# (tests/test_device_store.py asserts this).
+
+WIRE_CODEC_ENV = "PYABC_TPU_WIRE_CODEC"
+_CODEC_MAGIC = b"PTW1"
+_UINT_FOR_SIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def wire_codec() -> str:
+    """Active storage codec from ``$PYABC_TPU_WIRE_CODEC``:
+    ``delta`` (default) or ``raw`` (legacy ``np.save`` blobs)."""
+    v = os.environ.get(WIRE_CODEC_ENV, "delta").lower()
+    return "raw" if v in ("raw", "off", "none", "0") else "delta"
+
+
+def encode_array(arr: np.ndarray, codec: Optional[str] = None) -> bytes:
+    """Encode one array to a self-describing compressed blob
+    (``PTW1`` + JSON header + zlib payload).  ``codec="delta"`` applies
+    a wrapping same-width unsigned delta along axis 0 before
+    compression; arrays the delta cannot help (0-d, single-row, exotic
+    itemsizes) fall back to plain compression inside the container."""
+    shape = np.asarray(arr).shape  # before ascontiguousarray: it
+    arr = np.ascontiguousarray(arr)  # promotes 0-d to (1,)
+    if arr.dtype.hasobject:
+        raise ValueError("object arrays cannot ride the wire codec")
+    codec = codec or wire_codec()
+    u_dtype = _UINT_FOR_SIZE.get(arr.dtype.itemsize)
+    if codec == "delta" and u_dtype is not None and arr.ndim >= 1 \
+            and arr.shape[0] >= 2:
+        u = arr.view(u_dtype)
+        d = np.empty_like(u)
+        d[0] = u[0]
+        np.subtract(u[1:], u[:-1], out=d[1:])  # wraps mod 2^width
+        used, payload = "delta", d.tobytes()
+    else:
+        used, payload = "plain", arr.tobytes()
+    header = json.dumps({"dtype": arr.dtype.str,
+                         "shape": list(shape),
+                         "codec": used}).encode("ascii")
+    return (_CODEC_MAGIC + struct.pack("<I", len(header)) + header
+            + zlib.compress(payload, 1))
+
+
+def decode_array(blob: bytes) -> np.ndarray:
+    """Exact inverse of :func:`encode_array` (bit-identical)."""
+    if bytes(blob[:4]) != _CODEC_MAGIC:
+        raise ValueError("not a PTW1 codec blob")
+    (hlen,) = struct.unpack("<I", blob[4:8])
+    meta = json.loads(bytes(blob[8:8 + hlen]).decode("ascii"))
+    raw = zlib.decompress(bytes(blob[8 + hlen:]))
+    dtype = np.dtype(meta["dtype"])
+    shape = tuple(meta["shape"])
+    if meta["codec"] == "delta":
+        u_dtype = _UINT_FOR_SIZE[dtype.itemsize]
+        d = np.frombuffer(raw, dtype=u_dtype).reshape(shape)
+        # cumsum in the same unsigned width wraps mod 2^width — the
+        # exact inverse of the wrapping delta
+        u = np.cumsum(d, axis=0, dtype=u_dtype)
+        return u.view(dtype)
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()

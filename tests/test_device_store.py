@@ -1,0 +1,371 @@
+"""Device-resident population store (pyabc_tpu/wire/store.py) and lazy
+History hydration (storage/history.py).
+
+The tentpole contract: in ``history_mode="lazy"`` accepted populations
+stay parked on device in a bounded ring and steady-state egress is an
+O(KB) posterior summary packet — yet every consumer (transition fits,
+History queries, resumed runs) sees populations BIT-IDENTICAL to the
+eager dataflow, because hydration replays the exact production decode
+path.  These tests pin:
+
+- codec round-trips are bit-identical for every dtype/shape class
+  (wire/transfer.py PTW1 delta+zlib container);
+- the ring's deposit/evict/spill/drop/manifest accounting;
+- eager-vs-lazy posterior bit-identity on the sequential, fused and
+  pipelined run paths (np.array_equal, not allclose);
+- eviction pressure (ring capacity 1) degrades to the durable-DB
+  fallback without changing a single bit;
+- steady-state population-bucket egress does not grow with generations
+  under lazy mode while eager grows >= 10x faster;
+- the resilience ledger's manifest-only rows + the preemption flush
+  anchor (persist_lazy_tail) survive a store-backed run.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+from pyabc_tpu.wire import store as wire_store
+from pyabc_tpu.wire import transfer
+
+
+# ---------------------------------------------------------------------------
+# codec: PTW1 container round-trips bit-identically
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["delta", "raw"])
+def test_codec_roundtrip_bit_identity(codec):
+    """Every dtype the wire ships must survive encode/decode with the
+    exact bit pattern — including NaN/Inf payloads and shapes the delta
+    transform cannot help (0-d, single row)."""
+    rng = np.random.default_rng(0)
+    arrays = [
+        np.float16(rng.normal(size=(64, 3)) * 100),
+        np.float32(rng.normal(size=(257,))),
+        np.float64(rng.normal(size=(33, 2, 2))),
+        rng.integers(-128, 127, size=(65,), dtype=np.int8),
+        rng.integers(0, 2 ** 31, size=(12, 5)).astype(np.int32),
+        rng.integers(0, 2 ** 16, size=(40,), dtype=np.uint16),
+        (rng.random(50) < 0.5),                      # bool
+        np.array(3.25, dtype=np.float32),            # 0-d -> plain
+        np.float32(rng.normal(size=(1, 7))),         # single row -> plain
+        np.zeros((0, 4), dtype=np.float32),          # empty
+    ]
+    special = np.float32(rng.normal(size=(20, 2)))
+    special[3, 0] = np.nan
+    special[7, 1] = np.inf
+    special[11, 0] = -np.inf
+    special[0, 0] = -0.0
+    arrays.append(special)
+    for arr in arrays:
+        blob = transfer.encode_array(arr, codec=codec)
+        assert bytes(blob[:4]) == b"PTW1"
+        out = transfer.decode_array(blob)
+        assert out.dtype == arr.dtype
+        assert out.shape == arr.shape
+        assert out.tobytes() == arr.tobytes()  # bit-identity, not ==
+
+
+def test_codec_rejects_garbage():
+    with pytest.raises(ValueError):
+        transfer.decode_array(b"nope" + b"\0" * 16)
+    with pytest.raises(ValueError):
+        transfer.encode_array(np.array([object()]))
+
+
+def test_codec_delta_actually_compresses_correlated_rows():
+    """Round-ordered accepted rows correlate; the delta codec must beat
+    the raw container on them (the reason it exists)."""
+    base = np.linspace(0.0, 1.0, 4096, dtype=np.float32)
+    arr = (base[:, None] + np.float32(1e-4) * np.arange(3)).astype(
+        np.float32)
+    delta = transfer.encode_array(arr, codec="delta")
+    raw = transfer.encode_array(arr, codec="raw")
+    assert len(delta) < len(raw)
+    assert transfer.decode_array(delta).tobytes() == arr.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+# ---------------------------------------------------------------------------
+
+def _dummy_wire(t):
+    import jax.numpy as jnp
+    return {"theta": jnp.full((8, 2), float(t)),
+            "m": jnp.zeros((8,), jnp.int32)}
+
+
+def test_store_ring_eviction_spill_and_drop():
+    store = wire_store.DeviceRunStore(max_gens=2)
+    for t in range(3):
+        store.deposit(t, _dummy_wire(t), n=8, count=8, eps=1.0 - t * 0.1,
+                      norm="stream")
+    # ring holds the newest two; the oldest moved to the spill queue
+    assert store.resident_ts() == [1, 2]
+    assert store.deposits == 3 and store.evictions == 1
+    spills = store.take_spills()
+    assert [e["t"] for e in spills] == [0]
+    assert store.take_spills() == []  # drained
+
+    meta = store.entry_meta(2)
+    assert meta["n"] == 8 and meta["count"] == 8
+    assert meta["norm"] == "stream" and meta["nbytes"] > 0
+    assert store.entry_meta(0) is None
+
+    # re-deposit of a resident t replaces, not duplicates
+    store.deposit(2, _dummy_wire(2), n=8, count=4, norm="stream")
+    assert store.resident_ts() == [1, 2]
+    assert store.entry_meta(2)["count"] == 4
+
+    assert store.drop(1) and not store.drop(1)
+    assert store.resident_ts() == [2]
+
+
+def test_store_drop_from_covers_spills():
+    """Pipelined rewind: speculative generations past the frontier must
+    vanish from the ring AND the spill queue."""
+    store = wire_store.DeviceRunStore(max_gens=2)
+    for t in range(4):
+        store.deposit(t, _dummy_wire(t), n=8, count=8, norm="stream")
+    assert store.resident_ts() == [2, 3]
+    assert sorted(store.manifest()["spill_pending"]) == [0, 1]
+    dropped = store.drop_from(1)
+    assert dropped == 3  # gens 1 (spill), 2, 3 (resident)
+    assert store.resident_ts() == []
+    assert [e["t"] for e in store.take_spills()] == [0]
+
+
+def test_store_manifest_snapshot():
+    store = wire_store.DeviceRunStore(max_gens=4)
+    store.deposit(5, _dummy_wire(5), n=8, count=7, eps=0.25, norm="sample")
+    man = store.manifest()
+    assert man["max_gens"] == 4 and man["deposits"] == 1
+    (entry,) = man["resident"]
+    assert entry["t"] == 5 and entry["count"] == 7
+    assert entry["eps"] == 0.25 and entry["norm"] == "sample"
+    json.dumps(man)  # ledger row must be JSON-able
+
+
+# ---------------------------------------------------------------------------
+# eager-vs-lazy posterior bit-identity (the tentpole acceptance gate)
+# ---------------------------------------------------------------------------
+
+def _run(mode, pop=256, gens=4, seed=7, db="sqlite://", **kw):
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    sampler=pt.VectorizedSampler(), seed=seed,
+                    history_mode=mode, **kw)
+    abc.new(db, observed)
+    abc.run(max_nr_populations=gens)
+    return abc
+
+
+def _assert_bit_identical(h_e, h_l, label):
+    assert h_e.max_t == h_l.max_t
+    for t in range(h_e.max_t + 1):
+        for m in range(2):
+            de, we = h_e.get_distribution(m, t)
+            dl, wl = h_l.get_distribution(m, t)
+            assert np.array_equal(np.asarray(de["mu"]),
+                                  np.asarray(dl["mu"])), \
+                f"{label}: theta differs at t={t} m={m}"
+            assert np.array_equal(we, wl), \
+                f"{label}: weights differ at t={t} m={m}"
+        pe = h_e.get_population(t=t)
+        pl = h_l.get_population(t=t)
+        assert np.array_equal(np.asarray(pe.distance),
+                              np.asarray(pl.distance))
+
+
+def test_sequential_lazy_bit_identical_and_summary_row():
+    abc_e = _run("eager", ingest_mode="sequential")
+    abc_l = _run("lazy", ingest_mode="sequential")
+    _assert_bit_identical(abc_e.history, abc_l.history, "sequential")
+    # the lazy append left an O(KB) posterior packet on every row ...
+    for t in range(abc_l.history.max_t + 1):
+        packet = abc_l.history.get_population_summary(t)
+        assert packet is not None
+        assert packet["ess"] > 0
+        assert np.isclose(sum(packet["model_w"]), 1.0)
+        assert len(packet["mean"]) == 1  # one shared mu axis
+    # ... eager rows have none, and the timeline records the mode
+    assert abc_e.history.get_population_summary(0) is None
+    assert abc_l.timeline.summary()["history_mode"] == "lazy"
+    assert abc_e.timeline.summary()["history_mode"] == "eager"
+
+
+def test_fused_lazy_bit_identical(db_path):
+    abc_e = _run("eager", fuse_generations=3, ingest_mode="sequential")
+    abc_l = _run("lazy", fuse_generations=3, ingest_mode="sequential",
+                 db="sqlite:///" + db_path)
+    _assert_bit_identical(abc_e.history, abc_l.history, "fused")
+    # a fresh History on the same file sees the same bits (the durable
+    # fallback every resumed/offline reader takes)
+    h2 = pt.History("sqlite:///" + db_path, abc_id=abc_l.history.id)
+    _assert_bit_identical(abc_e.history, h2, "fused/reload")
+
+
+def test_pipelined_lazy_bit_identical():
+    abc_e = _run("eager", fuse_generations=2, ingest_mode="overlap")
+    abc_l = _run("lazy", fuse_generations=2, ingest_mode="overlap")
+    _assert_bit_identical(abc_e.history, abc_l.history, "pipelined")
+
+
+@pytest.mark.slow
+def test_lazy_bit_identical_pop1e4():
+    """The ISSUE acceptance gate at the specified scale."""
+    abc_e = _run("eager", pop=10_000, gens=4, fuse_generations=3,
+                 ingest_mode="sequential")
+    abc_l = _run("lazy", pop=10_000, gens=4, fuse_generations=3,
+                 ingest_mode="sequential")
+    _assert_bit_identical(abc_e.history, abc_l.history, "pop1e4")
+
+
+def test_eviction_pressure_falls_back_bit_identically(monkeypatch):
+    """Ring capacity 1 under a 3-generation fused block: every block
+    spills two generations to the durable queue mid-flight — results
+    must not change by a bit."""
+    monkeypatch.setenv(wire_store.STORE_GENS_ENV, "1")
+    abc_l = _run("lazy", fuse_generations=3, ingest_mode="sequential")
+    monkeypatch.delenv(wire_store.STORE_GENS_ENV)
+    abc_e = _run("eager", fuse_generations=3, ingest_mode="sequential")
+    _assert_bit_identical(abc_e.history, abc_l.history, "evicted")
+
+
+def test_env_default_and_validation(monkeypatch):
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    monkeypatch.setenv(wire_store.HISTORY_MODE_ENV, "eager")
+    abc = pt.ABCSMC(models, priors, distance, population_size=64)
+    assert abc.history_mode == "eager"
+    monkeypatch.delenv(wire_store.HISTORY_MODE_ENV)
+    abc = pt.ABCSMC(models, priors, distance, population_size=64)
+    assert abc.history_mode == "lazy"  # the PR's default
+    with pytest.raises(ValueError, match="history_mode"):
+        pt.ABCSMC(models, priors, distance, population_size=64,
+                  history_mode="nope")
+
+
+# ---------------------------------------------------------------------------
+# steady-state egress: the wire is dead
+# ---------------------------------------------------------------------------
+
+def test_steady_state_population_egress_ratio(monkeypatch):
+    """Per-generation growth of the population egress bucket: eager
+    ships the full accepted population every generation; lazy ships
+    summary packets (population growth ZERO after calibration).  The
+    contract is >= 10x; measured growth under lazy is 0 bytes/gen."""
+    monkeypatch.setenv("PYABC_TPU_LAZY_FINAL_ONLY", "1")
+
+    def growth(mode):
+        per_run = []
+        for gens in (2, 5):
+            b0 = dict(transfer.egress_breakdown())
+            _run(mode, pop=512, gens=gens, fuse_generations=3,
+                 ingest_mode="sequential")
+            b1 = transfer.egress_breakdown()
+            per_run.append({k: b1[k] - b0.get(k, 0) for k in b1})
+        short, long_ = per_run
+        return {k: (long_[k] - short[k]) / 3.0 for k in long_}
+
+    eager = growth("eager")
+    lazy = growth("lazy")
+    assert eager["population"] > 0
+    ratio = eager["population"] / max(lazy["population"], 1.0)
+    assert ratio >= 10, (eager, lazy)
+    # the generations still talk — in O(KB) summary packets
+    assert 0 < lazy["summary"] < eager["population"] / 10
+    # hydrated fetches book egress("history"), never population
+    assert lazy["history"] >= 0
+
+
+def test_egress_sum_invariant_holds_in_lazy_mode():
+    """Every byte still lands in exactly one bucket when the store
+    re-routes population traffic (the fleet-telemetry invariant must
+    survive the new labels)."""
+    from pyabc_tpu.telemetry import REGISTRY
+    total_key = "wire_d2h_bytes_total"
+    t0 = REGISTRY.to_dict().get(total_key, 0)
+    b0 = dict(transfer.egress_breakdown())
+    _run("lazy", pop=256, gens=3, fuse_generations=3,
+         ingest_mode="sequential")
+    delta_total = REGISTRY.to_dict().get(total_key, 0) - t0
+    b1 = transfer.egress_breakdown()
+    delta_sum = sum(b1[k] - b0.get(k, 0) for k in b1)
+    assert delta_total > 0
+    assert delta_sum == delta_total
+
+
+# ---------------------------------------------------------------------------
+# resilience: manifest-only ledger rows + the preemption anchor
+# ---------------------------------------------------------------------------
+
+def test_manifest_flush_and_preemption_anchor(db_path):
+    """Steady-state ledger flushes in lazy mode are manifest-only (zero
+    raw bytes); an actual preemption persists the device-resident tail
+    newest-first and raises Preempted with a durable resume anchor."""
+    from pyabc_tpu.resilience import checkpoint as ckpt
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=256,
+                    sampler=pt.VectorizedSampler(), seed=11,
+                    history_mode="lazy", ingest_mode="sequential")
+    h = abc.new("sqlite:///" + db_path, observed)
+    abc.run(max_nr_populations=2)
+
+    store = abc._store
+    assert store is not None
+    # park a synthetic ledger: cadence flush with a live manifest source
+    ck = ckpt.GenCheckpointer(h, t=9, every_rounds=1, eps=0.5)
+    ck.manifest_source = store.manifest
+    assert not ck.raw_required()
+    ck.flush_manifest(rounds=3, nr_evaluations=1000)
+    assert h.load_sub_checkpoint(9) is None  # no raw rows to splice
+    man = h.load_sub_checkpoint_manifest(9)
+    assert man is not None and man["max_gens"] >= 1
+
+    # preemption: raw becomes required and the lazy tail goes durable
+    ckpt.clear_preempt()
+    ckpt.request_preempt()
+    try:
+        assert ck.raw_required()
+        with pytest.raises(ckpt.Preempted):
+            ck.maybe_raise_preempted()
+    finally:
+        ckpt.clear_preempt()
+    # persist_lazy_tail ran: nothing summary-only is left to purge, and
+    # a resumed process anchors on the full run
+    h2 = pt.History("sqlite:///" + db_path, abc_id=h.id)
+    h2.purge_stale_lazy()
+    assert h2.max_t == h.max_t
+    h.clear_sub_checkpoint(9)
+
+
+def test_resume_purges_unhydratable_summary_rows(db_path):
+    """A lazy row whose device store died with its process cannot be
+    hydrated; ABCSMC.load must purge it so max_t anchors on durable
+    blobs and the run regenerates from there."""
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=128,
+                    sampler=pt.VectorizedSampler(), seed=3,
+                    history_mode="lazy", ingest_mode="sequential")
+    h = abc.new("sqlite:///" + db_path, observed)
+    abc.run(max_nr_populations=2)
+    max_t = h.max_t
+    # forge the crash artifact: a summary-only row for a generation
+    # whose wire never left the (now dead) device
+    h._conn.execute(
+        "INSERT INTO populations (abc_smc_id, t, epsilon, nr_samples,"
+        " population_end_time, lazy, summary) VALUES (?,?,?,?,?,1,?)",
+        (h.id, max_t + 1, 0.1, 999, "x",
+         json.dumps({"ess": 1.0, "model_w": [1.0]})))
+    h._conn.commit()
+    assert h.max_t == max_t + 1
+
+    abc2 = pt.ABCSMC(models, priors, distance, population_size=128,
+                     sampler=pt.VectorizedSampler(), seed=4,
+                     history_mode="lazy", ingest_mode="sequential")
+    h2 = abc2.load("sqlite:///" + db_path)
+    assert h2.max_t == max_t  # stale summary row purged on load

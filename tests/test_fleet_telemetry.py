@@ -320,6 +320,11 @@ def test_egress_accounts_for_every_d2h_byte():
         # the checkpoint-labeled fetches exercise alongside population
         sampler=pt.VectorizedSampler(min_batch_size=8, max_batch_size=64,
                                      max_rounds_per_call=1),
+        # eager pin: this test asserts population AND checkpoint bytes
+        # flow; lazy mode re-routes population to history/summary and
+        # makes the ledger flushes manifest-only (zero raw bytes) —
+        # the lazy-mode attribution is covered by test_device_store.py
+        history_mode="eager",
         seed=13, checkpoint_every_rounds=1)
     abc.new("sqlite://", observed)
     abc.run(max_nr_populations=2)

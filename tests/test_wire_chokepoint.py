@@ -53,3 +53,28 @@ def test_cli_exit_codes(tmp_path, capsys):
     (pkg / "leak.py").write_text("jax.device_get(y)\n")
     assert mod.main([str(pkg)]) == 1
     assert "leak.py:1" in capsys.readouterr().out
+
+
+def test_egress_label_lint(tmp_path):
+    """A typo'd egress("...") label books bytes to an unwatched bucket;
+    the lint flags it everywhere, INCLUDING the allowlisted wire/."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "wire").mkdir(parents=True)
+    (pkg / "wire" / "store.py").write_text(
+        'with egress("histroy"):\n    pass\n')
+    (pkg / "ok.py").write_text(
+        'with egress("history"):\n    pass\n'
+        'with egress(label):\n    pass\n')  # non-literal: out of scope
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [
+        ("wire/store.py", 1)]
+
+
+def test_egress_label_list_matches_ledger():
+    """The lint's literal EGRESS_SUBSYSTEMS mirror must not drift from
+    the real ledger's (wire/transfer.py)."""
+    from pyabc_tpu.wire import transfer
+    mod = _load()
+    assert tuple(mod.EGRESS_SUBSYSTEMS) == tuple(
+        transfer.EGRESS_SUBSYSTEMS)

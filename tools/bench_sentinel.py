@@ -54,11 +54,21 @@ WATCHED = (
     ("northstar_pop1e6_wallclock_s_per_gen", "lower", 0.25),
     ("fused_northstar_s_per_gen", "lower", 0.25),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
+    # steady-state population egress (wire/store.py lazy History):
+    # lower is better — a jump back toward full-population d2h means
+    # the device-resident store stopped carrying the hot path
+    ("telemetry_egress_population_mb", "lower", 0.25),
     ("resilience_retries", "zero", 0.0),
 )
 
 #: seconds-per-gen rows below this are timer noise, not signal
 _SECONDS_FLOOR = 0.05
+
+#: absolute slack for the _mb rows: with a lazy-History reference the
+#: population-egress median is ~0, and a pure relative limit would flag
+#: kilobyte-scale jitter; a regression back to eager-scale traffic
+#: (MBs) still clears this slack by orders of magnitude
+_MB_SLACK = 0.5
 
 #: prior captures: newest-last glob in the repo root
 _TRAJECTORY_GLOB = "BENCH_*.json"
@@ -147,9 +157,10 @@ def compare(new: dict, ref: dict, baseline_rate=None) -> list:
         if not isinstance(rv, (int, float)):
             continue  # no trajectory for this row yet
         if direction == "lower":
-            if rv < _SECONDS_FLOOR:
+            is_mb = key.endswith("_mb")
+            if not is_mb and rv < _SECONDS_FLOOR:
                 continue  # sub-noise-floor timings carry no signal
-            limit = rv * (1.0 + tol)
+            limit = rv * (1.0 + tol) + (_MB_SLACK if is_mb else 0.0)
             if nv > limit:
                 fails.append((key, nv, round(limit, 4),
                               f"> median-of-{_N_PRIOR} ref {rv:.4g} "

@@ -19,6 +19,13 @@ Checks over every ``pyabc_tpu/**/*.py`` outside the allowlist
   ``dev_``, or ``.addressable_shards`` access) — ``np.asarray`` on a
   jax Array is an implicit, unledgered transfer.
 
+A second, package-wide check (allowlist included — the wire itself
+must label its own traffic correctly): every literal
+``egress("<label>")`` attribution must use a label from the ledger's
+``EGRESS_SUBSYSTEMS`` — a typo'd label books bytes to a bucket no
+dashboard or sentinel watches, which is the same silent-under-report
+failure through the front door.
+
 Suppress a deliberate exception with a ``# wire-ok`` comment on the
 same line (none exist today; a new one should come with a review
 argument for why the ledger may miss it).
@@ -46,6 +53,14 @@ _ASARRAY_DEVICE = re.compile(
     r"np\.asarray\(\s*(?:\w+_dev\b|dev_\w+|\w+(?:\.\w+)*"
     r"\.addressable_shards)")
 
+#: must mirror pyabc_tpu/wire/transfer.py:EGRESS_SUBSYSTEMS — kept as a
+#: literal so the lint runs without importing (and thus initializing)
+#: jax; drift is caught by the wrapper test comparing the two tuples
+EGRESS_SUBSYSTEMS = ("population", "history", "checkpoint", "summary",
+                     "control", "other")
+# literal-label egress attribution: egress("...") / egress('...')
+_EGRESS_CALL = re.compile(r"\begress\(\s*([\"'])([^\"']*)\1")
+
 
 def _package_root(root: str = None) -> str:
     if root is not None:
@@ -65,14 +80,20 @@ def check(root: str = None) -> list:
                 continue
             path = os.path.join(dirpath, name)
             rel = os.path.relpath(path, root).replace(os.sep, "/")
-            if rel in ALLOWLIST_FILES or rel.startswith(
-                    ALLOWLIST_PREFIXES):
-                continue
+            allowlisted = (rel in ALLOWLIST_FILES
+                           or rel.startswith(ALLOWLIST_PREFIXES))
             with open(path, encoding="utf-8") as f:
                 for lineno, line in enumerate(f, 1):
                     if SUPPRESS in line:
                         continue
                     code = line.split("#", 1)[0]
+                    # label lint runs EVERYWHERE (wire/ included)
+                    m = _EGRESS_CALL.search(code)
+                    if m and m.group(2) not in EGRESS_SUBSYSTEMS:
+                        violations.append((rel, lineno, line.rstrip()))
+                        continue
+                    if allowlisted:
+                        continue
                     if _DEVICE_GET.search(code) \
                             or _ASARRAY_DEVICE.search(code):
                         violations.append((rel, lineno, line.rstrip()))
