@@ -224,6 +224,13 @@ def bench_primary():
             "resilience_retries_total", 0)),
         "checkpoint_s_per_gen": round(REGISTRY.to_dict().get(
             "resilience_checkpoint_seconds_total", 0.0) / n_gens, 4),
+        # durability-contract bill: the spill journal must stay O(KB)
+        # on a healthy run (manifests + in-flight payloads only), and
+        # integrity checks are the hydration count — zero failures
+        "resilience_journal_mb": round(float(REGISTRY.to_dict().get(
+            "resilience_journal_mb", 0.0)), 4),
+        "store_integrity_checks": int(REGISTRY.to_dict().get(
+            "store_integrity_checks_total", 0)),
         # d2h egress attribution (wire/transfer.py): on a healthy bench
         # run nearly all egress is population bytes; growth in the other
         # subsystems means the hot loop started paying for side traffic
@@ -672,7 +679,7 @@ def main():
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
                                 "posterior_gate_", "telemetry_",
-                                "resilience_", "checkpoint_"))
+                                "resilience_", "checkpoint_", "store_"))
                and not isinstance(v, (list, dict))}
     print(json.dumps({**header, "extra": compact}))
 

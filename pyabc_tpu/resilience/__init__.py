@@ -1,11 +1,12 @@
-"""pyabc_tpu.resilience: fault injection, retry, and sub-checkpointing.
+"""pyabc_tpu.resilience: fault injection, retry, checkpointing, and the
+crash-consistent spill journal.
 
 The robustness leg of the north star ("production-scale ... handles as
 many scenarios as you can imagine"), next to the perf (autotune/, wire/)
 and observability (telemetry/) legs:
 
 - :mod:`~pyabc_tpu.resilience.faults` — deterministic, seeded fault
-  injection at the hot loop's five named chokepoints
+  injection at the hot loop's named chokepoints
   (``PYABC_TPU_FAULTS``), so chaos tests are reproducible;
 - :mod:`~pyabc_tpu.resilience.retry` — bounded exponential-backoff
   retry wrapping every device dispatch and the d2h chokepoint, with
@@ -14,17 +15,27 @@ and observability (telemetry/) legs:
 - :mod:`~pyabc_tpu.resilience.checkpoint` — mid-generation
   sub-checkpointing: a round-granular accepted-particle ledger flushed
   to the History, so a SIGTERM mid-generation loses at most one flush
-  interval instead of the whole generation.
+  interval instead of the whole generation;
+- :mod:`~pyabc_tpu.resilience.journal` — the lazy History's durability
+  contract: an append-only fsync'd CRC-framed write-ahead journal for
+  device-resident generations, per-generation content digests verified
+  on every hydration (typed :class:`IntegrityError` + recovery ladder),
+  and crash recovery that REPLAYS what a kill stranded instead of
+  discarding it.
 
 See docs/resilience.md for the operator-facing guide.
 """
 
-from . import checkpoint, faults, retry  # noqa: F401
+from . import checkpoint, faults, journal, retry  # noqa: F401
 from .checkpoint import GenCheckpointer, Preempted
 from .faults import (FAULTS_ENV, SITE_APPEND, SITE_DISPATCH, SITE_FETCH,
-                     SITE_HEARTBEAT, SITE_PREEMPT, SITES, FaultPlan,
-                     FaultSpec, active_plan, fault_point, install,
-                     install_from_env, uninstall)
+                     SITE_HEARTBEAT, SITE_JOURNAL, SITE_MATERIALIZE,
+                     SITE_PREEMPT, SITE_STORE_DEPOSIT, SITE_STORE_HYDRATE,
+                     SITE_STORE_SPILL, SITES, FaultPlan, FaultSpec,
+                     active_plan, fault_point, install, install_from_env,
+                     uninstall)
+from .journal import (IntegrityError, SpillJournal, digest_wire,
+                      journal_for_history, verify_wire)
 from .retry import (RetryExhausted, RetryPolicy, is_transient,
                     retry_counters, shared_policy)
 
@@ -36,8 +47,11 @@ __all__ = [
     "FaultPlan", "FaultSpec", "active_plan", "fault_point", "install",
     "install_from_env", "uninstall", "FAULTS_ENV", "SITES",
     "SITE_DISPATCH", "SITE_FETCH", "SITE_APPEND", "SITE_HEARTBEAT",
-    "SITE_PREEMPT",
+    "SITE_PREEMPT", "SITE_STORE_DEPOSIT", "SITE_STORE_SPILL",
+    "SITE_STORE_HYDRATE", "SITE_MATERIALIZE", "SITE_JOURNAL",
     "RetryPolicy", "RetryExhausted", "is_transient", "shared_policy",
     "retry_counters",
     "GenCheckpointer", "Preempted",
+    "SpillJournal", "IntegrityError", "digest_wire", "verify_wire",
+    "journal_for_history",
 ]

@@ -242,15 +242,20 @@ class GenCheckpointer:
         platform's kill timeout."""
         if preempt_requested():
             # lazy-History runs: previous generations may still be
-            # device-resident summary rows — anchor them (newest first)
-            # before the process exits, or the resume purges them
+            # device-resident summary rows — anchor them before the
+            # process exits, or the resume purges them.  The persist is
+            # a bounded-deadline barrier ($PYABC_TPU_PREEMPT_DEADLINE_S)
+            # that journals the packed bytes FIRST (newest-first, cheap
+            # fsync'd appends) and only then materializes best-effort —
+            # a second kill mid-flush still leaves a replayable journal
             persist = getattr(self.history, "persist_lazy_tail", None)
             if persist is not None:
                 try:
                     persist()
                 except Exception:
                     logger.exception("lazy-tail persist on preemption "
-                                     "failed; resume will regenerate")
+                                     "failed; resume replays the "
+                                     "journal or regenerates")
             raise Preempted(
                 f"preemption signal during generation {self.t}; "
                 f"sub-checkpoint flushed through round "

@@ -22,6 +22,16 @@ Fault sites (the constants below, one per chokepoint):
 - ``preempt``         — polled once per device call by the sampler
   loop; the ``sigterm`` action here simulates a preemption notice
   mid-generation (resilience/checkpoint.py)
+- ``store.deposit``   — ``wire.store.DeviceRunStore.deposit``, the
+  lazy path's acknowledge point
+- ``store.spill``     — ring eviction fetching an at-risk generation
+  to the host + write-ahead journal
+- ``store.hydrate``   — ``wire.store.hydrate_entry`` decoding a
+  generation back into a Population (data hook: the fetched host wire)
+- ``history.materialize`` — ``storage.history`` turning a lazy row
+  into durable blobs (spill drain / reader hydration)
+- ``journal.write``   — every ``resilience.journal.SpillJournal``
+  append (data hook: the framed record bytes)
 
 Plan grammar (semicolon-separated directives)::
 
@@ -29,11 +39,17 @@ Plan grammar (semicolon-separated directives)::
     site@N+:action    fire at every visit >= N
     site~P:action     fire with probability P per visit (seeded RNG)
 
-    action := raise=ExcName | delay=SECONDS | sigterm
+    action := raise=ExcName | delay=SECONDS | sigterm | sigkill
+            | corrupt=N
 
 e.g. ``PYABC_TPU_FAULTS="wire.fetch@3:raise=ConnectionResetError;``
 ``preempt@5:sigterm"``.  Exception names resolve against builtins plus
-a small registry (``OperationalError``, ``WireError``).
+a small registry (``OperationalError``, ``WireError``).  ``sigkill``
+delivers an uncatchable ``SIGKILL`` to the process (subprocess chaos
+tests only).  ``corrupt=N`` flips N bits (deterministically, from the
+plan seed) in the data passing through the site — only sites that hand
+bytes to :func:`fault_point` via ``data=`` can corrupt; elsewhere it
+degrades to a no-op visit.
 
 Disabled cost: :func:`fault_point` is one module-global load and a
 ``None`` check (the same pattern as the telemetry tracer's ``_NULL``
@@ -54,10 +70,16 @@ SITE_FETCH = "wire.fetch"
 SITE_APPEND = "history.append"
 SITE_HEARTBEAT = "heartbeat.write"
 SITE_PREEMPT = "preempt"
+SITE_STORE_DEPOSIT = "store.deposit"
+SITE_STORE_SPILL = "store.spill"
+SITE_STORE_HYDRATE = "store.hydrate"
+SITE_MATERIALIZE = "history.materialize"
+SITE_JOURNAL = "journal.write"
 
 #: every named fault site, for validation and docs
 SITES = (SITE_DISPATCH, SITE_FETCH, SITE_APPEND, SITE_HEARTBEAT,
-         SITE_PREEMPT)
+         SITE_PREEMPT, SITE_STORE_DEPOSIT, SITE_STORE_SPILL,
+         SITE_STORE_HYDRATE, SITE_MATERIALIZE, SITE_JOURNAL)
 
 FAULTS_ENV = "PYABC_TPU_FAULTS"
 FAULT_SEED_ENV = "PYABC_TPU_FAULT_SEED"
@@ -100,7 +122,8 @@ class FaultSpec:
                 f"unknown fault site {site!r} (valid: {', '.join(SITES)})")
         if mode not in ("at", "from", "prob"):
             raise ValueError(f"unknown trigger mode {mode!r}")
-        if action not in ("raise", "delay", "sigterm"):
+        if action not in ("raise", "delay", "sigterm", "sigkill",
+                          "corrupt"):
             raise ValueError(f"unknown fault action {action!r}")
         self.site = site
         self.mode = mode
@@ -140,8 +163,17 @@ class FaultSpec:
                        _resolve_exception(val.strip()))
         if kind == "delay":
             return cls(site.strip(), mode, arg, "delay", float(val))
-        if kind == "sigterm":
-            return cls(site.strip(), mode, arg, "sigterm")
+        if kind in ("sigterm", "sigkill"):
+            if val.strip():
+                raise ValueError(
+                    f"{kind} takes no argument in {text!r}")
+            return cls(site.strip(), mode, arg, kind)
+        if kind == "corrupt":
+            nbits = int(val) if val.strip() else 1
+            if nbits < 1:
+                raise ValueError(
+                    f"corrupt=N needs N >= 1 in {text!r}")
+            return cls(site.strip(), mode, arg, "corrupt", nbits)
         raise ValueError(f"unknown fault action in {text!r}")
 
     def fires(self, visit: int, rng: random.Random) -> bool:
@@ -196,8 +228,9 @@ class FaultPlan:
         with self._lock:
             return self._visits.get(site, 0)
 
-    def visit(self, site: str):
-        """Count one visit of ``site`` and run any triggered actions.
+    def visit(self, site: str, data=None):
+        """Count one visit of ``site``, run any triggered actions, and
+        return ``data`` (bit-flipped if a ``corrupt`` spec fired).
 
         The trigger decision happens under the plan lock (deterministic
         counters even with background ingest threads); the action runs
@@ -226,9 +259,29 @@ class FaultPlan:
                 # asynchronous SIGTERM into a flush + clean Preempted
                 import signal
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif spec.action == "sigkill":
+                # uncatchable by design: the process dies HERE, and the
+                # durability contract is whatever already hit the disk
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(60)  # pragma: no cover - death is imminent
+            elif spec.action == "corrupt":
+                corrupted = _corrupt(
+                    data, spec.action_arg,
+                    seed=(self.seed + 1) * 9176 + visit)
+                if corrupted is not None:
+                    data = corrupted
             else:
-                raise spec.action_arg(
-                    f"injected fault at {site} (visit {visit})")
+                message = f"injected fault at {site} (visit {visit})"
+                import sqlite3
+                if spec.action_arg is sqlite3.OperationalError:
+                    # the realistic TRANSIENT sqlite failure — carries
+                    # the marker retry.is_transient classifies on, so
+                    # the injection tests the retry path, not the
+                    # fatal-error path
+                    message = "database is locked; " + message
+                raise spec.action_arg(message)
+        return data
 
 
 #: the installed plan; ``None`` = injection disabled (the hot-path
@@ -261,10 +314,50 @@ def install_from_env() -> Optional[FaultPlan]:
     return plan
 
 
-def fault_point(site: str):
+def _corrupt(data, nbits: int, seed: int):
+    """Flip ``nbits`` bits in ``data`` (bytes/bytearray, a numpy array,
+    or a dict of numpy arrays) deterministically from ``seed``.
+    Returns the corrupted copy, or ``None`` when the site passed no
+    corruptible data (the visit still counts; nothing else happens)."""
+    import numpy as np
+    rng = random.Random(seed)
+
+    def _flip_bytes(buf: bytes) -> bytes:
+        if not buf:
+            return buf
+        out = bytearray(buf)
+        for _ in range(nbits):
+            i = rng.randrange(len(out))
+            out[i] ^= 1 << rng.randrange(8)
+        return bytes(out)
+
+    def _flip_array(arr: "np.ndarray") -> "np.ndarray":
+        raw = _flip_bytes(arr.tobytes())
+        return (np.frombuffer(raw, dtype=arr.dtype)
+                .reshape(arr.shape).copy())  # writable, like the original
+
+    if isinstance(data, (bytes, bytearray)):
+        return _flip_bytes(bytes(data))
+    if isinstance(data, np.ndarray):
+        return _flip_array(data)
+    if isinstance(data, dict) and data:
+        keys = [k for k in sorted(data)
+                if isinstance(data[k], np.ndarray) and data[k].size]
+        if not keys:
+            return None
+        out = dict(data)
+        k = keys[rng.randrange(len(keys))]
+        out[k] = _flip_array(np.asarray(out[k]))
+        return out
+    return None
+
+
+def fault_point(site: str, data=None):
     """The hook every instrumented chokepoint calls.  No-op (one global
-    load + ``None`` check) unless a plan is installed."""
+    load + ``None`` check) unless a plan is installed.  Sites that move
+    bytes pass them via ``data`` and MUST use the return value — that
+    is how ``corrupt=N`` plans inject bit rot."""
     plan = _PLAN
     if plan is None:
-        return
-    plan.visit(site)
+        return data
+    return plan.visit(site, data)

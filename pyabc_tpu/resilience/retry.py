@@ -84,6 +84,12 @@ def is_transient(err: BaseException, _depth: int = 0) -> bool:
     msg = str(err).lower()
     if "donat" in msg or "buffer has been deleted" in msg:
         return False
+    from .journal import IntegrityError
+    if isinstance(err, IntegrityError):
+        # re-reading the same corrupt bytes cannot help; recovery is
+        # the History's ladder (journal re-read -> DB fallback ->
+        # degrade to eager), not a retry loop
+        return False
     from concurrent.futures import BrokenExecutor
     if isinstance(err, BrokenExecutor):
         return True

@@ -331,10 +331,12 @@ class ABCSMC:
                                stores_sum_stats=self.stores_sum_stats)
         self.x_0 = self._coerce_stats(self.history.observed_sum_stat())
         self._bind()
-        # summary-only rows from a previous process lost their device
-        # arrays with it: drop them so max_t anchors on durable blobs
-        # and the resumed loop regenerates from there
-        self.history.purge_stale_lazy()
+        # crash recovery: replay un-materialized spill-journal payloads
+        # into durable blobs (generations the previous process lost its
+        # device arrays for are RESTORED), then drop whatever is still
+        # summary-only so max_t anchors on durable blobs and the
+        # resumed loop regenerates from there
+        self.history.recover_lazy()
         return self.history
 
     def _bind(self):
@@ -376,6 +378,24 @@ class ABCSMC:
         """Lazy-History egress is armed for the bound run (wire/store.py
         tentpole): populations stay device-resident, summaries ship."""
         return self._store is not None and self.history is not None
+
+    def _degrade_lazy(self, t: int):
+        """Last rung of the integrity recovery ladder: generation ``t``
+        failed checksummed hydration beyond repair.  Drop its summary
+        row, detach the device store (the rest of the run takes the
+        eager append path), and let the caller re-run the generation."""
+        from .resilience.retry import record_degrade
+        logger.error(
+            "generation %d failed checksummed hydration beyond the "
+            "recovery ladder — degrading to eager history for the rest "
+            "of the run and re-running the generation", t)
+        record_degrade("lazy_integrity")
+        if self.history is not None:
+            self.history.drop_generation(t)
+            self.history.detach_store()
+        if self._store is not None:
+            self._store.clear()
+        self._store = None
 
     # ------------------------------------------------------------------
     # transition fitting with fixed-shape padding
@@ -2110,8 +2130,19 @@ class ABCSMC:
                 # real rows: hydrate through the store — bit-identical
                 # to the eager decode, booked under egress("history"),
                 # with the durable blobs written as a side effect
-                with _spans.span("gen.hydrate", gen=t):
-                    population = self.history.hydrate_population(t)
+                from .resilience.journal import (
+                    IntegrityError as _IntegrityError)
+                try:
+                    with _spans.span("gen.hydrate", gen=t):
+                        population = self.history.hydrate_population(t)
+                except _IntegrityError:
+                    # the recovery ladder (device re-fetch, journal
+                    # re-read) is exhausted: final rung is degrading to
+                    # eager mode and re-running this generation — a
+                    # redo costs one generation's compute, corrupt
+                    # bytes would cost the posterior
+                    self._degrade_lazy(t)
+                    continue
             ess = float(effective_sample_size(population.weight))
             now = _time.perf_counter()
             self.generation_wall_clock[t] = now - gen_mark

@@ -25,8 +25,11 @@ from __future__ import annotations
 import datetime
 import io
 import json
+import logging
 import os
 import sqlite3
+import time
+import zlib
 from typing import Dict, List, Optional, Tuple, Union
 
 import jax.numpy as jnp
@@ -36,7 +39,21 @@ import pandas as pd
 from ..population import Population
 from .bytes_storage import from_bytes, to_bytes
 
+logger = logging.getLogger("ABC.History")
+
 PRE_TIME = -1  # calibration-sample time index (reference history.py:135)
+
+#: preemption-barrier budget: persist_lazy_tail stops materializing
+#: after this many seconds (journal-first ordering means whatever was
+#: not materialized is still replayable)
+PREEMPT_DEADLINE_ENV = "PYABC_TPU_PREEMPT_DEADLINE_S"
+
+
+def _preempt_deadline_s() -> float:
+    try:
+        return float(os.environ.get(PREEMPT_DEADLINE_ENV, "30"))
+    except ValueError:
+        return 30.0
 
 
 def create_sqlite_db_id(dir_: Optional[str] = None,
@@ -81,6 +98,7 @@ CREATE TABLE IF NOT EXISTS model_populations (
     stats BLOB,
     param_names TEXT,
     stat_spec TEXT,
+    digest TEXT,
     PRIMARY KEY (abc_smc_id, t, m)
 );
 CREATE TABLE IF NOT EXISTS observed_data (
@@ -104,9 +122,16 @@ CREATE TABLE IF NOT EXISTS sub_checkpoints (
     stats BLOB,
     created TEXT,
     manifest TEXT,
+    digest TEXT,
     PRIMARY KEY (abc_smc_id, t)
 );
 """
+
+
+def _blob_crc(blob: Optional[bytes]) -> Optional[int]:
+    if blob is None:
+        return None
+    return zlib.crc32(blob) & 0xFFFFFFFF
 
 
 def _pack(arr: np.ndarray) -> bytes:
@@ -176,6 +201,59 @@ class History:
         #: device-resident population store (wire/store.py) this run's
         #: lazy generations live in; attached by the orchestrator
         self._store = None
+        #: write-ahead SpillJournal (resilience/journal.py) — created on
+        #: demand (lazy runs / resume recovery), never for plain eager
+        #: file DBs
+        self._journal = None
+        self._journal_armed = False
+
+    @property
+    def journal(self):
+        """The run's spill journal, created on first use (file-backed
+        DBs at ``<db>.journal``; in-memory DBs only under an explicit
+        ``$PYABC_TPU_JOURNAL_DIR``); None when journaling is off."""
+        if not self._journal_armed:
+            self._journal_armed = True
+            from ..resilience.journal import journal_for_history
+            self._journal = journal_for_history(self)
+        return self._journal
+
+    def _existing_journal(self):
+        """The journal ONLY if it is already armed or its directory
+        already exists on disk — resume recovery must find a previous
+        process's journal without creating directories for runs that
+        never journaled."""
+        if self._journal_armed:
+            return self._journal
+        from ..resilience.journal import journal_dir_for
+        d = journal_dir_for(self.db_path, self.in_memory)
+        if d and os.path.isdir(d):
+            return self.journal
+        return None
+
+    def _unpack_checked(self, blob, crc, *, t=-2, where="db.read"):
+        """``_unpack`` behind the stored-blob CRC: every read of a
+        digest-bearing row is an integrity check, and a flipped bit in
+        the database raises ``IntegrityError`` instead of decoding into
+        a silently wrong posterior."""
+        if blob is None:
+            return None
+        if crc is not None:
+            from ..resilience.journal import IntegrityError
+            from ..telemetry.metrics import REGISTRY
+            _help = "checksummed hydration; see resilience/journal.py"
+            REGISTRY.counter("store_integrity_checks_total", _help).inc()
+            if _blob_crc(blob) != int(crc):
+                REGISTRY.counter("store_integrity_failures_total",
+                                 _help).inc()
+                from ..telemetry.flight import RECORDER
+                RECORDER.note("integrity", t=int(t), where=where,
+                              detail="stored blob CRC mismatch")
+                raise IntegrityError(
+                    f"generation {t}: stored blob failed its CRC "
+                    f"({where}) — database bytes are corrupt",
+                    t=t, where=where)
+        return _unpack(blob)
 
     def _migrate(self):
         """In-place schema upgrades for databases written by older
@@ -200,11 +278,19 @@ class History:
         if "summary_grid" not in pop_cols:
             self._conn.execute(
                 "ALTER TABLE populations ADD COLUMN summary_grid BLOB")
+        mp_cols = [r[1] for r in self._conn.execute(
+            "PRAGMA table_info(model_populations)").fetchall()]
+        if "digest" not in mp_cols:
+            self._conn.execute(
+                "ALTER TABLE model_populations ADD COLUMN digest TEXT")
         ck_cols = [r[1] for r in self._conn.execute(
             "PRAGMA table_info(sub_checkpoints)").fetchall()]
         if "manifest" not in ck_cols:
             self._conn.execute(
                 "ALTER TABLE sub_checkpoints ADD COLUMN manifest TEXT")
+        if "digest" not in ck_cols:
+            self._conn.execute(
+                "ALTER TABLE sub_checkpoints ADD COLUMN digest TEXT")
 
     # ---- run registration ------------------------------------------------
 
@@ -301,18 +387,26 @@ class History:
             if idx.size == 0:
                 continue
             names_m = (param_names[m] if per_model_names else param_names)
+            blobs = {
+                "theta": _pack(theta[idx]), "weight": _pack(w[idx]),
+                "distance": _pack(d[idx]),
+                "stats": _pack(stats[idx]) if stats is not None else None,
+            }
+            digest = json.dumps({k: _blob_crc(v)
+                                 for k, v in blobs.items()
+                                 if v is not None})
             self._conn.execute(
                 "INSERT OR REPLACE INTO model_populations (abc_smc_id,"
                 " t, m, name, p_model, n_particles, theta, weight,"
-                " distance, stats, param_names, stat_spec) VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                " distance, stats, param_names, stat_spec, digest)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (self.id, t, m, model_names[m], float(probs[m]),
                  int(idx.size),
-                 _pack(theta[idx]), _pack(w[idx]), _pack(d[idx]),
-                 _pack(stats[idx]) if stats is not None else None,
+                 blobs["theta"], blobs["weight"], blobs["distance"],
+                 blobs["stats"],
                  json.dumps(list(names_m or [])),
                  json.dumps({k: list(v) for k, v in stat_spec.items()})
-                 if stat_spec else None))
+                 if stat_spec else None, digest))
         # the generation is durable in the same transaction, so its
         # mid-generation ledger row (if any) is obsolete
         self._conn.execute(
@@ -341,25 +435,35 @@ class History:
         from ..resilience import retry as _retry
 
         def _write():
+            blobs = {
+                "m": _pack(batch["m"]) if batch is not None else None,
+                "theta": _pack(batch["theta"])
+                if batch is not None else None,
+                "distance": _pack(batch["distance"])
+                if batch is not None else None,
+                "log_weight": _pack(batch["log_weight"])
+                if batch is not None else None,
+                "stats": _pack(batch["stats"])
+                if batch is not None and batch.get("stats") is not None
+                else None,
+            }
+            digest = json.dumps({k: _blob_crc(v)
+                                 for k, v in blobs.items()
+                                 if v is not None})
             self._conn.execute(
                 "INSERT OR REPLACE INTO sub_checkpoints (abc_smc_id, t,"
                 " rounds, n_accepted, nr_evaluations, eps, m, theta,"
-                " distance, log_weight, stats, created, manifest)"
-                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                " distance, log_weight, stats, created, manifest,"
+                " digest) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (self.id, int(t), int(rounds),
                  int(batch["m"].shape[0]) if batch is not None else 0,
                  int(nr_evaluations),
                  float(eps) if eps is not None else None,
-                 _pack(batch["m"]) if batch is not None else None,
-                 _pack(batch["theta"]) if batch is not None else None,
-                 _pack(batch["distance"]) if batch is not None else None,
-                 _pack(batch["log_weight"]) if batch is not None
-                 else None,
-                 _pack(batch["stats"])
-                 if batch is not None and batch.get("stats") is not None
-                 else None,
+                 blobs["m"], blobs["theta"], blobs["distance"],
+                 blobs["log_weight"], blobs["stats"],
                  datetime.datetime.now().isoformat(),
-                 json.dumps(manifest) if manifest is not None else None))
+                 json.dumps(manifest) if manifest is not None else None,
+                 digest))
             self._conn.commit()
 
         _retry.shared_policy().call(_write, _faults.SITE_APPEND)
@@ -373,14 +477,28 @@ class History:
         nothing to splice."""
         row = self._conn.execute(
             "SELECT rounds, n_accepted, nr_evaluations, eps, m, theta,"
-            " distance, log_weight, stats FROM sub_checkpoints"
+            " distance, log_weight, stats, digest FROM sub_checkpoints"
             " WHERE abc_smc_id=? AND t=?", (self.id, int(t))).fetchone()
         if row is None or row[4] is None:
             return None
-        batch = {"m": _unpack(row[4]), "theta": _unpack(row[5]),
-                 "distance": _unpack(row[6]), "log_weight": _unpack(row[7])}
+        crcs = json.loads(row[9]) if row[9] else {}
+        batch = {
+            "m": self._unpack_checked(
+                row[4], crcs.get("m"), t=t, where="checkpoint.splice"),
+            "theta": self._unpack_checked(
+                row[5], crcs.get("theta"), t=t,
+                where="checkpoint.splice"),
+            "distance": self._unpack_checked(
+                row[6], crcs.get("distance"), t=t,
+                where="checkpoint.splice"),
+            "log_weight": self._unpack_checked(
+                row[7], crcs.get("log_weight"), t=t,
+                where="checkpoint.splice"),
+        }
         if row[8] is not None:
-            batch["stats"] = _unpack(row[8])
+            batch["stats"] = self._unpack_checked(
+                row[8], crcs.get("stats"), t=t,
+                where="checkpoint.splice")
         return {"rounds": int(row[0]), "n_accepted": int(row[1]),
                 "nr_evaluations": int(row[2]),
                 "eps": float(row[3]) if row[3] is not None else None,
@@ -418,6 +536,26 @@ class History:
 
     def attach_store(self, store):
         self._store = store
+        # arm the durability contract: deposits/evictions write ahead
+        # into the journal this History will truncate after commits
+        store.attach_journal(self.journal)
+
+    def detach_store(self):
+        """Degrade-to-eager rung: the orchestrator abandons lazy mode
+        mid-run; subsequent appends take the durable eager path."""
+        self._store = None
+
+    def drop_generation(self, t: int):
+        """Delete generation ``t``'s rows entirely (the degrade ladder
+        re-runs the generation; its summary row must not shadow the
+        eager re-append)."""
+        self._conn.execute(
+            "DELETE FROM populations WHERE abc_smc_id=? AND t=?",
+            (self.id, int(t)))
+        self._conn.execute(
+            "DELETE FROM model_populations WHERE abc_smc_id=? AND t=?",
+            (self.id, int(t)))
+        self._conn.commit()
 
     def append_population_lazy(self, t: int, current_epsilon: float,
                                nr_simulations: int, *, summary: dict,
@@ -512,16 +650,74 @@ class History:
             int(t), eps, pop, nr, names, param_names, spec,
             summary_json=summary_json,
             summary_grid=grid_row[0] if grid_row else None)
+        # the sqlite commit above is the durability point: only now may
+        # the journal forget this generation (truncate-behind)
+        self._journal_done(int(t))
+
+    def _journal_done(self, t: int):
+        journal = self._journal if self._journal_armed else None
+        if journal is not None and journal.has_payload(t):
+            journal.mark_materialized(t)
+
+    def _hydrate_checked(self, t: int, entry: dict):
+        """``hydrate_entry`` behind the recovery ladder.  On
+        ``IntegrityError``: (1) a corrupt journaled host copy is dropped
+        and the decode retried from the still-resident device wire;
+        (2) the journal's own copy of the generation is re-read and
+        decoded; then the error propagates for the caller's DB-fallback
+        / degrade-to-eager rung."""
+        from ..resilience.journal import IntegrityError
+        from ..telemetry.metrics import REGISTRY
+        from ..wire.store import hydrate_entry
+        _help = "hydration recovery ladder; see resilience/journal.py"
+        try:
+            return hydrate_entry(entry)
+        except IntegrityError as first:
+            logger.warning("generation %d failed checksummed hydration "
+                           "(%s) — walking the recovery ladder", t,
+                           first)
+            if entry.get("host_wire") is not None \
+                    and entry.get("wire") is not None:
+                retry_entry = dict(entry)
+                retry_entry.pop("host_wire", None)
+                if retry_entry.get("digest"):
+                    retry_entry["digest"] = dict(
+                        retry_entry["digest"], crc=None)
+                try:
+                    pop = hydrate_entry(retry_entry)
+                    REGISTRY.counter(
+                        "store_integrity_recovered_total", _help).inc()
+                    return pop
+                except IntegrityError:
+                    pass
+            journal = self._journal if self._journal_armed else None
+            if journal is not None and journal.has_payload(t):
+                try:
+                    jentry = journal.pending().get(int(t))
+                    if jentry is not None:
+                        pop = hydrate_entry(jentry)
+                        REGISTRY.counter(
+                            "store_integrity_recovered_total",
+                            _help).inc()
+                        return pop
+                except IntegrityError:
+                    pass
+            raise
 
     def _drain_spills(self):
         """Materialize entries the store's ring evicted (deposits happen
         on ingest worker threads; the durable write happens here, on the
-        connection's thread)."""
+        connection's thread).  Each entry materializes under its own
+        retry (``history.materialize`` fault site) — a failure requeues
+        THAT entry (``store_spill_requeued_total``) and the drain moves
+        on, so one bad entry can no longer drop the rest of the batch
+        on the floor."""
         store = self._store
         if store is None:
             return
-        from ..telemetry.metrics import REGISTRY
-        from ..wire.store import hydrate_entry
+        from ..resilience import faults as _faults
+        from ..resilience import retry as _retry
+        from ..resilience.journal import IntegrityError
         requeue = []
         for entry in store.take_spills():
             t = entry["t"]
@@ -534,15 +730,32 @@ class History:
                 requeue.append(entry)
                 continue
             if not row[0]:
+                self._journal_done(t)
                 continue  # stale spill: the row is already durable
-            pop = hydrate_entry(entry)
-            if pop is None:
-                continue
-            self._materialize_pop(t, pop, row[1], row[2], row[3])
-            REGISTRY.counter("wire_store_spills_total",
-                             "evicted store entries made durable").inc()
+            try:
+                _retry.shared_policy().call(
+                    self._materialize_spill_once,
+                    _faults.SITE_MATERIALIZE, entry, row)
+            except (_retry.RetryExhausted, IntegrityError) as err:
+                logger.warning(
+                    "spill drain: generation %d not materialized (%s) "
+                    "— requeued for the next drain", t, err)
+                from ..telemetry.flight import RECORDER
+                RECORDER.note("spill_requeue", t=int(t),
+                              detail=type(err).__name__)
+                requeue.append(entry)
         if requeue:
             store.requeue_spills(requeue)
+
+    def _materialize_spill_once(self, entry: dict, row: tuple):
+        from ..telemetry.metrics import REGISTRY
+        t = entry["t"]
+        pop = self._hydrate_checked(t, entry)
+        if pop is None:
+            return
+        self._materialize_pop(t, pop, row[1], row[2], row[3])
+        REGISTRY.counter("wire_store_spills_total",
+                         "evicted store entries made durable").inc()
 
     def _materialize(self, t: int) -> bool:
         """Ensure generation ``t``'s row has real blobs.  True when the
@@ -559,12 +772,25 @@ class History:
         store = self._store
         if store is None or not store.has(int(t)):
             return False
-        pop = store.hydrate(int(t))
+        pop = self._store_hydrate(store, int(t))
         if pop is None:
             return False
         self._materialize_pop(int(t), pop, row[1], row[2], row[3])
         store.drop(int(t))
         return True
+
+    def _store_hydrate(self, store, t: int):
+        """``store.hydrate`` with the IntegrityError recovery ladder
+        behind it; an unrecoverable mismatch propagates so the
+        orchestrator can take its degrade-to-eager rung."""
+        from ..resilience.journal import IntegrityError
+        try:
+            return store.hydrate(t)
+        except IntegrityError:
+            entry = store.entry(t)
+            if entry is None:
+                raise
+            return self._hydrate_checked(t, entry)
 
     def hydrate_population(self, t: int) -> Population:
         """Round-order Population of generation ``t`` for in-run
@@ -578,7 +804,7 @@ class History:
         row = self._lazy_flag(t)
         if (store is not None and store.has(int(t)) and row is not None
                 and row[0]):
-            pop = store.hydrate(int(t))
+            pop = self._store_hydrate(store, int(t))
             if pop is not None:
                 self._materialize_pop(int(t), pop, row[1], row[2],
                                       row[3])
@@ -588,13 +814,19 @@ class History:
         return self.get_population(t)
 
     def flush_lazy(self, final_only: Optional[bool] = None,
-                   newest_first: bool = False):
+                   newest_first: bool = False,
+                   deadline: Optional[float] = None):
         """Materialize device-resident lazy generations (run end).  By
         default ALL of them — the finished DB then has full blobs for
         every generation, same as eager mode, just shipped off the
         per-generation critical path.  ``$PYABC_TPU_LAZY_FINAL_ONLY=1``
         keeps only the final generation's blobs (pure summary steady
-        state; intermediate generations stay summary rows)."""
+        state; intermediate generations stay summary rows).
+
+        ``deadline`` (absolute ``time.monotonic``) bounds the flush:
+        past it, remaining generations stay resident/journaled instead
+        of being dropped — a preemption barrier must never discard what
+        it ran out of time to materialize."""
         if final_only is None:
             final_only = os.environ.get(
                 "PYABC_TPU_LAZY_FINAL_ONLY", "0").lower() in (
@@ -610,17 +842,94 @@ class History:
             ts = ts[-1:]
         if newest_first:
             ts = list(reversed(ts))
+        timed_out = False
         for t in ts:
+            if deadline is not None and time.monotonic() >= deadline:
+                timed_out = True
+                logger.warning(
+                    "lazy flush: deadline hit with %d generation(s) "
+                    "left un-materialized — their journal/device "
+                    "copies survive for recovery",
+                    len(ts) - ts.index(t))
+                break
             self._materialize(t)
-        for t in store.resident_ts():
-            store.drop(t)
+        if not timed_out:
+            for t in store.resident_ts():
+                store.drop(t)
+            journal = self._journal if self._journal_armed else None
+            if journal is not None:
+                journal.compact()
 
-    def persist_lazy_tail(self):
-        """Exit-path durability anchor: materialize newest-first so the
-        resume anchor (max t) goes durable even if a platform kill
-        timeout truncates the flush (resilience/checkpoint.py raises
-        Preempted through here before the process exits)."""
-        self.flush_lazy(newest_first=True)
+    def persist_lazy_tail(self, deadline_s: Optional[float] = None):
+        """Exit-path durability anchor, in two bounded phases:
+
+        1. **journal-first** — append the packed bytes of every
+           un-journaled resident generation, NEWEST first
+           (``DeviceRunStore.journal_tail``): cheap fsync'd appends, so
+           even a second kill seconds later leaves a fully replayable
+           journal;
+        2. best-effort **materialize**, newest-first, so the resume
+           anchor (max durable t) is as late as possible.
+
+        The whole barrier is bounded by ``deadline_s`` (default
+        ``$PYABC_TPU_PREEMPT_DEADLINE_S`` = 30 s) — platform kill
+        timeouts are real, and an over-budget flush would otherwise
+        turn a clean preemption into a hard kill mid-commit."""
+        if deadline_s is None:
+            deadline_s = _preempt_deadline_s()
+        deadline = (time.monotonic() + float(deadline_s)
+                    if deadline_s and deadline_s > 0 else None)
+        store = self._store
+        if store is not None:
+            # make sure the journal is armed even if attach_store ran
+            # before journaling was possible
+            if store.journal is None and self.journal is not None:
+                store.attach_journal(self.journal)
+            store.journal_tail(deadline)
+        self.flush_lazy(newest_first=True, deadline=deadline)
+
+    def recover_lazy(self) -> dict:
+        """Startup recovery (``ABCSMC.load``): replay the previous
+        process's un-materialized journal payloads into durable blobs —
+        generations a crash stranded device-side are RESTORED, not
+        discarded — then purge whatever is still summary-only (deposits
+        whose bytes never reached the journal).  Returns
+        ``{"recovered": n, "purged": m}``."""
+        from ..telemetry.metrics import REGISTRY
+        recovered = 0
+        journal = self._existing_journal()
+        if journal is not None:
+            for t, entry in sorted(journal.pending().items()):
+                row = self._lazy_flag(t)
+                if row is None or not row[0]:
+                    # no lazy row to fill: either the summary row never
+                    # committed (nothing to anchor a recovery to) or
+                    # the generation is already durable — either way
+                    # the journal can forget it
+                    journal.mark_materialized(t)
+                    continue
+                try:
+                    pop = self._hydrate_checked(t, entry)
+                except Exception:
+                    logger.exception(
+                        "journal replay: generation %d undecodable — "
+                        "left for purge", t)
+                    continue
+                if pop is None:
+                    continue
+                self._materialize_pop(t, pop, row[1], row[2], row[3])
+                recovered += 1
+                REGISTRY.counter(
+                    "resilience_journal_replayed_total",
+                    "journal payloads replayed into durable blobs"
+                ).inc()
+            journal.compact()
+        if recovered:
+            logger.warning(
+                "recovered %d generation(s) from the spill journal "
+                "left by an interrupted lazy run", recovered)
+        purged = self.purge_stale_lazy()
+        return {"recovered": recovered, "purged": purged}
 
     def purge_stale_lazy(self) -> int:
         """Drop summary-only generation rows whose device store died
@@ -951,4 +1260,6 @@ class History:
         self._conn.commit()
 
     def close(self):
+        if self._journal_armed and self._journal is not None:
+            self._journal.close()
         self._conn.close()

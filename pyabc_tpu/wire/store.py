@@ -30,6 +30,19 @@ Eviction never loses data: entries pushed out of the ring land on a
 **spill queue** that ``storage/history.py`` drains on its own (sqlite
 writer) thread — deposits happen on ingest worker threads, so the
 store itself never touches the database.
+
+Durability contract (PR 8, ``resilience/journal.py``): when a
+:class:`~pyabc_tpu.resilience.journal.SpillJournal` is attached,
+``deposit`` write-aheads an O(100 B) manifest record before
+acknowledging, and the moment a generation becomes *at risk* —
+evicted from the ring, or still resident during a preemption flush
+(:meth:`DeviceRunStore.journal_tail`) — its packed wire bytes are
+fetched once and journaled BEFORE anything consumes them.  Every
+deposit also records a content digest (shape/dtype manifest at
+deposit, packed-bytes CRC completed at first host contact) that
+:func:`hydrate_entry` verifies on every decode; a mismatch raises
+``IntegrityError`` for the History's recovery ladder instead of
+handing corrupt bytes to the posterior.
 """
 
 from __future__ import annotations
@@ -214,21 +227,54 @@ def maybe_summary_grid(dp: dict) -> Optional[dict]:
 
 # ---------------------------------------------------------------- decode
 
-def hydrate_entry(entry: dict):
-    """Materialize one deposited generation to the host: fetch the
-    narrow wire under ``egress("history")`` and replay the exact decode
-    path the eager mode would have used (selected by the entry's
-    ``norm`` tag), so the result is bit-identical to an eager run.
-    Returns a round-order :class:`~pyabc_tpu.population.Population`,
-    or None when the weights are degenerate."""
-    from ..sampler.base import Sample, fetch_to_host, widen_wire
+def _narrow_wire(entry: dict) -> dict:
+    """The entry's decodable wire lanes (summary ``sm_*`` lanes carry
+    no population bytes and are excluded from fetch/digest/journal)."""
+    return {key: v for key, v in entry["wire"].items()
+            if not key.startswith("sm_")}
+
+
+def entry_host_wire(entry: dict) -> dict:
+    """Generation bytes on the host, fetched at most once per entry:
+    reuse the journaled copy when the spill path already paid the d2h,
+    else fetch under ``egress("history")`` and complete the entry's
+    content digest (CRC recorded at first host contact).  The returned
+    dict passes through the ``store.hydrate`` fault site and is
+    digest-verified — corruption between fetch and decode raises
+    ``IntegrityError`` rather than reaching the posterior."""
+    from ..resilience import faults as _faults
+    from ..resilience.journal import crc_of, verify_wire
+    from ..sampler.base import fetch_to_host
     from . import transfer
+
+    out = entry.get("host_wire")
+    if out is None:
+        with transfer.egress("history"):
+            out = fetch_to_host(_narrow_wire(entry))
+        digest = entry.get("digest")
+        if digest is not None and digest.get("crc") is None:
+            # the authoritative bytes, straight off the device: the CRC
+            # half of the deposit-time digest starts here
+            entry["digest"] = digest = dict(digest, crc=crc_of(out))
+    out = _faults.fault_point(_faults.SITE_STORE_HYDRATE, data=out)
+    verify_wire(out, entry.get("digest"), t=entry.get("t", -2),
+                where="store.hydrate")
+    return out
+
+
+def hydrate_entry(entry: dict):
+    """Materialize one deposited generation to the host: fetch (or
+    reuse the journaled host copy of) the narrow wire under
+    ``egress("history")``, digest-verify it, and replay the exact
+    decode path the eager mode would have used (selected by the
+    entry's ``norm`` tag), so the result is bit-identical to an eager
+    run.  Returns a round-order
+    :class:`~pyabc_tpu.population.Population`, or None when the
+    weights are degenerate."""
+    from ..sampler.base import Sample, widen_wire
     from .ingest import _SCALAR_KEYS, batch_to_population, split_gen_wire
 
-    wire = {key: v for key, v in entry["wire"].items()
-            if not key.startswith("sm_")}
-    with transfer.egress("history"):
-        out = fetch_to_host(wire)
+    out = entry_host_wire(entry)
     if entry["norm"] == "sample":
         batch = {key: v for key, v in out.items()
                  if key not in _SCALAR_KEYS}
@@ -263,6 +309,14 @@ class DeviceRunStore:
         self.deposits = 0
         self.evictions = 0
         self.hydrations = 0
+        #: optional write-ahead SpillJournal (resilience/journal.py)
+        self.journal = None
+
+    def attach_journal(self, journal):
+        """Arm the durability contract: deposits write-ahead manifest
+        records, evictions/preemption flushes journal the packed bytes
+        before they become the generation's only copy."""
+        self.journal = journal
 
     def _update_gauges(self):
         _gauge("wire_store_resident_entries").set(len(self._entries))
@@ -273,12 +327,35 @@ class DeviceRunStore:
                 eps: Optional[float] = None, norm: str = "stream"):
         """Park generation ``t``'s narrow wire on device.  A repeat
         deposit for the same ``t`` (pipelined re-run after a rewind)
-        replaces the stale entry."""
+        replaces the stale entry.
+
+        With a journal attached the deposit is acknowledged only after
+        an O(100 B) manifest record (shape/dtype digest included) is
+        durable, and any entry the ring evicts has its packed bytes
+        journaled before it joins the spill queue."""
+        from ..resilience import faults as _faults
+        from ..resilience.journal import manifest_of
+
+        _faults.fault_point(_faults.SITE_STORE_DEPOSIT)
         entry = {
             "t": int(t), "wire": wire, "n": int(n), "count": int(count),
             "eps": None if eps is None else float(eps),
             "norm": str(norm), "nbytes": _tree_nbytes(wire),
         }
+        narrow = _narrow_wire(entry)
+        entry["digest"] = {"crc": None, "manifest": manifest_of(narrow)}
+        journal = self.journal
+        if journal is not None:
+            # write-ahead: the run's durable record knows generation t
+            # exists (and its exact shape) before the deposit is
+            # acknowledged — a hard kill can then name what it lost
+            journal.append_manifest({
+                "t": entry["t"], "n": entry["n"],
+                "count": entry["count"], "eps": entry["eps"],
+                "norm": entry["norm"], "nbytes": entry["nbytes"],
+                "digest": entry["digest"],
+            })
+        evicted = []
         with self._lock:
             self._entries.pop(int(t), None)
             self._entries[int(t)] = entry
@@ -286,13 +363,83 @@ class DeviceRunStore:
             _counter("wire_store_deposits_total").inc()
             while len(self._entries) > self.max_gens:
                 t_old, old = self._entries.popitem(last=False)
-                self._spills.append(old)
+                evicted.append(old)
                 self.evictions += 1
                 _counter("wire_store_evictions_total").inc()
                 logger.info("device store: evicting gen %d to spill "
                             "queue (%d resident)", t_old,
                             len(self._entries))
             self._update_gauges()
+        for old in evicted:
+            # outside the lock: the spill fetch + fsync'd journal write
+            # must not serialize concurrent deposits
+            self._journal_spill(old)
+            with self._lock:
+                self._spills.append(old)
+
+    def _journal_spill(self, entry: dict) -> bool:
+        """Write an at-risk entry's packed bytes ahead (``store.spill``
+        fault site, retried).  On success the entry carries
+        ``host_wire`` + a completed digest; on exhausted retries it
+        stays a device-only spill (pre-journal semantics) and the run
+        continues."""
+        journal = self.journal
+        if journal is None or entry.get("host_wire") is not None:
+            return entry.get("host_wire") is not None
+        from ..resilience import faults as _faults
+        from ..resilience.retry import RetryExhausted, shared_policy
+        try:
+            shared_policy().call(self._spill_once,
+                                 _faults.SITE_STORE_SPILL,
+                                 entry, journal)
+            return True
+        except RetryExhausted:
+            logger.exception(
+                "device store: could not journal spilled gen %d — it "
+                "remains device-only until materialization",
+                entry["t"])
+            from ..telemetry.flight import RECORDER
+            RECORDER.note("spill_unjournaled", t=entry["t"])
+            return False
+
+    @staticmethod
+    def _spill_once(entry: dict, journal):
+        from ..sampler.base import fetch_to_host
+        from . import transfer
+
+        with transfer.egress("history"):
+            host_wire = fetch_to_host(_narrow_wire(entry))
+        entry["digest"] = journal.append_payload(
+            entry["t"], host_wire,
+            {"n": entry["n"], "count": entry["count"],
+             "eps": entry["eps"], "norm": entry["norm"]})
+        entry["host_wire"] = host_wire
+
+    def journal_tail(self, deadline: Optional[float] = None) -> int:
+        """Preemption barrier, phase 1: journal the packed bytes of
+        every un-journaled generation (resident ring + spill queue),
+        NEWEST first — under a second kill the most recent work is the
+        most valuable.  ``deadline`` is an absolute ``time.monotonic``
+        stop; returns how many generations were journaled."""
+        import time as _time
+        if self.journal is None:
+            return 0
+        with self._lock:
+            candidates = sorted(
+                list(self._entries.values()) + list(self._spills),
+                key=lambda e: e["t"], reverse=True)
+        done = 0
+        for entry in candidates:
+            if deadline is not None and _time.monotonic() >= deadline:
+                logger.warning(
+                    "preemption barrier: deadline hit after journaling "
+                    "%d/%d generations", done, len(candidates))
+                break
+            if self.journal.has_payload(entry["t"]):
+                continue
+            if self._journal_spill(entry):
+                done += 1
+        return done
 
     def has(self, t: int) -> bool:
         with self._lock:
@@ -301,6 +448,12 @@ class DeviceRunStore:
     def resident_ts(self) -> list:
         with self._lock:
             return sorted(self._entries)
+
+    def entry(self, t: int) -> Optional[dict]:
+        """The live entry dict for generation ``t`` (shared, not a
+        copy) — the History's recovery ladder re-decodes from it."""
+        with self._lock:
+            return self._entries.get(int(t))
 
     def entry_meta(self, t: int) -> Optional[dict]:
         with self._lock:
@@ -336,8 +489,11 @@ class DeviceRunStore:
         (their summary rows haven't been appended — the one-ahead fetch
         worker raced the harvest loop).  They rejoin at the FRONT: they
         are older than anything evicted since."""
+        if not entries:
+            return
         with self._lock:
             self._spills = list(entries) + self._spills
+            _counter("store_spill_requeued_total").inc(len(entries))
 
     def drop(self, t: int) -> bool:
         with self._lock:
@@ -374,7 +530,7 @@ class DeviceRunStore:
         a resumed run to know what was device-resident (and therefore
         what a hard preemption lost vs what is durable)."""
         with self._lock:
-            return {
+            out = {
                 "max_gens": self.max_gens,
                 "deposits": self.deposits,
                 "evictions": self.evictions,
@@ -385,3 +541,9 @@ class DeviceRunStore:
                 ],
                 "spill_pending": [e["t"] for e in self._spills],
             }
+            all_ts = sorted({e["t"] for e in self._entries.values()}
+                            | {e["t"] for e in self._spills})
+        if self.journal is not None:
+            out["journaled"] = [t for t in all_ts
+                                if self.journal.has_payload(t)]
+        return out

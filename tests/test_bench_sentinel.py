@@ -129,3 +129,14 @@ def test_median_of_three_resists_one_outlier(tmp_path):
             json.dumps({"value": v, "extra": {}}))
     ref = mod.reference_row(mod.load_trajectory(str(tmp_path)))
     assert ref["value"] == 560000.0
+
+
+def test_journal_mb_fails_high():
+    """The spill journal's on-disk footprint is watched fail-high: an
+    O(KB) wobble sits inside the absolute _MB_SLACK, a regression to
+    MB-scale WAL growth (compaction stopped reclaiming) trips."""
+    mod = _load()
+    ref = {"resilience_journal_mb": 0.01}
+    assert mod.compare({"resilience_journal_mb": 0.02}, ref) == []
+    fails = mod.compare({"resilience_journal_mb": 5.0}, ref)
+    assert any(k == "resilience_journal_mb" for k, *_ in fails)

@@ -1,0 +1,120 @@
+"""Tier-1 wrapper for tools/check_fault_sites.py: every fault site in
+``faults.SITES`` must be planted inside a recovery boundary, exercised
+by at least one test, listed in SITES, and documented — and the lint
+must actually catch each violation class when one is planted."""
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "check_fault_sites.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_fault_sites", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _plant(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+
+
+_FAULTS_OK = (
+    'SITE_FETCH = "wire.fetch"\n'
+    'SITE_JOURNAL = "journal.write"\n'
+    'SITES = (SITE_FETCH, SITE_JOURNAL)\n')
+
+
+def test_repo_tree_is_clean():
+    """Every site planted + bounded + tested + documented — the
+    invariant that keeps the chaos matrix honest."""
+    mod = _load()
+    assert mod.check() == []
+
+
+def test_site_constants_parse():
+    mod = _load()
+    consts = mod.site_constants(_FAULTS_OK)
+    assert consts == {"SITE_FETCH": "wire.fetch",
+                      "SITE_JOURNAL": "journal.write"}
+
+
+def test_detects_constant_missing_from_sites(tmp_path):
+    mod = _load()
+    _plant(tmp_path, "pyabc_tpu/resilience/faults.py",
+           'SITE_FETCH = "wire.fetch"\n'
+           'SITE_JOURNAL = "journal.write"\n'
+           'SITES = (SITE_FETCH,)\n')
+    got = mod.check(root=str(tmp_path))
+    assert any("SITE_JOURNAL is defined but missing from SITES" in msg
+               for _, msg in got)
+
+
+def test_detects_undefined_constant_in_sites(tmp_path):
+    mod = _load()
+    _plant(tmp_path, "pyabc_tpu/resilience/faults.py",
+           'SITE_FETCH = "wire.fetch"\n'
+           'SITES = (SITE_FETCH, SITE_GHOST)\n')
+    got = mod.check(root=str(tmp_path))
+    assert any("undefined constant SITE_GHOST" in msg for _, msg in got)
+
+
+def test_detects_lost_recovery_boundary(tmp_path):
+    """A plant whose retry/journal boundary disappeared is flagged:
+    the fault would kill the run instead of testing recovery."""
+    mod = _load()
+    _plant(tmp_path, "pyabc_tpu/resilience/faults.py", _FAULTS_OK)
+    # SITE_FETCH planted WITHOUT the shared_policy().call wrapper
+    _plant(tmp_path, "pyabc_tpu/sampler/base.py",
+           "return _fetch(SITE_FETCH)\n")
+    _plant(tmp_path, "pyabc_tpu/resilience/journal.py",
+           "shared_policy().call(self._append_once, SITE_JOURNAL)\n")
+    got = mod.check(root=str(tmp_path))
+    boundary = [(where, msg) for where, msg in got
+                if "recovery boundary" in msg]
+    assert [where for where, _ in boundary] == ["pyabc_tpu/sampler/base.py"]
+    assert "shared_policy().call(" in boundary[0][1]
+
+
+def test_detects_untested_and_undocumented_site(tmp_path):
+    mod = _load()
+    _plant(tmp_path, "pyabc_tpu/resilience/faults.py", _FAULTS_OK)
+    _plant(tmp_path, "tests/test_x.py", '"wire.fetch"\n')
+    _plant(tmp_path, "docs/resilience.md", "| `wire.fetch` |\n")
+    got = mod.check(root=str(tmp_path))
+    assert any(where == "tests/" and "journal.write" in msg
+               for where, msg in got)
+    assert any(where.endswith("resilience.md") and "journal.write" in msg
+               for where, msg in got)
+    # chaos_soak.py counts as coverage (its deterministic subset is
+    # tier-1 via tests/test_chaos_soak.py)
+    _plant(tmp_path, "tools/chaos_soak.py", '"journal.write@4:corrupt"\n')
+    got = mod.check(root=str(tmp_path))
+    assert not any(where == "tests/" for where, _ in got)
+
+
+def test_new_site_requires_manifest_entry(tmp_path):
+    """Adding a SITE_* constant without declaring its planting file and
+    boundary in the lint's MANIFEST is itself a violation."""
+    mod = _load()
+    _plant(tmp_path, "pyabc_tpu/resilience/faults.py",
+           'SITE_NOVEL = "novel.site"\n'
+           'SITES = (SITE_NOVEL,)\n')
+    got = mod.check(root=str(tmp_path))
+    assert any("no MANIFEST entry" in msg for _, msg in got)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    mod = _load()
+    assert mod.main([]) == 0  # the real tree
+    assert "clean" in capsys.readouterr().out
+    _plant(tmp_path, "pyabc_tpu/resilience/faults.py",
+           'SITE_FETCH = "wire.fetch"\n'
+           'SITES = (SITE_FETCH, SITE_GHOST)\n')
+    assert mod.main([str(tmp_path)]) == 1
+    assert "SITE_GHOST" in capsys.readouterr().out

@@ -4,6 +4,7 @@ multicorebase.py:78-105 worker-death detection, redis_eps/cli.py:244-282
 manager info/stop/reset-workers)."""
 
 import os
+import sqlite3
 import subprocess
 import sys
 import time
@@ -392,3 +393,107 @@ def test_sigterm_mid_generation_resumes_and_passes_gate(tmp_path):
     mu = float(np.sum(np.asarray(df["mu"]) * w))
     assert abs(p_b - p_true) < max(2.5e-3, 2.5 / _PREEMPT_POP ** 0.5)
     assert abs(mu - 1.0) < max(3e-3, 3.0 / _PREEMPT_POP ** 0.5)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-run: the spill journal is the only surviving copy of a
+# generation (resilience/journal.py) and a fresh process replays it into
+# durable blobs without re-running the generation
+# ---------------------------------------------------------------------------
+
+_SIGKILL_POP = 10_000
+
+#: child process: lazy history under eviction pressure (ring capacity 1
+#: via $PYABC_TPU_STORE_GENS, fused 3-generation blocks) so each
+#: generation's bytes are journaled when the next deposit evicts it.
+#: The kill -9 lands at a materialize — after the victim generation's
+#: summary row committed and its packed bytes were journaled at
+#: eviction, before they reached sqlite — the exact window where the
+#: journal payload is the generation's only copy.
+_SIGKILL_CHILD = """
+import sys
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+from pyabc_tpu.resilience import faults
+
+db = sys.argv[1]
+models, priors, distance, observed, _ = make_two_gaussians_problem()
+faults.install(faults.FaultPlan.parse("history.materialize@2:sigkill"))
+abc = pt.ABCSMC(models, priors, distance, population_size=%(pop)d,
+                eps=pt.MedianEpsilon(),
+                sampler=pt.VectorizedSampler(),
+                stores_sum_stats=False, seed=7,
+                history_mode="lazy", ingest_mode="sequential",
+                fuse_generations=3)
+abc.new(db, observed)
+abc.run(max_nr_populations=6)
+sys.exit(3)  # unreachable: the plan kills -9 mid-run
+""" % {"pop": _SIGKILL_POP}
+
+
+def test_sigkill_mid_run_recovers_from_journal(tmp_path):
+    """kill -9 a pop-1e4 lazy child mid-run; the write-ahead journal
+    holds the victim generation's only bytes, a fresh process replays
+    them into durable blobs WITHOUT re-running the generation, resumes,
+    and passes the posterior gate."""
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.resilience.journal import SpillJournal
+
+    db = str(tmp_path / "kill.db")
+    script = tmp_path / "kill_child.py"
+    script.write_text(_SIGKILL_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO,
+               PYABC_TPU_STORE_GENS="1")
+    proc = subprocess.run([sys.executable, str(script), db], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -9, proc.stderr[-3000:]
+
+    # post-mortem disk state: generation 2 is a lazy summary row whose
+    # packed bytes survive ONLY as a pending journal payload (gens 0-1
+    # materialized before the kill)
+    j = SpillJournal(db + ".journal")
+    assert 2 in j.pending()
+    j.close()
+    with sqlite3.connect(db) as conn:
+        flags = dict(conn.execute(
+            "SELECT t, lazy FROM populations WHERE t >= 0"))
+    assert flags == {0: 0, 1: 0, 2: 1}
+
+    # resume with a different seed and sampler shape: replay depends
+    # only on the journaled bytes, not the dead process's state
+    models, priors, distance, observed, posterior_fn = \
+        make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance,
+                    population_size=_SIGKILL_POP,
+                    eps=pt.MedianEpsilon(),
+                    sampler=pt.VectorizedSampler(max_batch_size=1 << 17),
+                    stores_sum_stats=False, seed=8,
+                    history_mode="lazy", ingest_mode="sequential")
+    abc.load(db)
+    # the journal replay materialized generation 2 without re-running
+    # it, and tombstoned + compacted itself empty
+    assert abc.history.max_t == 2
+    with sqlite3.connect(db) as conn:
+        lazy_left = conn.execute(
+            "SELECT COUNT(*) FROM populations WHERE lazy = 1").fetchone()
+    assert lazy_left[0] == 0
+    j2 = SpillJournal(db + ".journal")
+    assert j2.pending() == {}
+    j2.close()
+
+    h = abc.run(max_nr_populations=2)
+    t = h.max_t
+    assert t == 4
+    for tt in range(t + 1):
+        pop = h.get_population(t=tt)
+        assert np.asarray(pop.theta).shape[0] == _SIGKILL_POP
+        assert np.isclose(np.asarray(pop.weight).sum(), 1.0, atol=1e-5)
+
+    probs = h.get_model_probabilities(t)
+    p_b = float(probs.get(1, 0.0))
+    p_true = float(posterior_fn(1.0))
+    df, w = h.get_distribution(m=1, t=t)
+    mu = float(np.sum(np.asarray(df["mu"]) * w))
+    assert abs(p_b - p_true) < max(2.5e-3, 2.5 / _SIGKILL_POP ** 0.5)
+    assert abs(mu - 1.0) < max(3e-3, 3.0 / _SIGKILL_POP ** 0.5)

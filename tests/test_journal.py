@@ -1,0 +1,273 @@
+"""Spill journal + content digests (pyabc_tpu/resilience/journal.py).
+
+The write-ahead half of the lazy-History durability contract, pinned at
+unit scale: CRC framing round-trips, a torn tail ends the scan without
+losing earlier records, one flipped bit costs one record, tombstones
+and compaction reclaim materialized payloads, restart bootstraps from
+whatever segments survived, digests catch corrupted hydrations, and a
+forged crash (lazy summary row + journal payload, no process) replays
+through ``History.recover_lazy`` into real durable blobs."""
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from pyabc_tpu.resilience import journal as jn
+from pyabc_tpu.telemetry.metrics import REGISTRY
+
+
+def _wire(t, rows=6):
+    rng = np.random.default_rng(100 + t)
+    return {
+        "theta": np.float32(rng.normal(size=(rows, 1))),
+        "m": rng.integers(0, 2, size=(rows,)).astype(np.int32),
+        "distance": np.float32(rng.random(rows)),
+        "log_weight": np.float32(rng.normal(size=(rows,))),
+    }
+
+
+def _meta(t, rows=6):
+    return {"t": int(t), "n": rows, "count": rows, "eps": 0.5,
+            "norm": "sample", "nbytes": 123}
+
+
+def _counter_value(name):
+    return REGISTRY.to_dict().get(name, 0)
+
+
+# ---------------------------------------------------------------- digests
+
+def test_digest_roundtrip_and_manifest():
+    w = _wire(0)
+    d = jn.digest_wire(w)
+    assert set(d) == {"crc", "manifest"}
+    assert d["manifest"]["theta"] == [np.dtype(np.float32).str, [6, 1]]
+    jn.verify_wire(w, d)  # exact bytes: passes
+    jn.verify_wire(w, None)  # no digest recorded: vacuously fine
+    # manifest-only digest (crc still None: wire never left the device)
+    jn.verify_wire(w, {"crc": None, "manifest": d["manifest"]})
+
+
+def test_verify_wire_catches_flipped_bit_and_wrong_shape():
+    w = _wire(0)
+    d = jn.digest_wire(w)
+    bad = {k: v.copy() for k, v in w.items()}
+    bad["theta"][2, 0] += np.float32(1e-3)
+    with pytest.raises(jn.IntegrityError) as exc:
+        jn.verify_wire(bad, d, t=3, where="unit")
+    assert exc.value.t == 3 and exc.value.where == "unit"
+    assert "CRC" in str(exc.value)
+    short = dict(w)
+    short["theta"] = w["theta"][:-1]
+    with pytest.raises(jn.IntegrityError) as exc:
+        jn.verify_wire(short, d)
+    assert "manifest" in str(exc.value)
+
+
+def test_verify_wire_books_counters():
+    checks0 = _counter_value("store_integrity_checks_total")
+    fails0 = _counter_value("store_integrity_failures_total")
+    w = _wire(1)
+    d = jn.digest_wire(w)
+    jn.verify_wire(w, d)
+    with pytest.raises(jn.IntegrityError):
+        jn.verify_wire(_wire(2), d)
+    assert _counter_value("store_integrity_checks_total") == checks0 + 2
+    assert _counter_value("store_integrity_failures_total") == fails0 + 1
+
+
+def test_integrity_error_is_not_transient():
+    """Re-reading the same corrupt bytes cannot help: recovery is the
+    History's ladder, never a retry loop."""
+    from pyabc_tpu.resilience.retry import is_transient
+    assert not is_transient(jn.IntegrityError("x", t=1, where="unit"))
+
+
+# ---------------------------------------------------------------- journal
+
+def test_append_payload_roundtrip_and_tombstone(tmp_path):
+    j = jn.SpillJournal(str(tmp_path))
+    j.append_manifest(_meta(0))
+    w = _wire(0)
+    digest = j.append_payload(0, w, _meta(0))
+    assert digest["crc"] is not None
+    assert j.has_payload(0) and not j.has_payload(1)
+
+    pending = j.pending()
+    assert list(pending) == [0]
+    entry = pending[0]
+    assert entry["norm"] == "sample" and entry["n"] == 6
+    for k in w:
+        assert np.array_equal(entry["host_wire"][k], w[k])
+    assert entry["digest"] == digest
+
+    j.mark_materialized(0)
+    assert not j.has_payload(0)
+    assert j.pending() == {}
+    j.mark_materialized(0)  # idempotent: no duplicate tombstone record
+    j.close()
+
+
+def test_torn_tail_keeps_earlier_records(tmp_path):
+    j = jn.SpillJournal(str(tmp_path))
+    j.append_payload(0, _wire(0), _meta(0))
+    j.append_payload(1, _wire(1), _meta(1))
+    j.close()
+    seg = os.path.join(str(tmp_path), "seg-000000.wal")
+    torn0 = _counter_value("resilience_journal_torn_total")
+    with open(seg, "r+b") as f:
+        f.truncate(os.path.getsize(seg) - 7)  # crash mid-append
+    j2 = jn.SpillJournal(str(tmp_path))
+    assert sorted(j2.pending()) == [0]  # t=1 torn, t=0 intact
+    assert _counter_value("resilience_journal_torn_total") > torn0
+    j2.close()
+
+
+def test_crc_bad_record_skipped_not_fatal(tmp_path):
+    """One flipped bit costs ONE record; later records still replay."""
+    j = jn.SpillJournal(str(tmp_path))
+    j.append_payload(0, _wire(0), _meta(0))
+    off_after_first = os.path.getsize(
+        os.path.join(str(tmp_path), "seg-000000.wal"))
+    j.append_payload(1, _wire(1), _meta(1))
+    j.close()
+    seg = os.path.join(str(tmp_path), "seg-000000.wal")
+    bad0 = _counter_value("resilience_journal_bad_records_total")
+    with open(seg, "r+b") as f:
+        f.seek(off_after_first - 20)  # inside record 0's payload
+        byte = f.read(1)
+        f.seek(off_after_first - 20)
+        f.write(bytes([byte[0] ^ 0x40]))
+    j2 = jn.SpillJournal(str(tmp_path))
+    assert sorted(j2.pending()) == [1]
+    assert _counter_value("resilience_journal_bad_records_total") > bad0
+    j2.close()
+
+
+def test_restart_bootstrap_continues_segments(tmp_path):
+    j = jn.SpillJournal(str(tmp_path))
+    j.append_payload(0, _wire(0), _meta(0))
+    j.mark_materialized(0)
+    j.append_payload(1, _wire(1), _meta(1))
+    j.close()
+    j2 = jn.SpillJournal(str(tmp_path))  # fresh process
+    assert not j2.has_payload(0)  # tombstone survived
+    assert j2.has_payload(1)
+    assert sorted(j2.pending()) == [1]
+    # the restarted journal appends into a NEW segment, never the old
+    j2.append_payload(2, _wire(2), _meta(2))
+    segs = sorted(f for f in os.listdir(str(tmp_path))
+                  if f.endswith(".wal"))
+    assert len(segs) >= 2
+    j2.close()
+
+
+def test_compact_reclaims_materialized_segments(tmp_path):
+    trunc0 = _counter_value("resilience_journal_truncations_total")
+    j = jn.SpillJournal(str(tmp_path))
+    j.append_payload(0, _wire(0), _meta(0))
+    j.mark_materialized(0)
+    j.compact()
+    assert _counter_value(
+        "resilience_journal_truncations_total") > trunc0
+    assert j.pending() == {}
+    # live payloads pin their segment
+    j.append_payload(1, _wire(1), _meta(1))
+    j.compact()
+    assert j.has_payload(1) and sorted(j.pending()) == [1]
+    j.close()
+    # gauge tracks on-disk bytes through the lifecycle
+    assert REGISTRY.to_dict().get("resilience_journal_mb", 0) >= 0
+
+
+def test_record_framing_is_pjn1(tmp_path):
+    j = jn.SpillJournal(str(tmp_path))
+    j.append_manifest(_meta(7))
+    j.close()
+    with open(os.path.join(str(tmp_path), "seg-000000.wal"), "rb") as f:
+        data = f.read()
+    assert data[:4] == b"PJN1"
+    hlen, plen, crc = struct.unpack_from("<III", data, 4)
+    blob = data[16:16 + hlen + plen]
+    assert zlib.crc32(blob) & 0xFFFFFFFF == crc
+    hdr = json.loads(blob[:hlen])
+    assert hdr["kind"] == "manifest" and hdr["t"] == 7
+
+
+def test_journal_dir_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv(jn.JOURNAL_DIR_ENV, raising=False)
+    assert jn.journal_dir_for("/x/run.db", False) == "/x/run.db.journal"
+    assert jn.journal_dir_for(":memory:", True) is None
+    monkeypatch.setenv(jn.JOURNAL_DIR_ENV, str(tmp_path / "jd"))
+    assert jn.journal_dir_for(":memory:", True) == str(tmp_path / "jd")
+    monkeypatch.setenv(jn.JOURNAL_ENV, "0")
+    assert jn.journal_dir_for("/x/run.db", False) is None
+
+
+# ----------------------------------------------------- recover_lazy replay
+
+def test_recover_lazy_replays_forged_crash(tmp_path):
+    """Forge the exact post-SIGKILL disk state — a ``lazy=1`` summary
+    row whose bytes only exist as a journal payload — and assert a
+    fresh History replays it into durable blobs, then purges nothing."""
+    import pyabc_tpu as pt
+
+    db = str(tmp_path / "crash.db")
+    n = 8
+    rng = np.random.default_rng(5)
+    host_wire = {
+        "m": np.zeros((n,), np.int32),
+        "theta": np.float32(rng.normal(size=(n, 1))),
+        "distance": np.float32(rng.random(n)),
+        "log_weight": np.zeros((n,), np.float32),
+    }
+
+    h = pt.History(db, abc_id=1)
+    h.append_population_lazy(
+        0, 0.5, n, summary={"model_w": [1.0], "model_n": [n]},
+        model_names=["m0"], param_names=["mu"])
+    digest = h.journal.append_payload(0, host_wire, _meta(0, rows=n))
+    assert digest["crc"] is not None
+    h.close()  # the process "dies" here: blobs never hit sqlite
+
+    replayed0 = _counter_value("resilience_journal_replayed_total")
+    h2 = pt.History(db, abc_id=1)
+    out = h2.recover_lazy()
+    assert out["recovered"] == 1 and out["purged"] == 0
+    assert _counter_value(
+        "resilience_journal_replayed_total") == replayed0 + 1
+    pop = h2.get_population(t=0)
+    assert np.asarray(pop.theta).shape[0] == n
+    got = np.sort(np.asarray(pop.theta).ravel())
+    assert np.array_equal(got, np.sort(host_wire["theta"].ravel()))
+    assert np.isclose(np.asarray(pop.weight).sum(), 1.0, atol=1e-6)
+    # replay tombstoned + compacted: nothing left pending
+    assert h2.journal.pending() == {}
+    # second recovery is a no-op
+    assert h2.recover_lazy() == {"recovered": 0, "purged": 0}
+    h2.close()
+
+
+def test_recover_lazy_purges_row_without_payload(tmp_path):
+    """A lazy row whose bytes never reached the journal (killed before
+    the spill) cannot be replayed — recovery purges it so the resumed
+    loop regenerates from the last durable generation."""
+    import pyabc_tpu as pt
+
+    db = str(tmp_path / "lost.db")
+    h = pt.History(db, abc_id=1)
+    assert h.journal is not None  # file-backed: journaling armed
+    h.append_population_lazy(
+        0, 0.5, 8, summary={"model_w": [1.0], "model_n": [8]},
+        model_names=["m0"], param_names=["mu"])
+    h.close()
+
+    h2 = pt.History(db, abc_id=1)
+    out = h2.recover_lazy()
+    assert out["recovered"] == 0 and out["purged"] == 1
+    assert h2.max_t == -1  # nothing durable; loop restarts at t=0
+    h2.close()

@@ -132,6 +132,69 @@ def test_install_from_env(monkeypatch):
     assert faults.install_from_env() is None  # unset env: no plan
 
 
+def test_fault_plan_grammar_sigkill_and_corrupt():
+    plan = faults.FaultPlan.parse(
+        "store.deposit@3:sigkill; store.hydrate@2:corrupt=4;"
+        "journal.write@1:corrupt")
+    s0, s1, s2 = plan.specs
+    assert (s0.site, s0.mode, s0.arg, s0.action) == (
+        faults.SITE_STORE_DEPOSIT, "at", 3, "sigkill")
+    assert (s1.site, s1.action, s1.action_arg) == (
+        faults.SITE_STORE_HYDRATE, "corrupt", 4)
+    # bare corrupt defaults to a single flipped bit
+    assert (s2.site, s2.action, s2.action_arg) == (
+        faults.SITE_JOURNAL, "corrupt", 1)
+
+
+@pytest.mark.parametrize("bad", [
+    "store.hydrate@1:corrupt=0",     # N must be >= 1
+    "store.hydrate@1:corrupt=-3",
+    "store.hydrate@1:corrupt=lots",  # N must be an integer
+    "store.deposit@1:sigkill=9",     # sigkill takes no argument
+])
+def test_fault_plan_rejects_bad_corrupt_and_sigkill(bad):
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse(bad)
+
+
+def test_corrupt_is_deterministic_and_leaves_copies_writable():
+    blob = bytes(range(64))
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    wire = {"theta": np.arange(12.0), "distance": np.arange(6.0)}
+
+    a = faults._corrupt(blob, 4, seed=99)
+    b = faults._corrupt(blob, 4, seed=99)
+    assert a == b and a != blob  # same seed, same flips
+    assert faults._corrupt(blob, 4, seed=100) != a
+
+    ca = faults._corrupt(arr, 2, seed=5)
+    cb = faults._corrupt(arr, 2, seed=5)
+    assert np.array_equal(ca, cb) and not np.array_equal(ca, arr)
+    assert ca.flags.writeable  # hydrate decodes in place downstream
+    ca[0, 0] = 0.0
+
+    cw = faults._corrupt(wire, 1, seed=5)
+    assert set(cw) == set(wire)
+    flipped = [k for k in wire if not np.array_equal(cw[k], wire[k])]
+    assert len(flipped) == 1  # one array takes the hit
+    # non-corruptible payloads: the visit counts, the data passes
+    assert faults._corrupt(None, 1, seed=5) is None
+    assert faults._corrupt({"n": 3}, 1, seed=5) is None
+
+
+def test_fault_point_passes_data_through_unchanged():
+    payload = {"theta": np.ones(5)}
+    # no plan installed: identity, no copy
+    assert faults.fault_point(faults.SITE_STORE_HYDRATE, payload) is payload
+    # a plan targeting ANOTHER site: still identity
+    faults.install(faults.FaultPlan.parse("journal.write@1:corrupt=8"))
+    assert faults.fault_point(faults.SITE_STORE_HYDRATE, payload) is payload
+    # the targeted site gets a corrupted COPY; the original is intact
+    framed = b"PJN1" + bytes(32)
+    out = faults.fault_point(faults.SITE_JOURNAL, framed)
+    assert out != framed and framed == b"PJN1" + bytes(32)
+
+
 # ---------------------------------------------------------------------------
 # transient-vs-fatal classification
 # ---------------------------------------------------------------------------

@@ -58,6 +58,10 @@ WATCHED = (
     # lower is better — a jump back toward full-population d2h means
     # the device-resident store stopped carrying the hot path
     ("telemetry_egress_population_mb", "lower", 0.25),
+    # spill-journal footprint (resilience/journal.py): lower is better
+    # — growth means compaction stopped reclaiming materialized
+    # payloads and the write-ahead path is billing the steady state
+    ("resilience_journal_mb", "lower", 0.25),
     ("resilience_retries", "zero", 0.0),
 )
 

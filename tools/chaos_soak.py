@@ -1,0 +1,480 @@
+#!/usr/bin/env python
+"""Chaos/soak harness for the lazy-History durability contract.
+
+Runs short two-gaussians inferences in ``history_mode="lazy"`` under
+injected fault plans (``pyabc_tpu/resilience/faults.py``) covering the
+store/journal fault sites — ``store.deposit``, ``store.spill``,
+``store.hydrate``, ``history.materialize``, ``journal.write`` — plus
+the original hot-loop sites, crossed with every action the grammar
+knows: ``raise``, ``delay``, ``sigterm``, ``sigkill`` (subprocess
+variant: the child is ACTUALLY killed -9 and a fresh process recovers
+from the spill journal), and ``corrupt=N`` bit flips.
+
+After every trial the harness asserts the durability invariants:
+
+- **no lost generations** — the run completed, or a restarted process
+  recovered (``History.recover_lazy``) and re-ran to the target; every
+  generation ``0..max_t`` has full durable blobs, the right population
+  size, and weights summing to 1;
+- **journal/manifest/DB agreement** — no ``lazy=1`` rows without
+  device backing survive, and no un-materialized journal payloads are
+  left pending;
+- **egress-sum exact** — the per-subsystem egress counters still sum
+  to ``wire_d2h_bytes_total`` across the trial (faults must not leak
+  unattributed bytes);
+- **posterior within tolerance** — model probability and posterior
+  mean against the analytic two-gaussians posterior, tolerances scaled
+  to the population;
+- **bit-identity for absorbed faults** — trials whose faults are fully
+  absorbed (retried transients, delays, detected-and-recovered
+  corruption) must match a clean run of the same seed **bit for bit**
+  (``np.array_equal``, not allclose).
+
+Tier-1 runs the small deterministic subset (``DETERMINISTIC_TRIALS``)
+via ``tests/test_chaos_soak.py``; the randomized soak
+(``python tools/chaos_soak.py --trials 50``) is the slow/manual
+variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # CLI use: `python tools/chaos_soak.py`
+    sys.path.insert(0, _REPO)
+
+POP = 512
+GENS = 4
+SEED = 11
+RECOVER_SEED = 12
+
+
+class Trial:
+    """One chaos trial: a fault plan + the run shape it targets.
+
+    ``evict`` runs fused 3-generation blocks under ring capacity 1 so
+    every block spills generations through the journal payload path;
+    otherwise the plain sequential lazy loop runs.  ``absorbed`` trials
+    must complete in-process AND match the clean run bit-for-bit;
+    others may crash/preempt and are driven through recovery.
+    ``must_fire`` asserts the plan actually triggered (guards against a
+    matrix entry silently never reaching its visit index).
+    """
+
+    def __init__(self, plan: str, *, evict: bool = False,
+                 absorbed: bool = False, kind: str = "inproc",
+                 must_fire: bool = True, checkpoint: bool = False):
+        self.plan = plan
+        self.evict = evict
+        self.absorbed = absorbed
+        self.kind = kind  # "inproc" | "subproc"
+        self.must_fire = must_fire
+        self.checkpoint = checkpoint
+
+    def __repr__(self):
+        return f"Trial({self.plan!r}, kind={self.kind})"
+
+
+#: the deterministic tier-1 subset: one representative per action class
+#: over the new store/journal sites (+ a hot-loop control), visit
+#: indices chosen to land inside a 4-generation run
+DETERMINISTIC_TRIALS = [
+    # absorbed transients: retried at the site, bit-identical output
+    Trial("wire.fetch@3:raise=ConnectionResetError", absorbed=True),
+    Trial("history.append@2:delay=0.02", absorbed=True),
+    Trial("store.spill@2:raise=OSError", evict=True, absorbed=True),
+    Trial("history.materialize@2:raise=OperationalError", evict=True,
+          absorbed=True),
+    # detected corruption: the recovery ladder re-decodes from the
+    # still-valid device wire — absorbed, bit-identical
+    Trial("store.hydrate@2:corrupt=4", absorbed=True),
+    # bit rot on the WAL write path: the frame CRC catches it at scan
+    # time; the run itself never needs the journal, so it completes
+    Trial("journal.write@4:corrupt=8", evict=True, absorbed=True),
+    # preemption barrier: SIGTERM -> bounded journal-first persist ->
+    # Preempted -> recovery run completes from the durable anchor
+    Trial("store.deposit@3:sigterm", checkpoint=True),
+    # the hard one: kill -9 a child mid-run, recover in this process
+    Trial("store.deposit@3:sigkill", evict=True, kind="subproc"),
+]
+
+_RAISE_BY_SITE = {
+    "device.dispatch": "ConnectionResetError",
+    "wire.fetch": "ConnectionResetError",
+    "history.append": "OperationalError",
+    "heartbeat.write": "OSError",
+    "preempt": "OSError",
+    "store.deposit": "OSError",
+    "store.spill": "OSError",
+    "store.hydrate": "OSError",
+    "history.materialize": "OperationalError",
+    "journal.write": "OSError",
+}
+
+
+def full_matrix(rng: random.Random, n: int) -> list:
+    """``n`` randomized site x action trials for the slow soak."""
+    from pyabc_tpu.resilience import faults
+    actions = ("raise", "delay", "sigterm", "sigkill", "corrupt")
+    trials = []
+    for _ in range(n):
+        site = rng.choice(faults.SITES)
+        action = rng.choice(actions)
+        visit = rng.randint(1, 6)
+        if action == "raise":
+            text = f"{site}@{visit}:raise={_RAISE_BY_SITE[site]}"
+        elif action == "delay":
+            text = f"{site}@{visit}:delay=0.02"
+        elif action == "corrupt":
+            text = f"{site}@{visit}:corrupt={rng.randint(1, 16)}"
+        else:
+            text = f"{site}@{visit}:{action}"
+        trials.append(Trial(
+            text, evict=bool(rng.getrandbits(1)),
+            kind="subproc" if action == "sigkill" else "inproc",
+            checkpoint=(action == "sigterm"),
+            # randomized visits may simply never be reached (e.g.
+            # heartbeat.write without a parallel sampler): a non-firing
+            # plan degrades to a clean-run trial, which still must pass
+            # every invariant
+            must_fire=False))
+    return trials
+
+
+# --------------------------------------------------------------- running
+
+def _make_abc(pop: int, seed: int, *, evict: bool, checkpoint: bool):
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import make_two_gaussians_problem
+    models, priors, distance, observed, posterior_fn = \
+        make_two_gaussians_problem()
+    kw = dict(
+        population_size=pop, eps=pt.MedianEpsilon(),
+        sampler=pt.VectorizedSampler(), seed=seed, history_mode="lazy",
+        ingest_mode="sequential",
+    )
+    if evict:
+        kw["fuse_generations"] = 3
+    if checkpoint:
+        kw["checkpoint_every_rounds"] = 1
+    return pt.ABCSMC(models, priors, distance, **kw), observed, \
+        posterior_fn
+
+
+def _egress_snapshot() -> dict:
+    from pyabc_tpu.telemetry.metrics import REGISTRY
+    snap = REGISTRY.to_dict()
+    return {k: v for k, v in snap.items()
+            if k == "wire_d2h_bytes_total"
+            or (k.startswith("wire_egress_") and k.endswith(
+                "_bytes_total"))}
+
+
+def check_egress_sum(before: dict, after: dict):
+    """Per-subsystem egress deltas must sum EXACTLY to the d2h total
+    delta — a fault path that fetched bytes outside an egress label
+    would show up here."""
+    d2h = after.get("wire_d2h_bytes_total", 0.0) \
+        - before.get("wire_d2h_bytes_total", 0.0)
+    parts = sum(after.get(k, 0.0) - before.get(k, 0.0)
+                for k in after if k.startswith("wire_egress_"))
+    assert parts == d2h, (
+        f"egress attribution leaked under faults: sum(buckets)={parts} "
+        f"!= d2h={d2h}")
+
+
+def check_invariants(db: str, pop: int, posterior_fn,
+                     min_gens: int = GENS):
+    """The durability contract, checked on the finished database."""
+    import pyabc_tpu as pt
+    from pyabc_tpu.resilience.journal import journal_dir_for
+
+    h = pt.History(db, abc_id=1)
+    try:
+        t_max = h.max_t
+        assert t_max + 1 >= min_gens, (
+            f"lost generations: max_t={t_max}, expected >= "
+            f"{min_gens - 1}")
+        # every generation has full durable blobs (this read path also
+        # runs the stored-blob CRC checks — a corrupt DB raises here)
+        for t in range(t_max + 1):
+            p = h.get_population(t=t)
+            assert np.asarray(p.theta).shape[0] == pop, (
+                f"generation {t}: {np.asarray(p.theta).shape[0]} != "
+                f"{pop} particles")
+            assert np.isclose(np.asarray(p.weight).sum(), 1.0,
+                              atol=1e-5)
+        # DB agreement: no summary-only lazy rows survive a clean end
+        lazy_rows = h._conn.execute(
+            "SELECT t FROM populations WHERE abc_smc_id=? AND lazy=1",
+            (h.id,)).fetchall()
+        assert not lazy_rows, f"un-materialized lazy rows: {lazy_rows}"
+        # journal agreement: nothing left pending for this DB
+        jdir = journal_dir_for(h.db_path, h.in_memory)
+        if jdir and os.path.isdir(jdir):
+            from pyabc_tpu.resilience.journal import SpillJournal
+            pending = sorted(SpillJournal(jdir).pending())
+            assert not pending, (
+                f"journal payloads left pending: {pending}")
+        # posterior gate, tolerances scaled to the population
+        probs = h.get_model_probabilities(t_max)
+        p_b = float(probs.get(1, 0.0))
+        p_true = float(posterior_fn(1.0))
+        df, w = h.get_distribution(m=1, t=t_max)
+        mu = float(np.sum(np.asarray(df["mu"]) * w))
+        assert abs(p_b - p_true) < max(2.5e-3, 2.5 / pop ** 0.5), (
+            f"posterior gate: p_b={p_b} vs {p_true}")
+        assert abs(mu - 1.0) < max(3e-3, 3.0 / pop ** 0.5), (
+            f"posterior gate: mu={mu}")
+    finally:
+        h.close()
+
+
+def _distribution_snapshot(db: str) -> list:
+    import pyabc_tpu as pt
+    h = pt.History(db, abc_id=1)
+    try:
+        out = []
+        for t in range(h.max_t + 1):
+            for m in range(2):
+                df, w = h.get_distribution(m=m, t=t)
+                arr = (np.asarray(df["mu"]) if "mu" in df else
+                       np.zeros(0))
+                out.append((t, m, arr, np.asarray(w)))
+        return out
+    finally:
+        h.close()
+
+
+def check_bit_identity(db: str, clean_db: str, label: str):
+    got, want = _distribution_snapshot(db), _distribution_snapshot(
+        clean_db)
+    assert len(got) == len(want), f"{label}: generation count differs"
+    for (t, m, a_mu, a_w), (_, _, b_mu, b_w) in zip(got, want):
+        assert np.array_equal(a_mu, b_mu), (
+            f"{label}: theta differs at t={t} m={m} — the fault was "
+            f"not absorbed bit-identically")
+        assert np.array_equal(a_w, b_w), (
+            f"{label}: weights differ at t={t} m={m}")
+
+
+class _StoreGens:
+    """Temporarily pin the device-store ring capacity (evict trials)."""
+
+    def __init__(self, value):
+        self.value = value
+        self._old = None
+
+    def __enter__(self):
+        from pyabc_tpu.wire.store import STORE_GENS_ENV
+        self._old = os.environ.get(STORE_GENS_ENV)
+        if self.value is None:
+            os.environ.pop(STORE_GENS_ENV, None)
+        else:
+            os.environ[STORE_GENS_ENV] = str(self.value)
+        return self
+
+    def __exit__(self, *exc):
+        from pyabc_tpu.wire.store import STORE_GENS_ENV
+        if self._old is None:
+            os.environ.pop(STORE_GENS_ENV, None)
+        else:
+            os.environ[STORE_GENS_ENV] = self._old
+
+
+def _durable_gens(db: str) -> int:
+    """Durable generations in the DB (``max_t`` anchors on real blobs;
+    journal replay already ran if a loader touched it)."""
+    import pyabc_tpu as pt
+    h = pt.History(db, abc_id=1)
+    try:
+        return h.max_t + 1
+    finally:
+        h.close()
+
+
+_CLEAN_CACHE = {}
+
+
+def clean_run_db(workdir: str, *, evict: bool) -> str:
+    """A fault-free run of the trial configuration (cached): the
+    bit-identity baseline for absorbed faults."""
+    key = bool(evict)
+    if key in _CLEAN_CACHE:
+        return _CLEAN_CACHE[key]
+    db = os.path.join(workdir, f"clean_{'evict' if evict else 'seq'}.db")
+    with _StoreGens(1 if evict else None):
+        abc, observed, _ = _make_abc(POP, SEED, evict=evict,
+                                     checkpoint=False)
+        abc.new("sqlite:///" + db, observed)
+        abc.run(max_nr_populations=GENS)
+        abc.history.close()
+    _CLEAN_CACHE[key] = db
+    return db
+
+
+_CHILD = """
+import sys
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+from pyabc_tpu.resilience.checkpoint import Preempted
+
+db = sys.argv[1]
+models, priors, distance, observed, _ = make_two_gaussians_problem()
+kw = dict(population_size=%(pop)d, eps=pt.MedianEpsilon(),
+          sampler=pt.VectorizedSampler(), seed=%(seed)d,
+          history_mode="lazy", ingest_mode="sequential")
+if %(evict)d:
+    kw["fuse_generations"] = 3
+abc = pt.ABCSMC(models, priors, distance, **kw)
+abc.new(db, observed)
+try:
+    abc.run(max_nr_populations=%(gens)d)
+except Preempted:
+    sys.exit(17)
+sys.exit(0)
+"""
+
+
+def run_trial(trial: Trial, workdir: str, seed: int = 0) -> dict:
+    """Execute one trial end to end; returns a report dict.  Raises
+    AssertionError when an invariant fails."""
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.resilience import checkpoint as ckpt
+    from pyabc_tpu.resilience import faults
+
+    posterior_fn = make_two_gaussians_problem()[4]
+    slug = (trial.plan.replace("@", "_").replace(":", "_")
+            .replace("=", "_").replace(".", "_").replace("~", "_"))
+    db = os.path.join(workdir, f"{slug}.db")
+    report = {"plan": trial.plan, "kind": trial.kind,
+              "outcome": "completed", "recovered": False}
+    before = _egress_snapshot()
+
+    if trial.kind == "subproc":
+        script = os.path.join(workdir, f"{slug}_child.py")
+        with open(script, "w") as f:
+            f.write(_CHILD % {"pop": POP, "seed": SEED, "gens": GENS,
+                              "evict": int(trial.evict)})
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO,
+                   PYABC_TPU_FAULTS=trial.plan,
+                   PYABC_TPU_FAULT_SEED=str(seed))
+        if trial.evict:
+            env["PYABC_TPU_STORE_GENS"] = "1"
+        proc = subprocess.run(
+            [sys.executable, script, "sqlite:///" + db], env=env,
+            capture_output=True, text=True, timeout=600)
+        if "sigkill" in trial.plan and trial.must_fire:
+            assert proc.returncode == -9, (
+                f"expected SIGKILL death, got rc={proc.returncode}: "
+                f"{proc.stderr[-2000:]}")
+        report["outcome"] = ("completed" if proc.returncode == 0
+                             else f"rc={proc.returncode}")
+    else:
+        with _StoreGens(1 if trial.evict else None):
+            abc, observed, _ = _make_abc(POP, SEED, evict=trial.evict,
+                                         checkpoint=trial.checkpoint)
+            abc.new("sqlite:///" + db, observed)
+            plan = faults.install(faults.FaultPlan.parse(trial.plan,
+                                                         seed=seed))
+            try:
+                abc.run(max_nr_populations=GENS)
+            except ckpt.Preempted:
+                report["outcome"] = "preempted"
+            except Exception as err:  # crash trial: recovery must save it
+                report["outcome"] = f"crash:{type(err).__name__}"
+            finally:
+                faults.uninstall()
+                ckpt.clear_preempt()
+                abc.history.close()
+            if trial.must_fire:
+                assert plan.fired, (
+                    f"plan {trial.plan!r} never fired — the trial "
+                    f"tested nothing (visits: {plan._visits})")
+            if trial.absorbed:
+                assert report["outcome"] == "completed", (
+                    f"absorbed-class fault was not absorbed: "
+                    f"{report['outcome']}")
+
+    # recovery is driven by what phase 1 LEFT BEHIND, not by how it
+    # died: a SIGTERM at a generation boundary stops the master loop
+    # gracefully (no Preempted raised), a SIGKILL leaves whatever the
+    # journal anchored, and a kill between a materialize commit and its
+    # tombstone leaves a full DB with a pending journal payload.  A
+    # fresh process (different seed, no fault plan) runs ABCSMC.load —
+    # which replays/compacts the journal — then runs exactly the
+    # missing generations (run() counts populations from max_t + 1 on
+    # a resumed DB).
+    if report["outcome"] != "completed" or _durable_gens(db) < GENS:
+        report["recovered"] = True
+        with _StoreGens(1 if trial.evict else None):
+            abc, observed, _ = _make_abc(POP, RECOVER_SEED,
+                                         evict=trial.evict,
+                                         checkpoint=False)
+            abc.load("sqlite:///" + db)
+            done = abc.history.max_t + 1  # journal already replayed
+            if done < GENS:
+                abc.run(max_nr_populations=GENS - done)
+            abc.history.close()
+
+    check_invariants(db, POP, posterior_fn, min_gens=GENS)
+    check_egress_sum(before, _egress_snapshot())
+    if trial.absorbed and trial.kind == "inproc":
+        check_bit_identity(db, clean_run_db(workdir, evict=trial.evict),
+                           trial.plan)
+    return report
+
+
+def soak(trials, workdir=None, seed: int = 0, verbose: bool = True):
+    """Run a list of trials; returns the list of report dicts."""
+    owns = workdir is None
+    if owns:
+        workdir = tempfile.mkdtemp(prefix="chaos_soak_")
+    reports = []
+    for i, trial in enumerate(trials):
+        if verbose:
+            print(f"[chaos {i + 1}/{len(trials)}] {trial.plan} "
+                  f"({trial.kind}{', evict' if trial.evict else ''})",
+                  flush=True)
+        reports.append(run_trial(trial, workdir, seed=seed + i))
+        if verbose:
+            print(f"    -> {reports[-1]['outcome']}"
+                  + (" (recovered)" if reports[-1]["recovered"] else ""),
+                  flush=True)
+    return reports
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--trials", type=int, default=0,
+                    help="number of RANDOMIZED trials (0 = just the "
+                         "deterministic subset)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    trials = list(DETERMINISTIC_TRIALS)
+    if args.trials:
+        trials += full_matrix(random.Random(args.seed), args.trials)
+    try:
+        reports = soak(trials, workdir=args.workdir, seed=args.seed)
+    except AssertionError as err:
+        print(f"CHAOS SOAK FAILED: {err}", file=sys.stderr)
+        return 1
+    n_rec = sum(1 for r in reports if r["recovered"])
+    print(f"chaos soak: {len(reports)} trial(s) passed "
+          f"({n_rec} via recovery)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
