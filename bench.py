@@ -655,6 +655,26 @@ def main():
             _log(f"bench: {name} FAILED: {type(err).__name__}: {err}")
             extra[f"{name}_error"] = f"{type(err).__name__}: {err}"[:300]
 
+    # static-analysis gate on the same record: a bench row produced
+    # from a tree the lint rejects is not comparable (e.g. an
+    # unlabeled egress or raw dispatch skews the very counters bench
+    # reports).  In-process — graftlint imports nothing from
+    # pyabc_tpu, so it cannot perturb the measured run.
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tools.lint import run_lint
+        lint = run_lint(repo_root=repo)
+        extra["lint_findings_total"] = len(lint.findings)
+        extra["lint_runtime_s"] = round(lint.runtime_s, 2)
+        if lint.findings:
+            _log("bench: LINT DIRTY: " + "; ".join(
+                f"{f.location} [{f.rule}]" for f in lint.findings[:5]))
+    except Exception as err:  # never lose the primary line
+        _log(f"bench: lint FAILED: {type(err).__name__}: {err}")
+        extra["lint_error"] = f"{type(err).__name__}: {err}"[:300]
+
     baseline = FALLBACK_BASELINE
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BASELINE_MEASURED.json")
@@ -679,7 +699,8 @@ def main():
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
                                 "posterior_gate_", "telemetry_",
-                                "resilience_", "checkpoint_", "store_"))
+                                "resilience_", "checkpoint_", "store_",
+                                "lint_"))
                and not isinstance(v, (list, dict))}
     print(json.dumps({**header, "extra": compact}))
 

@@ -209,6 +209,14 @@ class CompiledLadder:
     compiles synchronously, exactly the pre-autotune behavior.
     """
 
+    #: lock-discipline contract, enforced by `abc-lint`.  ``_queue`` is
+    #: a thread-safe ``queue.Queue`` and intentionally unguarded.
+    _GUARDED_BY = {
+        "_cache": "_lock",
+        "_inflight": "_lock",
+        "_worker": "_lock",
+    }
+
     def __init__(self, capacity: int = 16):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1 (got {capacity})")

@@ -57,6 +57,9 @@ _SPAN_TAIL = 128
 class FlightRecorder:
     """Bounded ring of failure-path events + self-contained dump."""
 
+    #: lock-discipline contract, enforced by `abc-lint`
+    _GUARDED_BY = {"_events": "_lock"}
+
     def __init__(self, capacity: int = _CAPACITY):
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()

@@ -127,6 +127,9 @@ class MetricsRegistry:
     typo can't silently fork a metric.
     """
 
+    #: lock-discipline contract, enforced by `abc-lint`
+    _GUARDED_BY = {"_metrics": "_lock"}
+
     def __init__(self):
         self._lock = threading.RLock()
         self._metrics: Dict[str, object] = {}

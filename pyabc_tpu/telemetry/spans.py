@@ -115,6 +115,15 @@ class SpanTracer:
     :meth:`flush` when a trace path is configured.
     """
 
+    #: lock-discipline contract, enforced by `abc-lint`.  ``enabled``
+    #: is deliberately unguarded: it is the lock-free fast-path check
+    #: in begin()/end(), a benign boolean race.
+    _GUARDED_BY = {
+        "_ring": "_lock",
+        "_emit": "_lock",
+        "_path": "_lock",
+    }
+
     def __init__(self, capacity: int = 8192):
         self.enabled = False
         self.dropped = 0
@@ -160,7 +169,8 @@ class SpanTracer:
 
     @property
     def capacity(self) -> int:
-        return self._ring.maxlen
+        with self._lock:
+            return self._ring.maxlen
 
     def t0_unix(self) -> float:
         """Wall-clock (unix) instant of trace ``ts == 0``.

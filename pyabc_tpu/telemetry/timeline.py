@@ -27,6 +27,9 @@ STAGES = ("adapt", "dispatch", "compute", "fetch", "decode", "append")
 class GenerationTimeline:
     """Bounded list of per-generation stage-duration rows."""
 
+    #: lock-discipline contract, enforced by `abc-lint`
+    _GUARDED_BY = {"_rows": "_lock"}
+
     def __init__(self, max_rows: int = 4096):
         self._rows: list = []
         self._max_rows = max_rows

@@ -173,15 +173,16 @@ def summarize_device_population(dp: dict, M: int) -> dict:
     device_population``) and fetched under ``egress("summary")`` —
     O(KB) regardless of population size.  Compiles once per shape."""
     global _SUMMARIZE_JIT
-    import jax
 
     if _SUMMARIZE_JIT is None:
+        from ..autotune.ladder import jit_compile
+
         def _f(m, theta, log_weight, distance, count, M):
             import jax.numpy as jnp
             valid = jnp.arange(m.shape[0]) < count
             return summary_wire_lanes(m, theta, distance, log_weight,
                                       valid, M)
-        _SUMMARIZE_JIT = jax.jit(_f, static_argnames=("M",))
+        _SUMMARIZE_JIT = jit_compile(_f, static_argnames=("M",))
 
     from ..sampler.base import fetch_to_host
     from . import transfer
@@ -300,6 +301,18 @@ class DeviceRunStore:
     when to ``drop`` (after durable materialization) or ``drop_from``
     (pipelined rewind of speculative generations).
     """
+
+    #: lock-discipline contract, enforced by `abc-lint` (lock-discipline
+    #: rule).  ``journal`` is deliberately NOT guarded: journal calls
+    #: happen outside the store lock so there is no store->journal lock
+    #: edge (the journal serializes on its own RLock).
+    _GUARDED_BY = {
+        "_entries": "_lock",
+        "_spills": "_lock",
+        "deposits": "_lock",
+        "evictions": "_lock",
+        "hydrations": "_lock",
+    }
 
     def __init__(self, max_gens: Optional[int] = None):
         self.max_gens = int(max_gens) if max_gens else default_max_gens()

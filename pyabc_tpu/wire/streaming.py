@@ -123,6 +123,14 @@ class StreamingIngest:
     pool down and ``drain()`` abandons everything still in flight.
     """
 
+    #: lock-discipline contract, enforced by `abc-lint`: workers latch
+    #: the first exception and mutate the outstanding list concurrently
+    #: with submit/drain on the caller thread.
+    _GUARDED_BY = {
+        "_outstanding": "_lock",
+        "_failed": "_lock",
+    }
+
     def __init__(self, depth: int = 2):
         self.depth = int(depth)
         self._pool = None
@@ -163,10 +171,12 @@ class StreamingIngest:
         Blocks when ``depth`` tickets are already in flight — that wait
         is the backpressure bound, measured into the returned ticket's
         ``wait_s`` so it is never miscredited as overlap."""
-        if self._failed is not None:
+        with self._lock:
+            failed = self._failed
+        if failed is not None:
             raise WireError(
-                f"streaming ingest already failed: {self._failed!r}"
-            ) from self._failed
+                f"streaming ingest already failed: {failed!r}"
+            ) from failed
         ticket = IngestTicket(self, label)
         if self._sem is not None:
             t0 = time.perf_counter()

@@ -63,6 +63,13 @@ WATCHED = (
     # payloads and the write-ahead path is billing the steady state
     ("resilience_journal_mb", "lower", 0.25),
     ("resilience_retries", "zero", 0.0),
+    # graftlint gate on the SAME record (bench.py runs abc-lint
+    # in-process): any finding on the measured tree fails high — a
+    # bench row from a tree the lint rejects is not comparable
+    ("lint_findings_total", "zero", 0.0),
+    # and the lint itself staying cheap is part of the contract: it
+    # rides tier-1 and the bench, so a blowup here taxes every gate
+    ("lint_runtime_s", "lower", 9.0),
 )
 
 #: seconds-per-gen rows below this are timer noise, not signal

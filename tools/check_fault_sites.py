@@ -1,188 +1,19 @@
 #!/usr/bin/env python
-"""Static lint: every fault site must be planted, bounded, and tested.
-
-``pyabc_tpu/resilience/faults.py`` defines the injection sites the
-chaos harness drives (``faults.SITES``).  A site that exists in the
-tuple but is never planted in the code, or is planted OUTSIDE a
-recovery boundary, or is exercised by zero tests, gives false
-confidence: chaos runs "pass" while the failure mode they claim to
-cover is untested.  This lint closes the loop, the same way
-``check_retry_sites.py`` pins dispatches to the retry wrapper:
-
-1. **Completeness** — every ``SITE_* = "..."`` constant in faults.py
-   is listed in ``SITES``, and ``SITES`` has no strings without a
-   constant (parsed statically, no import);
-2. **Planting + boundary** — each site's constant appears in its
-   owning module TOGETHER with that site's recovery-boundary marker
-   (retry wrapper, journal append, digest verification, preemption
-   ledger...), per the manifest below;
-3. **Test coverage** — each site's literal string appears in at least
-   one file under ``tests/`` or in ``tools/chaos_soak.py`` (whose
-   deterministic subset runs in tier-1 via
-   ``tests/test_chaos_soak.py``);
-4. **Docs** — each site's literal string appears in
-   ``docs/resilience.md`` (the site x action matrix).
-
-Run directly (exits 1 on violations) or via the tier-1 wrapper
-``tests/test_fault_sites_lint.py``.
-"""
+"""Compatibility shim: this check now lives in the unified graftlint
+framework (tools/lint/rules/fault_sites.py).  Kept so existing invocations
+and muscle memory (`python tools/check_fault_sites.py`) keep working; prefer
+`abc-lint` which runs all rules in one process."""
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-#: site constant -> (planting file under pyabc_tpu/, markers that must
-#: ALL appear in that file: the constant itself plus the recovery
-#: boundary that makes an injected fault survivable)
-MANIFEST = {
-    "SITE_DISPATCH": ("sampler/base.py",
-                      ("SITE_DISPATCH", "_retry.call(")),
-    "SITE_FETCH": ("sampler/base.py",
-                   ("SITE_FETCH", "shared_policy().call(")),
-    "SITE_APPEND": ("storage/history.py",
-                    ("SITE_APPEND", "shared_policy().call(")),
-    # heartbeat writes are best-effort by design: the boundary is the
-    # monitor loop's exception tolerance, marked in parallel/health.py
-    "SITE_HEARTBEAT": ("parallel/health.py",
-                       ("SITE_HEARTBEAT", "fault_point(")),
-    # the preemption probe's boundary is the sub-checkpoint ledger:
-    # the sampler flushes and raises Preempted instead of dying dirty
-    "SITE_PREEMPT": ("sampler/vectorized.py",
-                     ("SITE_PREEMPT", "checkpointer")),
-    # deposit's boundary: the manifest record hits the journal before
-    # the deposit is acknowledged
-    "SITE_STORE_DEPOSIT": ("wire/store.py",
-                           ("SITE_STORE_DEPOSIT", "append_manifest(")),
-    "SITE_STORE_SPILL": ("wire/store.py",
-                         ("SITE_STORE_SPILL", "shared_policy().call(")),
-    # hydrate's boundary: the content digest is verified on every host
-    # decode, and the History runs the recovery ladder on mismatch
-    "SITE_STORE_HYDRATE": ("wire/store.py",
-                           ("SITE_STORE_HYDRATE", "verify_wire(")),
-    "SITE_MATERIALIZE": ("storage/history.py",
-                         ("SITE_MATERIALIZE", "shared_policy().call(")),
-    "SITE_JOURNAL": ("resilience/journal.py",
-                     ("SITE_JOURNAL", "shared_policy().call(")),
-}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-_CONST_RE = re.compile(r'^(SITE_[A-Z_]+)\s*=\s*"([^"]+)"', re.M)
-
-DOCS = "docs/resilience.md"
-CHAOS = "tools/chaos_soak.py"
-
-
-def _repo_root(root: str = None) -> str:
-    if root is not None:
-        return root
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.dirname(here)
-
-
-def _read(path: str) -> str:
-    with open(path, encoding="utf-8") as f:
-        return f.read()
-
-
-def site_constants(faults_text: str) -> dict:
-    """``{constant_name: site_string}`` parsed from faults.py source."""
-    return dict(_CONST_RE.findall(faults_text))
-
-
-def check(root: str = None) -> list:
-    """Returns ``[(where, message), ...]`` violations (empty = clean)."""
-    root = _repo_root(root)
-    pkg = os.path.join(root, "pyabc_tpu")
-    violations = []
-
-    faults_path = os.path.join(pkg, "resilience", "faults.py")
-    if not os.path.exists(faults_path):
-        return [("pyabc_tpu/resilience/faults.py", "missing")]
-    faults_text = _read(faults_path)
-    consts = site_constants(faults_text)
-
-    # 1. completeness: constants <-> SITES tuple, statically.  Every
-    # constant must be NAMED inside the SITES = (...) expression.
-    m = re.search(r"^SITES\s*=\s*\(([^)]*)\)", faults_text, re.M)
-    sites_body = m.group(1) if m else ""
-    listed = set(re.findall(r"SITE_[A-Z_]+", sites_body))
-    for name in consts:
-        if name not in listed:
-            violations.append((
-                "pyabc_tpu/resilience/faults.py",
-                f"{name} is defined but missing from SITES"))
-    for name in listed - set(consts):
-        violations.append((
-            "pyabc_tpu/resilience/faults.py",
-            f"SITES references undefined constant {name}"))
-
-    # 2. planting + recovery boundary
-    for name, site in consts.items():
-        if name not in MANIFEST:
-            violations.append((
-                "tools/check_fault_sites.py",
-                f"new site {name} ({site!r}) has no MANIFEST entry — "
-                f"declare its planting file and recovery boundary"))
-            continue
-        rel, markers = MANIFEST[name]
-        path = os.path.join(pkg, rel.replace("/", os.sep))
-        if not os.path.exists(path):
-            continue  # planted-tree tests cover subsets
-        text = _read(path)
-        for marker in markers:
-            if marker not in text:
-                violations.append((
-                    f"pyabc_tpu/{rel}",
-                    f"site {site!r}: expected marker {marker!r} not "
-                    f"found (fault plant or its recovery boundary is "
-                    f"gone)"))
-
-    # 3. test coverage: the literal site string in tests/ or the chaos
-    # harness (tier-1 runs its deterministic subset)
-    test_dir = os.path.join(root, "tests")
-    corpus = []
-    if os.path.isdir(test_dir):
-        for fn in sorted(os.listdir(test_dir)):
-            if fn.endswith(".py"):
-                corpus.append(_read(os.path.join(test_dir, fn)))
-    chaos_path = os.path.join(root, CHAOS.replace("/", os.sep))
-    if os.path.exists(chaos_path):
-        corpus.append(_read(chaos_path))
-    if corpus:
-        blob = "\n".join(corpus)
-        for name, site in consts.items():
-            if site not in blob:
-                violations.append((
-                    "tests/", f"site {site!r} is exercised by no test "
-                              f"(and absent from {CHAOS})"))
-
-    # 4. docs: the site x action matrix must list every site
-    docs_path = os.path.join(root, DOCS.replace("/", os.sep))
-    if os.path.exists(docs_path):
-        docs_text = _read(docs_path)
-        for name, site in consts.items():
-            if site not in docs_text:
-                violations.append((
-                    DOCS, f"site {site!r} missing from the fault-site "
-                          f"matrix"))
-
-    return violations
-
-
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else None
-    violations = check(root)
-    if not violations:
-        print("fault sites: clean (every site planted inside a "
-              "recovery boundary, tested, and documented)")
-        return 0
-    print("fault-site violations:")
-    for where, message in violations:
-        print(f"  {where}: {message}")
-    return 1
-
+from tools.lint.rules.fault_sites import check, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,152 +1,19 @@
 #!/usr/bin/env python
-"""Static lint: fused-chain eligibility stays flag-driven and in sync.
-
-``ABCSMC._device_chain_eligible`` decides whether a configuration's
-propose→accept→refit→new-eps chain runs inside a fused device block.
-That decision is deliberately NOT an isinstance whitelist: each
-component family owns a capability flag (``device_accept_ok`` on
-acceptors, ``device_schedule_ok``/``device_solve_ok`` on epsilon
-schedules, ``device_refit_ok`` on adaptive distances,
-``device_support_ok`` on transitions) so a component that grows a
-device path opts in where its semantics live.  The failure mode this
-lint guards against is drift: a flag renamed or dropped at its owner,
-or the eligibility body quietly reverting to type checks, silently
-sends eligible configs down the sequential path (a performance
-regression no functional test catches — results stay correct).
-
-Checks:
-
-- every capability flag is still defined in its OWNER file
-  (``FLAG_OWNERS``);
-- ``ABCSMC._device_chain_eligible``'s body consults every flag;
-- ``ABCSMC._fused_eligible`` consults the named ``PROBE_MIN_POP``
-  threshold, and neither body re-hardcodes the retired ``1 << 17``
-  population cutoff (the probe threshold must stay the single named
-  class attribute).
-
-Run directly (exits 1 on violations) or via the tier-1 wrapper
-``tests/test_fused_eligibility_lint.py``.
-"""
+"""Compatibility shim: this check now lives in the unified graftlint
+framework (tools/lint/rules/fused_eligibility.py).  Kept so existing invocations
+and muscle memory (`python tools/check_fused_eligibility.py`) keep working; prefer
+`abc-lint` which runs all rules in one process."""
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-SUPPRESS = "# eligibility-ok"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-#: capability flag -> relpath (package root) of the file that OWNS it
-FLAG_OWNERS = {
-    "device_accept_ok": "acceptor/acceptor.py",
-    "device_schedule_ok": "epsilon/base.py",
-    "device_solve_ok": "epsilon/temperature.py",
-    "device_refit_ok": "distance/distance.py",
-    "device_support_ok": "transition/base.py",
-}
-
-SMC_FILE = "smc.py"
-CHAIN_FN = "_device_chain_eligible"
-FUSED_FN = "_fused_eligible"
-PROBE_ATTR = "PROBE_MIN_POP"
-RETIRED_LITERAL = "1 << 17"
-
-
-def _package_root(root: str = None) -> str:
-    if root is not None:
-        return root
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.join(os.path.dirname(here), "pyabc_tpu")
-
-
-def _function_segment(text: str, name: str):
-    """(source, lineno) of def ``name`` anywhere in ``text`` (class
-    methods included), or (None, 0) when absent/unparsable."""
-    try:
-        tree = ast.parse(text)
-    except SyntaxError:
-        return None, 0
-    for node in ast.walk(tree):
-        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name == name):
-            lines = text.splitlines()
-            seg = "\n".join(lines[node.lineno - 1:node.end_lineno])
-            return seg, node.lineno
-    return None, 0
-
-
-def check(root: str = None) -> list:
-    """Returns ``[(relpath, lineno, message), ...]`` violations
-    (empty = clean).  Files absent from ``root`` are skipped so
-    planted-tree tests can cover subsets."""
-    root = _package_root(root)
-    violations = []
-    for flag, rel in FLAG_OWNERS.items():
-        path = os.path.join(root, rel.replace("/", os.sep))
-        if not os.path.exists(path):
-            continue
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-        if flag not in text:
-            violations.append((
-                rel, 0,
-                f"capability flag {flag!r} no longer defined in its "
-                f"owner file"))
-    smc_path = os.path.join(root, SMC_FILE)
-    if os.path.exists(smc_path):
-        with open(smc_path, encoding="utf-8") as f:
-            text = f.read()
-        chain_src, chain_line = _function_segment(text, CHAIN_FN)
-        if chain_src is None:
-            violations.append((SMC_FILE, 0,
-                               f"{CHAIN_FN}() not found"))
-        else:
-            if SUPPRESS not in chain_src:
-                for flag in FLAG_OWNERS:
-                    if flag not in chain_src:
-                        violations.append((
-                            SMC_FILE, chain_line,
-                            f"{CHAIN_FN}() no longer consults "
-                            f"{flag!r}"))
-                if RETIRED_LITERAL in chain_src:
-                    violations.append((
-                        SMC_FILE, chain_line,
-                        f"{CHAIN_FN}() hardcodes {RETIRED_LITERAL!r}; "
-                        f"use the named {PROBE_ATTR} attribute"))
-        fused_src, fused_line = _function_segment(text, FUSED_FN)
-        if fused_src is None:
-            violations.append((SMC_FILE, 0,
-                               f"{FUSED_FN}() not found"))
-        elif SUPPRESS not in fused_src:
-            if PROBE_ATTR not in fused_src:
-                violations.append((
-                    SMC_FILE, fused_line,
-                    f"{FUSED_FN}() no longer consults {PROBE_ATTR} "
-                    f"(the at-scale engine probe threshold)"))
-            if RETIRED_LITERAL in fused_src:
-                violations.append((
-                    SMC_FILE, fused_line,
-                    f"{FUSED_FN}() hardcodes {RETIRED_LITERAL!r}; use "
-                    f"the named {PROBE_ATTR} attribute"))
-    return violations
-
-
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else None
-    violations = check(root)
-    if not violations:
-        print("fused eligibility: clean (capability flags defined at "
-              "their owners and consulted by the eligibility checks)")
-        return 0
-    print("fused-eligibility violations (keep _device_chain_eligible "
-          "flag-driven and the probe threshold named; justify with "
-          f"'{SUPPRESS}'):")
-    for rel, lineno, msg in violations:
-        loc = f"pyabc_tpu/{rel}" + (f":{lineno}" if lineno else "")
-        print(f"  {loc}: {msg}")
-    return 1
-
+from tools.lint.rules.fused_eligibility import check, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

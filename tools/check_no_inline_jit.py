@@ -1,99 +1,19 @@
 #!/usr/bin/env python
-"""Static lint: per-generation code paths must not call ``jax.jit``.
-
-``pyabc_tpu/autotune/`` is THE compile chokepoint — its
-:func:`~pyabc_tpu.autotune.ladder.jit_compile` wrapper is how hot-path
-modules stage programs, so every compiled program lives in a bounded
-:class:`~pyabc_tpu.autotune.ladder.CompiledLadder`, shows up on the
-``xla_compiles_total`` / ``compile.miss`` telemetry, and is reachable
-by the AOT prewarm.  An inline ``jax.jit`` in a per-generation module
-re-opens the door to the pre-autotune failure mode: an unbounded
-anonymous program cache that recompiles invisibly in steady state —
-exactly what the zero-recompile acceptance test exists to prevent.
-
-Scope: the per-generation orchestration surface — ``sampler/``,
-``wire/`` and ``smc.py``.  Cold-path modules (ops/, distance/,
-epsilon/ ...) may still jit at module import or fit time; they are
-outside the scan on purpose.  ``autotune/`` itself is the one place
-allowed to touch ``jax.jit``.
-
-Suppress a deliberate exception with a ``# jit-ok`` comment on the
-same line (none exist today; a new one should come with a review
-argument for why the ladder may not own that program).
-
-Run directly (exits 1 on violations) or via the tier-1 wrapper
-``tests/test_no_inline_jit.py``.
-"""
+"""Compatibility shim: this check now lives in the unified graftlint
+framework (tools/lint/rules/no_inline_jit.py).  Kept so existing invocations
+and muscle memory (`python tools/check_no_inline_jit.py`) keep working; prefer
+`abc-lint` which runs all rules in one process."""
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-#: per-generation surface to scan (package-root-relative, forward
-#: slashes); everything else is cold path and out of scope
-SCAN_PREFIXES = ("sampler/", "wire/", "autotune/")
-SCAN_FILES = ("smc.py",)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-#: the compile chokepoint itself may call jax.jit
-ALLOWLIST_PREFIXES = ("autotune/",)
-
-SUPPRESS = "# jit-ok"
-
-# jax.jit / jax.pjit as a call or decorator; functools-partial'd forms
-# like ``partial(jax.jit, ...)`` match too (they contain the token)
-_INLINE_JIT = re.compile(r"\bjax\.p?jit\b")
-
-
-def _package_root(root: str = None) -> str:
-    if root is not None:
-        return root
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.join(os.path.dirname(here), "pyabc_tpu")
-
-
-def check(root: str = None) -> list:
-    """Scan the per-generation surface; returns
-    ``[(relpath, lineno, line), ...]`` violations (empty = clean)."""
-    root = _package_root(root)
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            if not (rel in SCAN_FILES
-                    or rel.startswith(SCAN_PREFIXES)):
-                continue
-            if rel.startswith(ALLOWLIST_PREFIXES):
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if SUPPRESS in line:
-                        continue
-                    code = line.split("#", 1)[0]
-                    if _INLINE_JIT.search(code):
-                        violations.append((rel, lineno, line.rstrip()))
-    return violations
-
-
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else None
-    violations = check(root)
-    if not violations:
-        print("inline jit: clean (per-generation paths compile via "
-              "pyabc_tpu.autotune)")
-        return 0
-    print("inline jax.jit in per-generation code (stage programs via "
-          "pyabc_tpu.autotune.jit_compile so the ladder/telemetry own "
-          f"them, or justify with '{SUPPRESS}'):")
-    for rel, lineno, line in violations:
-        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
-    return 1
-
+from tools.lint.rules.no_inline_jit import check, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,111 +1,19 @@
 #!/usr/bin/env python
-"""Static lint: hot-loop device dispatches must route through retry.
-
-``pyabc_tpu/resilience/retry.py`` wraps every device dispatch and the
-d2h chokepoint in bounded-backoff retry with transient-vs-fatal
-classification.  A raw call to one of the sampler's compiled loop
-functions (``step``/``finalize``/...) or the orchestrator's block
-function bypasses that policy: a transient relay/runtime hiccup then
-kills the whole run instead of costing one backoff — and the
-``resilience_*`` telemetry under-reports, exactly the silent-regression
-class ``tools/check_wire_chokepoint.py`` exists for on the wire side.
-
-Checks (manifest-scoped: only the files that own dispatch sites):
-
-- ``sampler/vectorized.py``: any direct call of a stateful-loop
-  function (``start``/``step``/``finalize``/``harvest``/``reset``/
-  ``step_finalize``) must go through ``self._dispatch(...)``;
-- ``smc.py``: the fused/pipelined block dispatch ``fn(carry_in, ...)``
-  must go through ``self._retry.call(...)``;
-- ``sampler/base.py`` must still route ``fetch_to_host`` through the
-  shared retry policy at the ``SITE_FETCH`` site (the d2h chokepoint
-  keeps its retry wrapper).
-
-Compile/trace-time uses (``jit_compile(step, ...)``, ``eval_shape``)
-pass a function OBJECT, not a call, so they do not match.  Suppress a
-deliberate raw dispatch with a ``# retry-ok`` comment on the line.
-
-Run directly (exits 1 on violations) or via the tier-1 wrapper
-``tests/test_retry_sites.py``.
-"""
+"""Compatibility shim: this check now lives in the unified graftlint
+framework (tools/lint/rules/retry_sites.py).  Kept so existing invocations
+and muscle memory (`python tools/check_retry_sites.py`) keep working; prefer
+`abc-lint` which runs all rules in one process."""
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-SUPPRESS = "# retry-ok"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-#: relpath (package root, forward slashes) -> raw-dispatch smell
-MANIFEST = {
-    "sampler/vectorized.py": re.compile(
-        r"\b(?:step_finalize|step|finalize|harvest|start|reset)\s*\("),
-    "smc.py": re.compile(r"\bfn\s*\(\s*carry_in"),
-}
-
-#: a smelly line is clean when the call is routed through either wrapper
-_ROUTED = ("_dispatch(", "_retry.call(")
-
-#: the d2h chokepoint must keep its retry wrapper: both markers present
-CHOKEPOINT_FILE = "sampler/base.py"
-CHOKEPOINT_MARKERS = ("SITE_FETCH", "shared_policy")
-
-
-def _package_root(root: str = None) -> str:
-    if root is not None:
-        return root
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.join(os.path.dirname(here), "pyabc_tpu")
-
-
-def check(root: str = None) -> list:
-    """Scan the manifest files; returns ``[(relpath, lineno, line), ...]``
-    violations (empty = clean)."""
-    root = _package_root(root)
-    violations = []
-    for rel, smell in MANIFEST.items():
-        path = os.path.join(root, rel.replace("/", os.sep))
-        if not os.path.exists(path):
-            continue  # planted-tree tests cover subsets of the manifest
-        with open(path, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                if SUPPRESS in line:
-                    continue
-                code = line.split("#", 1)[0]
-                if not smell.search(code):
-                    continue
-                if any(marker in code for marker in _ROUTED):
-                    continue
-                violations.append((rel, lineno, line.rstrip()))
-    chokepoint = os.path.join(root, CHOKEPOINT_FILE.replace("/", os.sep))
-    if os.path.exists(chokepoint):
-        with open(chokepoint, encoding="utf-8") as f:
-            text = f.read()
-        for marker in CHOKEPOINT_MARKERS:
-            if marker not in text:
-                violations.append((
-                    CHOKEPOINT_FILE, 0,
-                    f"fetch_to_host lost its retry wrapper (no "
-                    f"{marker!r} in the file)"))
-    return violations
-
-
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else None
-    violations = check(root)
-    if not violations:
-        print("retry sites: clean (all hot-loop dispatches route "
-              "through resilience/retry.py)")
-        return 0
-    print("retry-site violations (route dispatches through "
-          "self._dispatch / self._retry.call, or justify with "
-          f"'{SUPPRESS}'):")
-    for rel, lineno, line in violations:
-        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
-    return 1
-
+from tools.lint.rules.retry_sites import check, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

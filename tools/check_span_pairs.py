@@ -1,110 +1,19 @@
 #!/usr/bin/env python
-"""Static lint: every explicit span ``begin()`` must have an ``end()``.
-
-``telemetry/spans.py`` offers two APIs: the ``with span(...)`` context
-manager (cannot leak) and the explicit ``tok = spans.begin(...)`` /
-``spans.end(tok)`` pair for spans that outlive a scope — ingest queue
-tickets, the ``GenStream`` per-generation span.  An explicit begin
-whose token is dropped, or whose token is never passed to ``end()``
-anywhere in the same file, produces a span that silently never closes:
-the Chrome trace shows an open track to the end of the process, the
-fleet merge inherits the garbage, and — worse — nobody notices until a
-trace is actually read.
-
-Rules (package-wide, ``telemetry/spans.py`` itself exempt):
-
-- a ``spans.begin(...)`` / ``telemetry.begin(...)`` call must assign
-  its token (``tok = spans.begin(...)``) — a bare call discards the
-  only handle that can ever close the span;
-- the assignment target's name must appear inside some ``spans.end(...)``
-  argument in the SAME file (helpers like ``_end_span`` keep the
-  ``end()`` call in-file, so this stays a per-file property).
-
-Suppress a deliberate open-ended span with ``# span-ok`` on the line.
-
-Run directly (exits 1 on violations) or via the tier-1 wrapper
-``tests/test_span_pairs_lint.py``.
-"""
+"""Compatibility shim: this check now lives in the unified graftlint
+framework (tools/lint/rules/span_pairs.py).  Kept so existing invocations
+and muscle memory (`python tools/check_span_pairs.py`) keep working; prefer
+`abc-lint` which runs all rules in one process."""
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-SUPPRESS = "# span-ok"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-#: files that define the API rather than use it
-EXEMPT = {"telemetry/spans.py"}
-
-_BEGIN = re.compile(r"(?:spans|telemetry)\.begin\s*\(")
-_ASSIGNED_BEGIN = re.compile(
-    r"^\s*(?P<target>[A-Za-z_][\w.]*)\s*=\s*(?:spans|telemetry)\.begin\s*\(")
-_END = re.compile(r"(?:spans|telemetry)\.end\s*\((?P<arg>[^)]*)")
-
-
-def _package_root(root: str = None) -> str:
-    if root is not None:
-        return root
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.join(os.path.dirname(here), "pyabc_tpu")
-
-
-def _py_files(root: str):
-    for dirpath, _, names in os.walk(root):
-        for name in sorted(names):
-            if name.endswith(".py"):
-                path = os.path.join(dirpath, name)
-                yield os.path.relpath(path, root).replace(os.sep, "/"), path
-
-
-def check(root: str = None) -> list:
-    """Scan the package; returns ``[(relpath, lineno, line), ...]``
-    violations (empty = clean)."""
-    root = _package_root(root)
-    violations = []
-    for rel, path in _py_files(root):
-        if rel in EXEMPT:
-            continue
-        with open(path, encoding="utf-8") as f:
-            lines = f.read().splitlines()
-        end_args = " ".join(m.group("arg")
-                            for line in lines
-                            for m in [_END.search(line.split("#", 1)[0])]
-                            if m)
-        for lineno, line in enumerate(lines, 1):
-            if SUPPRESS in line:
-                continue
-            code = line.split("#", 1)[0]
-            if not _BEGIN.search(code):
-                continue
-            m = _ASSIGNED_BEGIN.match(code)
-            if m is None:
-                violations.append((rel, lineno, line.rstrip()))
-                continue
-            # 'self._q_span' -> '_q_span': the attribute travels across
-            # objects (ticket._q_span), the receiver name does not
-            token = m.group("target").rsplit(".", 1)[-1]
-            if token not in end_args:
-                violations.append((rel, lineno, line.rstrip()))
-    return violations
-
-
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else None
-    violations = check(root)
-    if not violations:
-        print("span pairs: clean (every explicit begin() has a "
-              "matching end())")
-        return 0
-    print("span-pair violations (assign the begin() token and pass it "
-          f"to spans.end() in the same file, or justify with "
-          f"'{SUPPRESS}'):")
-    for rel, lineno, line in violations:
-        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
-    return 1
-
+from tools.lint.rules.span_pairs import check, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

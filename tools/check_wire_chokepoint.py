@@ -1,120 +1,19 @@
 #!/usr/bin/env python
-"""Static lint: all device->host traffic must route through the wire.
-
-``pyabc_tpu/sampler/base.py:fetch_to_host`` is THE d2h chokepoint — it
-syncs the producing computation (booking the wait to ``compute_s``),
-times the pure transfer, and charges bytes to the process-global wire
-ledger (``pyabc_tpu/wire/transfer.py``).  A module that calls
-``jax.device_get`` directly moves bytes the ledger never sees, so bench
-rows, heartbeat throughput and the d2h_mb_per_s bandwidth figure all
-silently under-report — exactly the regression class this repo's
-north-star work is about.
-
-Checks over every ``pyabc_tpu/**/*.py`` outside the allowlist
-(``wire/`` and ``sampler/base.py``, the chokepoint itself):
-
-- no ``device_get`` occurrence (call or attribute);
-- no ``np.asarray(...)`` whose argument text smells like a device
-  array (heuristic: names/attributes ending in ``_dev`` or prefixed
-  ``dev_``, or ``.addressable_shards`` access) — ``np.asarray`` on a
-  jax Array is an implicit, unledgered transfer.
-
-A second, package-wide check (allowlist included — the wire itself
-must label its own traffic correctly): every literal
-``egress("<label>")`` attribution must use a label from the ledger's
-``EGRESS_SUBSYSTEMS`` — a typo'd label books bytes to a bucket no
-dashboard or sentinel watches, which is the same silent-under-report
-failure through the front door.
-
-Suppress a deliberate exception with a ``# wire-ok`` comment on the
-same line (none exist today; a new one should come with a review
-argument for why the ledger may miss it).
-
-Run directly (exits 1 on violations) or via the tier-1 wrapper
-``tests/test_wire_chokepoint.py``.
-"""
+"""Compatibility shim: this check now lives in the unified graftlint
+framework (tools/lint/rules/wire_chokepoint.py).  Kept so existing invocations
+and muscle memory (`python tools/check_wire_chokepoint.py`) keep working; prefer
+`abc-lint` which runs all rules in one process."""
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-#: paths (relative to the package root, forward slashes) exempt from the
-#: scan: the wire itself and the chokepoint module
-ALLOWLIST_PREFIXES = ("wire/",)
-ALLOWLIST_FILES = ("sampler/base.py",)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-SUPPRESS = "# wire-ok"
-
-_DEVICE_GET = re.compile(r"\bdevice_get\b")
-# np.asarray(<something device-smelling>): conservative textual heuristic
-_ASARRAY_DEVICE = re.compile(
-    r"np\.asarray\(\s*(?:\w+_dev\b|dev_\w+|\w+(?:\.\w+)*"
-    r"\.addressable_shards)")
-
-#: must mirror pyabc_tpu/wire/transfer.py:EGRESS_SUBSYSTEMS — kept as a
-#: literal so the lint runs without importing (and thus initializing)
-#: jax; drift is caught by the wrapper test comparing the two tuples
-EGRESS_SUBSYSTEMS = ("population", "history", "checkpoint", "summary",
-                     "control", "other")
-# literal-label egress attribution: egress("...") / egress('...')
-_EGRESS_CALL = re.compile(r"\begress\(\s*([\"'])([^\"']*)\1")
-
-
-def _package_root(root: str = None) -> str:
-    if root is not None:
-        return root
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.join(os.path.dirname(here), "pyabc_tpu")
-
-
-def check(root: str = None) -> list:
-    """Scan the package tree; returns ``[(relpath, lineno, line), ...]``
-    violations (empty = clean)."""
-    root = _package_root(root)
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            allowlisted = (rel in ALLOWLIST_FILES
-                           or rel.startswith(ALLOWLIST_PREFIXES))
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if SUPPRESS in line:
-                        continue
-                    code = line.split("#", 1)[0]
-                    # label lint runs EVERYWHERE (wire/ included)
-                    m = _EGRESS_CALL.search(code)
-                    if m and m.group(2) not in EGRESS_SUBSYSTEMS:
-                        violations.append((rel, lineno, line.rstrip()))
-                        continue
-                    if allowlisted:
-                        continue
-                    if _DEVICE_GET.search(code) \
-                            or _ASARRAY_DEVICE.search(code):
-                        violations.append((rel, lineno, line.rstrip()))
-    return violations
-
-
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else None
-    violations = check(root)
-    if not violations:
-        print("wire chokepoint: clean "
-              "(all d2h routes through fetch_to_host)")
-        return 0
-    print("wire chokepoint violations (route d2h through "
-          "pyabc_tpu.sampler.base.fetch_to_host, or justify with "
-          f"'{SUPPRESS}'):")
-    for rel, lineno, line in violations:
-        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
-    return 1
-
+from tools.lint.rules.wire_chokepoint import check, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,3 @@
+import os
+
+UNDOCUMENTED = os.environ.get("PYABC_TPU_FIXTURE_KNOB", "0")  # graftlint: allow(env-drift)

@@ -1,0 +1,5 @@
+SITE_DISPATCH = "dispatch"
+
+SITES = (
+    SITE_DISPATCH,
+)

@@ -1,0 +1,5 @@
+SITE_DISPATCH = "dispatch"
+
+
+def run(self, fn, *args):
+    return self._retry.call(SITE_DISPATCH, fn, *args)

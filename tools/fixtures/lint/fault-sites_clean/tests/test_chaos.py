@@ -1,0 +1,2 @@
+def test_dispatch_site():
+    assert "dispatch"

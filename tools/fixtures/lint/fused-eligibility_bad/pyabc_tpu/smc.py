@@ -1,0 +1,9 @@
+class ABCSMC:
+    def _device_chain_eligible(self):
+        return (self.acceptor.device_accept_ok
+                and self.eps.device_schedule_ok
+                and self.eps.device_solve_ok
+                and self.transition.device_support_ok)
+
+    def _fused_eligible(self, n):
+        return n >= self.PROBE_MIN_POP

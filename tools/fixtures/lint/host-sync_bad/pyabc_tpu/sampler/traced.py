@@ -1,0 +1,16 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def reduce_traced(x):
+    y = jnp.sum(x)
+    return float(y)
+
+
+def body(carry, t):
+    return carry, jax.device_get(t)
+
+
+def run(xs):
+    return jax.lax.scan(body, 0.0, xs)

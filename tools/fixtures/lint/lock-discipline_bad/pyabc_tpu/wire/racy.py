@@ -1,0 +1,48 @@
+import threading
+
+
+class Ring:
+    _GUARDED_BY = {"_items": "_lock"}
+
+    def __init__(self):
+        self._items = []
+        self._lock = threading.Lock()
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def peek(self):
+        return self._items[-1]
+
+
+class Depot:
+    _GUARDED_BY = {"_slots": "_dlock"}
+
+    def __init__(self, ring):
+        self._slots = {}
+        self._dlock = threading.Lock()
+        self.ring = ring
+
+    def stash(self, k, v):
+        with self._dlock:
+            self._slots[k] = v
+            self.ring.drain_ring(k)
+
+
+class Drainer:
+    _GUARDED_BY = {"_buf": "_lock"}
+
+    def __init__(self, depot):
+        self._buf = []
+        self._lock = threading.Lock()
+        self.depot = depot
+
+    def drain_ring(self, k):
+        with self._lock:
+            self._buf.append(k)
+
+    def push_back(self, k, v):
+        with self._lock:
+            self._buf.append(k)
+            self.depot.stash(k, v)

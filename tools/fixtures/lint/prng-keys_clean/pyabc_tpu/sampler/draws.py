@@ -1,0 +1,17 @@
+import jax
+
+
+def double_draw(key):
+    a = jax.random.normal(key)
+    b = jax.random.uniform(key)  # graftlint: allow(prng-keys)
+    return a + b
+
+
+def body(carry, t):
+    key, acc = carry
+    x = jax.random.normal(key)
+    return (key, acc + x), x  # graftlint: allow(prng-keys)
+
+
+def run(key0, xs):
+    return jax.lax.scan(body, (key0, 0.0), xs)

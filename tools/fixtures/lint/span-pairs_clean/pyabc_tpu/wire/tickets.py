@@ -1,0 +1,4 @@
+def open_only(spans):
+    tok = spans.begin("ingest.queue")  # graftlint: allow(span-pairs)
+    spans.begin("ingest.work")  # graftlint: allow(span-pairs)
+    return tok

@@ -1,0 +1,8 @@
+import jax
+
+
+def grab(arr, transfer):
+    host = jax.device_get(arr)
+    with transfer.egress("particles"):
+        pass
+    return host

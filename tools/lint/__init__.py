@@ -1,0 +1,19 @@
+"""graftlint: the unified static-analysis framework for this repo.
+
+Public surface::
+
+    from tools.lint import run_lint
+    result = run_lint()              # all ten rules, repo defaults
+    result = run_lint(rule_ids=["host-sync"])
+
+See tools/lint/core.py for the framework, tools/lint/rules/ for the
+rules, and docs/linting.md for the operator-facing catalog.
+"""
+
+from .core import (ALLOW_RE, Finding, LintResult, LintTree, RULES, Rule,
+                   all_rule_ids, register, render_json, render_text,
+                   run_lint)
+
+__all__ = ["ALLOW_RE", "Finding", "LintResult", "LintTree", "RULES",
+           "Rule", "all_rule_ids", "register", "render_json",
+           "render_text", "run_lint"]

@@ -1,0 +1,72 @@
+"""``abc-lint``: run the graftlint rule suite in one process.
+
+Usage::
+
+    abc-lint                      # all ten rules over the repo
+    abc-lint --rule host-sync --rule prng-keys
+    abc-lint --json               # machine-readable (bench ingests this)
+    abc-lint --list               # rule catalog
+    abc-lint --root /path/to/checkout
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  Also runnable as
+``python -m tools.lint.cli`` or ``python tools/lint/cli.py`` from a
+checkout without installing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _bootstrap():
+    """Make ``tools.lint`` importable when run as a bare script."""
+    if __package__:
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+def main(argv=None) -> int:
+    _bootstrap()
+    from tools.lint.core import (RULES, all_rule_ids, render_json,
+                                 render_text, run_lint)
+    parser = argparse.ArgumentParser(
+        prog="abc-lint",
+        description="graftlint: unified static analysis for pyabc_tpu")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: inferred from the "
+                             "installed tools/ package)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="ID",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit machine-readable JSON")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        ids = all_rule_ids()
+        width = max(len(i) for i in ids)
+        for rid in ids:
+            cls = RULES[rid]
+            print(f"{rid:<{width}}  [{cls.severity}]  "
+                  f"{cls.description}")
+        return 0
+
+    try:
+        result = run_lint(repo_root=args.root, rule_ids=args.rule)
+    except KeyError as exc:
+        print(f"abc-lint: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    print(render_json(result) if args.json else render_text(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

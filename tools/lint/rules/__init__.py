@@ -1,0 +1,15 @@
+"""graftlint rule modules — importing this package registers all ten
+rules with :data:`tools.lint.core.RULES` (registration order is the
+default run order: the six ported gates first, then the new
+analyzers)."""
+
+from . import wire_chokepoint    # noqa: F401
+from . import no_inline_jit      # noqa: F401
+from . import retry_sites        # noqa: F401
+from . import fused_eligibility  # noqa: F401
+from . import span_pairs         # noqa: F401
+from . import fault_sites        # noqa: F401
+from . import host_sync          # noqa: F401
+from . import lock_discipline    # noqa: F401
+from . import prng_keys          # noqa: F401
+from . import env_drift          # noqa: F401

@@ -1,0 +1,84 @@
+"""Rule ``env-drift``: the ``PYABC_TPU_*`` environment surface in code
+and in docs is the SAME set.
+
+Every operational knob in this repo is a ``PYABC_TPU_*`` environment
+variable, and ``docs/`` is the contract for operators driving fleet
+runs.  Drift is deadly in both directions: an undocumented variable is
+a knob nobody can discover (it gets re-invented under a second name),
+and a documented-but-removed variable is an operator setting it in a
+launch script and silently getting the default.
+
+Check: collect every ``PYABC_TPU_[A-Z0-9_]+`` token from
+``pyabc_tpu/**/*.py`` and from ``docs/*.md``; the two sets must be
+equal.  The allowlist below is deliberately EMPTY at seed — add a
+variable only with a justification comment (e.g. a var that exists
+solely for a test harness and must not be in operator docs).
+
+Findings are anchored to the first occurrence (code side) or the docs
+file (docs side).  Inline ``# graftlint: allow(env-drift)`` on the
+defining line also works for code-side findings.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+from ..core import Finding, Rule, register
+
+_VAR = re.compile(r"\bPYABC_TPU_[A-Z0-9_]+\b")
+
+#: vars exempt from the two-way check.  EMPTY on purpose — grow it
+#: only with a justification comment per entry.
+ALLOWLIST: frozenset = frozenset()
+
+
+def check(package_files, docs_files) -> List[Tuple[str, int, str]]:
+    """Both arguments are iterables of objects with ``.rel``,
+    ``.lines``; returns ``[(rel, lineno, message), ...]`` where rel is
+    the argument object's own rel path."""
+    code_first: dict = {}   # var -> (rel, lineno)
+    for sf in package_files:
+        for lineno, line in enumerate(sf.lines, 1):
+            for var in _VAR.findall(line):
+                code_first.setdefault(var, (sf.rel, lineno))
+    docs_first: dict = {}
+    for sf in docs_files:
+        for lineno, line in enumerate(sf.lines, 1):
+            for var in _VAR.findall(line):
+                docs_first.setdefault(var, (sf.rel, lineno))
+    violations: List[Tuple[str, int, str]] = []
+    for var in sorted(set(code_first) - set(docs_first) - ALLOWLIST):
+        rel, lineno = code_first[var]
+        violations.append((
+            rel, lineno,
+            f"{var} is read in code but documented nowhere under "
+            f"docs/ — add it to the relevant ops doc"))
+    for var in sorted(set(docs_first) - set(code_first) - ALLOWLIST):
+        rel, lineno = docs_first[var]
+        violations.append((
+            rel, lineno,
+            f"{var} is documented but no longer read by any code — "
+            f"drop it from the docs or restore the knob"))
+    violations.sort()
+    return violations
+
+
+@register
+class EnvDriftRule(Rule):
+    id = "env-drift"
+    description = ("every PYABC_TPU_* env var is documented, and every "
+                   "documented one still exists in code")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        pkg = tree.package_files()
+        docs = tree.repo_glob("docs", ".md")
+        out = []
+        for rel, lineno, msg in check(pkg, docs):
+            # package files carry package-relative rels; docs carry
+            # repo-relative rels already
+            path = rel if rel.startswith("docs/") \
+                else f"{prefix}/{rel}"
+            out.append(Finding(self.id, path, lineno, msg))
+        return out

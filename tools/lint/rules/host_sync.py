@@ -1,0 +1,317 @@
+"""Rule ``host-sync``: no host synchronization inside traced code.
+
+A ``float(x)`` / ``x.item()`` / ``bool(x)`` / ``np.asarray(x)`` /
+``jax.device_get(x)`` / ``x.block_until_ready()`` on a traced value is
+one of two bugs, both invisible at the call site:
+
+- inside a jitted function or a ``lax.scan``/``while_loop``/``cond``
+  body it raises ``TracerArrayConversionError`` at trace time — or
+  worse, silently bakes a concrete value in via weak typing of a
+  Python scalar, so the compiled program is wrong for every later
+  input;
+- on an abstract-in-practice value (a not-yet-ready device array) it
+  blocks the host thread mid-pipeline, serializing the very dispatch
+  the fused blocks exist to overlap.
+
+The analyzer finds **traced roots** — functions decorated with
+``jit_compile``/``jax.jit`` (directly or via ``partial``), and
+functions passed by name into ``jit_compile``/``jax.jit``/
+``lax.scan``/``while_loop``/``cond``/``fori_loop`` — then propagates
+traced-ness through the module-local call graph (bare-name calls and
+``self.method`` calls).  Inside traced code it flags:
+
+- any ``device_get`` call, ``.block_until_ready()`` or ``.item()``
+  (these have NO legitimate traced use);
+- ``float()``/``int()``/``bool()``/``np.asarray()`` applied to a
+  *device-suspect* name: a function parameter (minus names listed in
+  a literal ``static_argnames``) or a local assigned from a
+  ``jnp.``/``jax.``/``lax.`` expression.  Host-side casts of plain
+  Python values stay legal.
+
+Suppress a deliberate host sync (e.g. behind a
+``jax.experimental.io_callback``) with
+``# graftlint: allow(host-sync)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (Finding, Rule, ancestors, attach_parents, dotted_name,
+                    register)
+
+#: callable names that put their function-Name arguments under trace
+_TRACING_CALLS = {
+    "jit_compile", "autotune.jit_compile",
+    "jax.jit", "jax.pjit", "jit",
+    "lax.scan", "jax.lax.scan",
+    "lax.while_loop", "jax.lax.while_loop",
+    "lax.cond", "jax.lax.cond",
+    "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.switch", "jax.lax.switch",
+}
+
+#: decorator names that make the decorated function a traced root
+_TRACING_DECORATORS = {"jit_compile", "autotune.jit_compile",
+                       "jax.jit", "jax.pjit", "jit"}
+
+#: builtins that concretize their argument
+_CAST_FUNCS = {"float", "int", "bool"}
+
+#: value-expression prefixes that mark a local as device-suspect
+_DEVICE_PREFIXES = ("jnp.", "jax.", "lax.")
+
+
+def _func_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def _static_argnames(deco: ast.AST) -> Set[str]:
+    """Literal ``static_argnames`` strings from a jit-ish decorator
+    call (``@partial(jit_compile, static_argnames=("n",))``)."""
+    out: Set[str] = set()
+    if not isinstance(deco, ast.Call):
+        return out
+    for kw in deco.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    out.add(node.value)
+    return out
+
+
+def _is_tracing_decorator(deco: ast.AST) -> bool:
+    name = dotted_name(deco)
+    if name in _TRACING_DECORATORS:
+        return True
+    if isinstance(deco, ast.Call):
+        inner = dotted_name(deco.func)
+        if inner in _TRACING_DECORATORS:
+            return True
+        # @partial(jit_compile, ...): the traced wrapper is arg 0
+        if inner in ("partial", "functools.partial") and deco.args:
+            if dotted_name(deco.args[0]) in _TRACING_DECORATORS:
+                return True
+    return False
+
+
+class _ModuleIndex:
+    """Per-module function table + call graph + traced-root seeds."""
+
+    def __init__(self, tree: ast.Module):
+        attach_parents(tree)
+        #: resolution key -> FunctionDef.  Bare names resolve module
+        #: functions and nested defs; "ClassName.meth" resolves methods.
+        self.funcs: Dict[str, ast.FunctionDef] = {}
+        self.by_node: Dict[ast.FunctionDef, str] = {}
+        self.static_args: Dict[str, Set[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            cls = self._enclosing_class(node)
+            key = f"{cls}.{node.name}" if cls else node.name
+            self.funcs.setdefault(key, node)
+            # bare-name fallback so ``self.f`` vs ``f`` both resolve
+            self.funcs.setdefault(node.name, node)
+            self.by_node[node] = key
+        self.traced: Set[ast.FunctionDef] = set()
+        self._seed_roots(tree)
+        self._propagate()
+
+    @staticmethod
+    def _enclosing_class(node: ast.AST) -> Optional[str]:
+        for anc in ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+        return None
+
+    def _seed_roots(self, tree: ast.Module):
+        for node in self.by_node:
+            for deco in node.decorator_list:
+                if _is_tracing_decorator(deco):
+                    self.traced.add(node)
+                    self.static_args[node.name] = _static_argnames(deco)
+        for call in (n for n in ast.walk(tree)
+                     if isinstance(n, ast.Call)):
+            name = _func_name(call)
+            if name not in _TRACING_CALLS:
+                continue
+            statics = _static_argnames(call)
+            for arg in list(call.args) + [kw.value for kw in
+                                          call.keywords]:
+                target = None
+                if isinstance(arg, ast.Name):
+                    target = self.funcs.get(arg.id)
+                elif isinstance(arg, ast.Attribute) \
+                        and isinstance(arg.value, ast.Name) \
+                        and arg.value.id in ("self", "cls"):
+                    target = self.funcs.get(arg.attr)
+                if target is not None:
+                    self.traced.add(target)
+                    if statics:
+                        self.static_args.setdefault(
+                            target.name, set()).update(statics)
+
+    def _callees(self, fn: ast.FunctionDef) -> Set[ast.FunctionDef]:
+        out: Set[ast.FunctionDef] = set()
+        for call in iter_own_nodes(fn, ast.Call):
+            func = call.func
+            target = None
+            if isinstance(func, ast.Name):
+                target = self.funcs.get(func.id)
+            elif isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in ("self", "cls"):
+                cls = self._enclosing_class(fn)
+                target = (self.funcs.get(f"{cls}.{func.attr}")
+                          if cls else None) or self.funcs.get(func.attr)
+            if target is not None and target is not fn:
+                out.add(target)
+        return out
+
+    def _propagate(self):
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                for callee in self._callees(fn):
+                    if callee not in self.traced:
+                        self.traced.add(callee)
+                        changed = True
+
+
+def iter_own_nodes(fn: ast.FunctionDef, kind):
+    """Walk ``fn``'s own body, NOT descending into nested function
+    definitions (those are analyzed as their own traced units)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, kind):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+#: constructors whose first (shape) argument must be static ints —
+#: a name appearing there is trace-time static, not a device value
+_SHAPE_TAKERS = {"full", "zeros", "ones", "empty", "arange", "eye",
+                 "reshape", "broadcast_to", "tile", "iota"}
+
+
+def _static_evidence(fn: ast.FunctionDef) -> Set[str]:
+    """Names used where only static Python ints are legal: shape
+    arguments of array constructors, ``range()`` bounds, slice
+    bounds.  A param both cast and used as a shape is static, so
+    ``float(support_cap)`` under trace is fine."""
+    out: Set[str] = set()
+
+    def names_of(node: ast.AST) -> Set[str]:
+        return {n.id for n in ast.walk(node)
+                if isinstance(n, ast.Name)}
+
+    for call in iter_own_nodes(fn, ast.Call):
+        name = _func_name(call) or ""
+        leaf = name.split(".")[-1]
+        if leaf in _SHAPE_TAKERS and call.args:
+            out |= names_of(call.args[0])
+            for kw in call.keywords:
+                if kw.arg == "shape":
+                    out |= names_of(kw.value)
+        elif leaf == "range":
+            for arg in call.args:
+                out |= names_of(arg)
+    for sub in iter_own_nodes(fn, ast.Slice):
+        for part in (sub.lower, sub.upper, sub.step):
+            if part is not None:
+                out |= names_of(part)
+    return out
+
+
+def _device_suspects(fn: ast.FunctionDef,
+                     statics: Set[str]) -> Set[str]:
+    """Parameter names (minus static_argnames) plus locals assigned
+    from a jnp/jax/lax expression."""
+    args = fn.args
+    names = {a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    names -= statics
+    names.discard("self")
+    names.discard("cls")
+    for node in iter_own_nodes(fn, ast.Assign):
+        src = ast.unparse(node.value) if node.value is not None else ""
+        if not any(p in src for p in ("jnp.", "jax.", "lax.")):
+            continue
+        for tgt in node.targets:
+            for leaf in ast.walk(tgt):
+                if isinstance(leaf, ast.Name):
+                    names.add(leaf.id)
+    return names
+
+
+def check(files) -> List[Tuple[str, int, str]]:
+    """``files`` is an iterable of (rel, ast.Module or None) pairs;
+    returns ``[(rel, lineno, message), ...]``."""
+    violations: List[Tuple[str, int, str]] = []
+    for rel, tree in files:
+        if tree is None:
+            continue
+        index = _ModuleIndex(tree)
+        for fn in sorted(index.traced, key=lambda f: f.lineno):
+            statics = index.static_args.get(fn.name, set())
+            suspects = _device_suspects(fn, statics) \
+                - _static_evidence(fn)
+            for call in iter_own_nodes(fn, ast.Call):
+                name = _func_name(call) or ""
+                if name.split(".")[-1] == "device_get":
+                    violations.append((
+                        rel, call.lineno,
+                        f"device_get inside traced `{fn.name}` — "
+                        f"host transfer under trace"))
+                    continue
+                if isinstance(call.func, ast.Attribute) \
+                        and call.func.attr in ("block_until_ready",
+                                               "item"):
+                    violations.append((
+                        rel, call.lineno,
+                        f".{call.func.attr}() inside traced "
+                        f"`{fn.name}` — host sync under trace"))
+                    continue
+                head = name.split(".", 1)[0] if name else ""
+                is_cast = name in _CAST_FUNCS
+                is_asarray = (name in ("np.asarray", "numpy.asarray")
+                              or (head in ("np", "numpy")
+                                  and name.endswith(".asarray")))
+                if not (is_cast or is_asarray) or not call.args:
+                    continue
+                arg = call.args[0]
+                arg_names = {n.id for n in ast.walk(arg)
+                             if isinstance(n, ast.Name)}
+                hit = arg_names & suspects
+                if hit:
+                    violations.append((
+                        rel, call.lineno,
+                        f"{name}() concretizes traced value "
+                        f"{sorted(hit)[0]!r} inside `{fn.name}`"))
+    violations.sort()
+    return violations
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    description = ("no device_get/.item()/float()/np.asarray host "
+                   "syncs reachable from traced code")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        pairs = [(sf.rel, sf.tree) for sf in tree.package_files()]
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, msg)
+                for rel, lineno, msg in check(pairs)]

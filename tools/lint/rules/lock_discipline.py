@@ -1,0 +1,289 @@
+"""Rule ``lock-discipline``: declared guarded state is only touched
+under its lock, and cross-class lock acquisition stays acyclic.
+
+The concurrent classes in this repo (DeviceRunStore, StreamingIngest,
+CompiledLadder, SpanTracer, MetricsRegistry, FlightRecorder,
+SpillJournal) each guard mutable state with one internal lock.  The
+invariant is easy to state and easy to erode: a new method reads
+``self._entries`` without taking ``self._lock`` and works fine until
+the ingest executor races it under load.  Grep can't catch this —
+whether an access is guarded is a *dominance* property of the
+enclosing ``with`` blocks.
+
+This rule is **declaration-driven**: a class opts in by declaring
+
+.. code-block:: python
+
+    class DeviceRunStore:
+        _GUARDED_BY = {"_entries": "_lock", "_spills": "_lock"}
+
+Then every ``self.<attr>`` access (read or write) of a guarded
+attribute must be lexically dominated by ``with self.<lock>:``.
+Exemptions, computed to a fixpoint:
+
+- ``__init__`` (no concurrent access before construction returns);
+- private methods called ONLY from ``__init__``/exempt methods
+  (bootstrap helpers);
+- private methods whose every same-class call site is itself inside a
+  ``with self.<lock>`` region (lock-held-only helpers — the RLock
+  makes re-entry legal, but these helpers rely on the caller's hold).
+
+Second check: the **lock-order graph**.  While holding class A's lock,
+calling a method that acquires class B's lock creates edge A→B; a
+cycle in that graph is a latent deadlock.  Edges are conservative —
+only method names that resolve to exactly ONE other guarded class
+count (ambiguous names like ``clear`` are skipped).
+
+Suppress a deliberate unguarded access (e.g. a lock-free fast path
+reading an immutable-after-init field) with
+``# graftlint: allow(lock-discipline)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (Finding, Rule, ancestors, attach_parents, register)
+
+GUARD_ATTR = "_GUARDED_BY"
+
+
+class _GuardedClass:
+    def __init__(self, rel: str, node: ast.ClassDef,
+                 guards: Dict[str, str]):
+        self.rel = rel
+        self.node = node
+        self.name = node.name
+        self.guards = guards            # attr -> lock attr
+        self.locks = set(guards.values())
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _literal_guards(node: ast.ClassDef) -> Optional[Dict[str, str]]:
+    """The ``_GUARDED_BY`` dict literal on the class body, or None."""
+    for stmt in node.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == GUARD_ATTR
+                   for t in stmt.targets):
+            continue
+        if not isinstance(stmt.value, ast.Dict):
+            return None
+        out: Dict[str, str] = {}
+        for k, v in zip(stmt.value.keys, stmt.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                out[k.value] = v.value
+        return out
+    return None
+
+
+def _with_locks(node: ast.AST) -> Set[str]:
+    """Lock attrs held at ``node``: every ancestor ``with self.<x>:``."""
+    held: Set[str] = set()
+    chain = [node] + list(ancestors(node))
+    for anc in chain:
+        if not isinstance(anc, ast.With):
+            continue
+        for item in anc.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Attribute) \
+                    and isinstance(ctx.value, ast.Name) \
+                    and ctx.value.id == "self":
+                held.add(ctx.attr)
+    return held
+
+
+def _enclosing_method(node: ast.AST,
+                      cls: ast.ClassDef) -> Optional[ast.FunctionDef]:
+    best = None
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            best = anc
+        if anc is cls:
+            return best
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _exempt_methods(gc: _GuardedClass) -> Set[str]:
+    """Methods whose guarded accesses need no lock, to a fixpoint:
+    __init__, helpers reachable only from exempt methods, and private
+    helpers called only while a class lock is already held."""
+    # call sites: method name -> [(caller method, locks held at call)]
+    sites: Dict[str, List[Tuple[str, Set[str]]]] = {}
+    for mname, mnode in gc.methods.items():
+        for call in ast.walk(mnode):
+            if not isinstance(call, ast.Call):
+                continue
+            attr = _self_attr(call.func)
+            if attr in gc.methods:
+                sites.setdefault(attr, []).append(
+                    (mname, _with_locks(call) & gc.locks))
+    exempt = {"__init__"}
+    changed = True
+    while changed:
+        changed = False
+        for mname in gc.methods:
+            if mname in exempt or not mname.startswith("_") \
+                    or mname.startswith("__"):
+                continue
+            calls = sites.get(mname)
+            if not calls:
+                continue  # never called in-class: external entry point
+            if all(caller in exempt or held
+                   for caller, held in calls):
+                exempt.add(mname)
+                changed = True
+    return exempt
+
+
+def _collect(files) -> Tuple[List[_GuardedClass], Dict[str, str]]:
+    """All guarded classes, plus a method-name -> class-name map for
+    names that resolve UNIQUELY across guarded classes."""
+    classes: List[_GuardedClass] = []
+    owner: Dict[str, Optional[str]] = {}
+    for rel, tree in files:
+        if tree is None:
+            continue
+        attach_parents(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            guards = _literal_guards(node)
+            if not guards:
+                continue
+            gc = _GuardedClass(rel, node, guards)
+            classes.append(gc)
+            for mname in gc.methods:
+                owner[mname] = (gc.name if mname not in owner
+                                else None)  # ambiguous -> None
+    unique = {m: c for m, c in owner.items() if c}
+    return classes, unique
+
+
+def _lock_edges(classes: List[_GuardedClass],
+                unique: Dict[str, str]) -> Dict[str, Set[Tuple[str,
+                                                               int, str]]]:
+    """A -> {(B, lineno, rel)}: while holding A's lock, a call resolves
+    to a lock-acquiring method of guarded class B."""
+    acquiring: Dict[Tuple[str, str], bool] = {}
+    by_name = {gc.name: gc for gc in classes}
+    for gc in classes:
+        for mname, mnode in gc.methods.items():
+            acq = any(_with_locks(n) & gc.locks
+                      for n in ast.walk(mnode)
+                      if isinstance(n, ast.With))
+            acquiring[(gc.name, mname)] = acq
+    edges: Dict[str, Set[Tuple[str, int, str]]] = {}
+    for gc in classes:
+        for mnode in gc.methods.values():
+            for call in ast.walk(mnode):
+                if not isinstance(call, ast.Call):
+                    continue
+                if not (_with_locks(call) & gc.locks):
+                    continue
+                func = call.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                # skip self-calls: RLock re-entry, not a cross edge
+                if isinstance(func.value, ast.Name) \
+                        and func.value.id == "self":
+                    continue
+                target_cls = unique.get(func.attr)
+                if not target_cls or target_cls == gc.name:
+                    continue
+                if acquiring.get((target_cls, func.attr)):
+                    edges.setdefault(gc.name, set()).add(
+                        (target_cls, call.lineno, gc.rel))
+    return edges
+
+
+def _find_cycle(edges: Dict[str, Set[Tuple[str, int, str]]]
+                ) -> Optional[List[str]]:
+    graph = {a: {b for b, _, _ in dests} for a, dests in edges.items()}
+    state: Dict[str, int] = {}   # 1 = on stack, 2 = done
+    path: List[str] = []
+
+    def dfs(node: str) -> Optional[List[str]]:
+        state[node] = 1
+        path.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if state.get(nxt) == 1:
+                return path[path.index(nxt):] + [nxt]
+            if state.get(nxt) is None:
+                found = dfs(nxt)
+                if found:
+                    return found
+        path.pop()
+        state[node] = 2
+        return None
+
+    for start in sorted(graph):
+        if state.get(start) is None:
+            found = dfs(start)
+            if found:
+                return found
+    return None
+
+
+def check(files) -> List[Tuple[str, int, str]]:
+    """``files`` is an iterable of (rel, ast.Module or None) pairs;
+    returns ``[(rel, lineno, message), ...]``."""
+    files = list(files)
+    classes, unique = _collect(files)
+    violations: List[Tuple[str, int, str]] = []
+    for gc in classes:
+        exempt = _exempt_methods(gc)
+        for node in ast.walk(gc.node):
+            attr = _self_attr(node)
+            if attr is None or attr not in gc.guards:
+                continue
+            meth = _enclosing_method(node, gc.node)
+            if meth is None or meth.name in exempt:
+                continue
+            lock = gc.guards[attr]
+            if lock in _with_locks(node):
+                continue
+            violations.append((
+                gc.rel, node.lineno,
+                f"{gc.name}.{attr} is _GUARDED_BY {lock!r} but "
+                f"accessed in `{meth.name}` without `with "
+                f"self.{lock}`"))
+    edges = _lock_edges(classes, unique)
+    cycle = _find_cycle(edges)
+    if cycle:
+        rel = classes[0].rel if classes else ""
+        for gc in classes:
+            if gc.name == cycle[0]:
+                rel = gc.rel
+        violations.append((
+            rel, 0,
+            "lock-order cycle (latent deadlock): "
+            + " -> ".join(cycle)))
+    violations.sort()
+    return violations
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    description = ("_GUARDED_BY state is only touched under its lock; "
+                   "cross-class lock order stays acyclic")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        pairs = [(sf.rel, sf.tree) for sf in tree.package_files()]
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, msg)
+                for rel, lineno, msg in check(pairs)]

@@ -1,0 +1,100 @@
+"""Rule ``no-inline-jit``: per-generation code paths must not call
+``jax.jit`` directly.
+
+``pyabc_tpu/autotune/`` is THE compile chokepoint — its ``jit_compile``
+wrapper is how hot-path modules stage programs, so every compiled
+program lives in a bounded ``CompiledLadder``, shows up on the
+``xla_compiles_total`` / ``compile.miss`` telemetry, and is reachable
+by the AOT prewarm.  An inline ``jax.jit`` in a per-generation module
+re-opens the pre-autotune failure mode: an unbounded anonymous program
+cache that recompiles invisibly in steady state.
+
+Scope: the per-generation orchestration surface — ``sampler/``,
+``wire/`` and ``smc.py``.  Cold-path modules (ops/, distance/,
+epsilon/ ...) may still jit at module import or fit time; they are
+outside the scan on purpose.  ``autotune/`` itself is the one place
+allowed to touch ``jax.jit``.
+
+Legacy suppression: ``# jit-ok`` on the line;
+``# graftlint: allow(no-inline-jit)`` also works.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from ..core import Finding, Rule, default_package_root, register
+
+#: per-generation surface to scan (package-root-relative, forward
+#: slashes); everything else is cold path and out of scope
+SCAN_PREFIXES = ("sampler/", "wire/", "autotune/")
+SCAN_FILES = ("smc.py",)
+
+#: the compile chokepoint itself may call jax.jit
+ALLOWLIST_PREFIXES = ("autotune/",)
+
+SUPPRESS = "# jit-ok"
+
+# jax.jit / jax.pjit as a call or decorator; functools-partial'd forms
+# like ``partial(jax.jit, ...)`` match too (they contain the token)
+_INLINE_JIT = re.compile(r"\bjax\.p?jit\b")
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def check(root: str = None) -> list:
+    """Scan the per-generation surface; returns
+    ``[(relpath, lineno, line), ...]`` violations (empty = clean)."""
+    root = _package_root(root)
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if not (rel in SCAN_FILES
+                    or rel.startswith(SCAN_PREFIXES)):
+                continue
+            if rel.startswith(ALLOWLIST_PREFIXES):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if SUPPRESS in line:
+                        continue
+                    code = line.split("#", 1)[0]
+                    if _INLINE_JIT.search(code):
+                        violations.append((rel, lineno, line.rstrip()))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("inline jit: clean (per-generation paths compile via "
+              "pyabc_tpu.autotune)")
+        return 0
+    print("inline jax.jit in per-generation code (stage programs via "
+          "pyabc_tpu.autotune.jit_compile so the ladder/telemetry own "
+          f"them, or justify with '{SUPPRESS}'):")
+    for rel, lineno, line in violations:
+        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
+    return 1
+
+
+@register
+class NoInlineJitRule(Rule):
+    id = "no-inline-jit"
+    description = ("per-generation modules stage programs via "
+                   "autotune.jit_compile, never inline jax.jit")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, line.strip())
+                for rel, lineno, line in check(tree.package_root)]

@@ -1,0 +1,250 @@
+"""Rule ``prng-keys``: no PRNG key is consumed twice, and scan bodies
+don't leak a consumed key back into the carry.
+
+JAX PRNG keys are use-once values: passing the same key to two
+``jax.random.*`` draws produces correlated (often identical) samples,
+which in an ABC-SMC sampler silently collapses the effective particle
+count — the posterior looks fine, the statistics are wrong.  The two
+shapes this rule catches:
+
+- **Double consumption** — a name bound to a key is passed to more
+  than one consuming ``jax.random.*`` call without being rebound in
+  between.  ``split`` COUNTS as a consumption of its argument (so
+  ``sub = split(key)`` followed by ``normal(key)`` flags), and
+  rebinding (``key, sub = jax.random.split(key)``) resets the name.
+  ``fold_in`` does NOT consume — deriving many streams from one base
+  key via distinct fold constants is the idiomatic fan-out (see
+  ``sampler/fused.py``).  Uses in mutually exclusive ``if``/``else``
+  branches don't conflict.
+- **Scan-carry leak** — a ``lax.scan``/``while_loop`` body that
+  consumes a key from its carry and then returns that SAME name in
+  the new carry reuses the key on every iteration.  The fix is always
+  ``key, sub = jax.random.split(key)`` and carrying the fresh half.
+
+Keys are recognized by provenance (assigned from ``PRNGKey``/
+``split``/``fold_in``/``wrap_key_data``), by the carry-unpack of a
+scan body whose element names contain ``key``/``rng``, and by
+parameter names containing ``key``/``rng``.
+
+Suppress a deliberate reuse (e.g. common random numbers across
+configs) with ``# graftlint: allow(prng-keys)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (Finding, Rule, ancestors, attach_parents, dotted_name,
+                    register)
+
+#: jax.random constructors whose RESULT is a key
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+               "clone"}
+
+#: jax.random calls that do NOT consume their key argument
+_NON_CONSUMING = {"fold_in", "key_data", "wrap_key_data", "clone",
+                  "key_impl"}
+
+_SCAN_CALLS = {"lax.scan", "jax.lax.scan",
+               "lax.while_loop", "jax.lax.while_loop"}
+
+
+def _random_fn(call: ast.Call) -> Optional[str]:
+    """'split' for jax.random.split(...) / random.split(...) /
+    jr.split(...); None for non-jax.random calls."""
+    name = dotted_name(call.func)
+    if not name or "." not in name:
+        return None
+    head, _, fn = name.rpartition(".")
+    if head in ("jax.random", "random", "jrandom", "jr") \
+            or head.endswith(".random"):
+        return fn
+    return None
+
+
+def _branch_path(node: ast.AST) -> Tuple[Tuple[int, str], ...]:
+    """(if-node-id, arm) pairs from outermost to ``node`` — two uses
+    conflict only when neither diverges from the other at a shared
+    ``if`` (i.e. one path is a prefix of the other)."""
+    path: List[Tuple[int, str]] = []
+    child = node
+    for anc in ancestors(node):
+        if isinstance(anc, ast.If):
+            arm = "body" if any(child is n or child in ast.walk(n)
+                                for n in anc.body) else "else"
+            path.append((id(anc), arm))
+        child = anc
+    return tuple(reversed(path))
+
+
+def _conflicting(a: Tuple, b: Tuple) -> bool:
+    for (ia, arma), (ib, armb) in zip(a, b):
+        if ia == ib and arma != armb:
+            return False
+    return True
+
+
+def _name_targets(target: ast.AST) -> List[str]:
+    return [n.id for n in ast.walk(target) if isinstance(n, ast.Name)]
+
+
+def _looks_like_key(name: str) -> bool:
+    low = name.lower()
+    return "key" in low or "rng" in low
+
+
+def _scan_body_names(tree: ast.Module) -> Set[str]:
+    """Names of functions passed to lax.scan/while_loop in this
+    module."""
+    out: Set[str] = set()
+    for call in (n for n in ast.walk(tree) if isinstance(n, ast.Call)):
+        if dotted_name(call.func) in _SCAN_CALLS:
+            for arg in call.args[:2]:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+class _FnState:
+    """Per-function linear walk: key vars, per-name consumption count
+    since last rebind, and recorded violations."""
+
+    def __init__(self, rel: str, fn: ast.FunctionDef,
+                 is_scan_body: bool):
+        self.rel = rel
+        self.fn = fn
+        self.is_scan_body = is_scan_body
+        self.keys: Set[str] = set()
+        #: name -> list of (lineno, branch-path) consumptions
+        self.uses: Dict[str, List[Tuple[int, Tuple]]] = {}
+        self.violations: List[Tuple[str, int, str]] = []
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if _looks_like_key(a.arg):
+                self.keys.add(a.arg)
+
+    def rebind(self, names: List[str], value: ast.AST):
+        fn = _random_fn(value) if isinstance(value, ast.Call) else None
+        for name in names:
+            if fn in _KEY_MAKERS or _looks_like_key(name):
+                self.keys.add(name)
+            self.uses.pop(name, None)   # rebinding resets the counter
+
+    def consume(self, name: str, lineno: int, where: ast.AST):
+        if name not in self.keys:
+            return
+        path = _branch_path(where)
+        prior = self.uses.setdefault(name, [])
+        for plineno, ppath in prior:
+            if _conflicting(ppath, path):
+                self.violations.append((
+                    self.rel, lineno,
+                    f"key {name!r} consumed again in `{self.fn.name}` "
+                    f"(first use line {plineno}; split before "
+                    f"reusing)"))
+                break
+        prior.append((lineno, path))
+
+    def returned_carry_names(self, node: ast.Return) -> List[str]:
+        if not self.is_scan_body or node.value is None:
+            return []
+        val = node.value
+        if isinstance(val, ast.Tuple) and val.elts:
+            val = val.elts[0]       # (carry, y): carry is element 0
+        return [n.id for n in ast.walk(val)
+                if isinstance(n, ast.Name)]
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _walk_fn(state: _FnState):
+    """Statement-ordered walk of the function's own body."""
+    def visit(node: ast.AST):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not state.fn:
+            return
+        if isinstance(node, ast.If):
+            # an arm that exits the function cannot conflict with the
+            # code after the ``if`` — roll its consumptions back
+            visit(node.test)
+            snap = {k: list(v) for k, v in state.uses.items()}
+            for stmt in node.body:
+                visit(stmt)
+            if _terminates(node.body):
+                state.uses = snap
+            snap = {k: list(v) for k, v in state.uses.items()}
+            for stmt in node.orelse:
+                visit(stmt)
+            if node.orelse and _terminates(node.orelse):
+                state.uses = snap
+            return
+        if isinstance(node, ast.Assign):
+            visit(node.value)
+            for t in node.targets:
+                state.rebind(_name_targets(t), node.value)
+            return
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            fn = _random_fn(node)
+            if fn is not None and fn not in _NON_CONSUMING:
+                candidates = list(node.args) \
+                    + [kw.value for kw in node.keywords]
+                for arg in candidates:
+                    if isinstance(arg, ast.Name):
+                        state.consume(arg.id, node.lineno, node)
+            return
+        if isinstance(node, ast.Return):
+            for name in state.returned_carry_names(node):
+                if name in state.keys and state.uses.get(name):
+                    state.violations.append((
+                        state.rel, node.lineno,
+                        f"scan body `{state.fn.name}` consumes key "
+                        f"{name!r} but returns it in the carry — the "
+                        f"next iteration reuses it (split and carry "
+                        f"the fresh key)"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in state.fn.body:
+        visit(stmt)
+
+
+def check(files) -> List[Tuple[str, int, str]]:
+    """``files`` is an iterable of (rel, ast.Module or None) pairs;
+    returns ``[(rel, lineno, message), ...]``."""
+    violations: List[Tuple[str, int, str]] = []
+    for rel, tree in files:
+        if tree is None:
+            continue
+        attach_parents(tree)
+        scan_bodies = _scan_body_names(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            state = _FnState(rel, node, node.name in scan_bodies)
+            _walk_fn(state)
+            violations.extend(state.violations)
+    violations.sort()
+    return violations
+
+
+@register
+class PrngKeysRule(Rule):
+    id = "prng-keys"
+    description = ("PRNG keys are consumed once per binding; scan "
+                   "carries never recycle a consumed key")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        pairs = [(sf.rel, sf.tree) for sf in tree.package_files()]
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, msg)
+                for rel, lineno, msg in check(pairs)]

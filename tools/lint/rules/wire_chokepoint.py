@@ -1,0 +1,121 @@
+"""Rule ``wire-chokepoint``: all device->host traffic routes through
+the wire, and every egress label is one the ledger watches.
+
+``pyabc_tpu/sampler/base.py:fetch_to_host`` is THE d2h chokepoint — it
+syncs the producing computation (booking the wait to ``compute_s``),
+times the pure transfer, and charges bytes to the process-global wire
+ledger (``pyabc_tpu/wire/transfer.py``).  A module that calls
+``jax.device_get`` directly moves bytes the ledger never sees, so bench
+rows, heartbeat throughput and the d2h_mb_per_s bandwidth figure all
+silently under-report — exactly the regression class this repo's
+north-star work is about.
+
+Checks over every ``pyabc_tpu/**/*.py`` outside the allowlist
+(``wire/`` and ``sampler/base.py``, the chokepoint itself):
+
+- no ``device_get`` occurrence (call or attribute);
+- no ``np.asarray(...)`` whose argument text smells like a device
+  array (heuristic: names/attributes ending in ``_dev`` or prefixed
+  ``dev_``, or ``.addressable_shards`` access).
+
+A second, package-wide check (allowlist included — the wire itself
+must label its own traffic correctly): every literal
+``egress("<label>")`` attribution must use a label from the ledger's
+``EGRESS_SUBSYSTEMS``.
+
+Legacy suppression: ``# wire-ok`` on the line (kept for byte-compatible
+verdicts with the predecessor ``tools/check_wire_chokepoint.py``);
+``# graftlint: allow(wire-chokepoint)`` also works.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from ..core import Finding, Rule, default_package_root, register
+
+#: paths (relative to the package root, forward slashes) exempt from the
+#: scan: the wire itself and the chokepoint module
+ALLOWLIST_PREFIXES = ("wire/",)
+ALLOWLIST_FILES = ("sampler/base.py",)
+
+SUPPRESS = "# wire-ok"
+
+_DEVICE_GET = re.compile(r"\bdevice_get\b")
+# np.asarray(<something device-smelling>): conservative textual heuristic
+_ASARRAY_DEVICE = re.compile(
+    r"np\.asarray\(\s*(?:\w+_dev\b|dev_\w+|\w+(?:\.\w+)*"
+    r"\.addressable_shards)")
+
+#: must mirror pyabc_tpu/wire/transfer.py:EGRESS_SUBSYSTEMS — kept as a
+#: literal so the lint runs without importing (and thus initializing)
+#: jax; drift is caught by the wrapper test comparing the two tuples
+EGRESS_SUBSYSTEMS = ("population", "history", "checkpoint", "summary",
+                     "control", "other")
+# literal-label egress attribution: egress("...") / egress('...')
+_EGRESS_CALL = re.compile(r"\begress\(\s*([\"'])([^\"']*)\1")
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def check(root: str = None) -> list:
+    """Scan the package tree; returns ``[(relpath, lineno, line), ...]``
+    violations (empty = clean)."""
+    root = _package_root(root)
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            allowlisted = (rel in ALLOWLIST_FILES
+                           or rel.startswith(ALLOWLIST_PREFIXES))
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if SUPPRESS in line:
+                        continue
+                    code = line.split("#", 1)[0]
+                    # label lint runs EVERYWHERE (wire/ included)
+                    m = _EGRESS_CALL.search(code)
+                    if m and m.group(2) not in EGRESS_SUBSYSTEMS:
+                        violations.append((rel, lineno, line.rstrip()))
+                        continue
+                    if allowlisted:
+                        continue
+                    if _DEVICE_GET.search(code) \
+                            or _ASARRAY_DEVICE.search(code):
+                        violations.append((rel, lineno, line.rstrip()))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("wire chokepoint: clean "
+              "(all d2h routes through fetch_to_host)")
+        return 0
+    print("wire chokepoint violations (route d2h through "
+          "pyabc_tpu.sampler.base.fetch_to_host, or justify with "
+          f"'{SUPPRESS}'):")
+    for rel, lineno, line in violations:
+        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
+    return 1
+
+
+@register
+class WireChokepointRule(Rule):
+    id = "wire-chokepoint"
+    description = ("every d2h transfer routes through fetch_to_host "
+                   "and every egress label is ledger-known")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, line.strip())
+                for rel, lineno, line in check(tree.package_root)]
