@@ -42,6 +42,11 @@ is one-off).
   cancels), plus the engine probe's recorded decision
   (``fused_northstar_engine_decision``) — the ISSUE-5 headline claim,
   on the compact line so the driver tail captures it
+- ``onedispatch_pop1e6_*`` — the whole-run one-dispatch row (run_mode=
+  "onedispatch"): after the sequential gen 0, the rest of the run is a
+  SINGLE device program with the stop chain evaluated on device;
+  ``dispatches_per_run`` must read 1 and
+  ``control_roundtrip_s_per_gen`` prices the residual control plane
 - ``posterior_gate_*``     — the repeatable 1e6 adaptive posterior-
   exactness gate (tools/verify_northstar_posterior.py): perf work
   cannot silently trade statistical bias
@@ -410,6 +415,68 @@ def bench_fused_northstar():
     }
 
 
+ONEDISPATCH_GENS = 8
+
+
+def bench_onedispatch():
+    """One-dispatch whole-run row at the north star (pop 1e6): gen 0
+    runs sequentially to seed the device carry, then EVERY remaining
+    generation executes inside a single device program whose stop
+    chain (eps floor / max generations / acceptance rate / budget)
+    is evaluated on device between fused blocks
+    (sampler/fused.py ``build_onedispatch_run``).
+
+    Acceptance artifacts: ``onedispatch_pop1e6_dispatches_per_run``
+    must be 1 (the whole post-calibration run is one XLA dispatch) and
+    ``onedispatch_pop1e6_control_roundtrip_s_per_gen`` prices what is
+    left of the host control plane — a single O(scalar) control-packet
+    fetch amortized over the generations it replaced."""
+    import pyabc_tpu as pt
+    from pyabc_tpu.autotune import compile_counters, compile_delta
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=NORTHSTAR_POP,
+        eps=pt.ConstantEpsilon(0.2),
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                     max_rounds_per_call=16),
+        stores_sum_stats=False,
+        fuse_generations=4,
+        run_mode="onedispatch",
+        seed=0)
+    abc.new("sqlite://", observed)
+    eg0 = _egress_mb()
+    cc0 = compile_counters()
+    t0 = time.perf_counter()
+    abc.run(max_nr_populations=1 + ONEDISPATCH_GENS)
+    wall = time.perf_counter() - t0
+    cc = compile_delta(cc0)
+    eg = {k: v - eg0.get(k, 0.0) for k, v in _egress_mb().items()}
+    od_ts = sorted(r["gen"] for r in abc.timeline.to_rows()
+                   if r.get("path") == "onedispatch")
+    # steady-state s/gen: the one dispatch smears its wall clock evenly
+    # over the generations it wrote, so back the one-off compile bill
+    # out of the whole-run wall instead of picking a "steady" suffix
+    gens = len(od_ts)
+    od_spg = (max(wall - cc["compile_s"], 0.0) / gens if gens else None)
+    return {
+        "onedispatch_pop1e6_dispatches_per_run": abc.run_dispatches,
+        "onedispatch_pop1e6_control_roundtrip_s_per_gen": (
+            round(abc.control_roundtrip_s / gens, 4) if gens else None),
+        "onedispatch_pop1e6_s_per_gen": (None if od_spg is None
+                                         else round(od_spg, 2)),
+        "onedispatch_pop1e6_generations": gens,
+        "onedispatch_pop1e6_stop_reason":
+            abc.timeline.summary().get("stop_reason"),
+        "onedispatch_pop1e6_compile_s": round(cc["compile_s"], 2),
+        **{f"onedispatch_pop1e6_egress_{k}_mb": round(v, 3)
+           for k, v in eg.items() if k in ("population", "history",
+                                           "summary", "control")},
+    }
+
+
 def bench_kde_1e6():
     """Standalone 1e6-query × 1e6-support streamed weighted-KDE log-pdf
     (the SURVEY.md §7 '1e6 × 1e6 KDE' hard part)."""
@@ -480,9 +547,9 @@ def _bench_problem(make_problem, pop, prefix):
             **{f"{prefix}_{k}": v for k, v in transfer.items()}}
 
 
-SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "posterior_gate",
-               "lotka_volterra", "sir", "petab_ode", "sharded_mesh1",
-               "ab_vec_sharded", "sharded_cpu8")
+SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
+               "posterior_gate", "lotka_volterra", "sir", "petab_ode",
+               "sharded_mesh1", "ab_vec_sharded", "sharded_cpu8")
 
 
 def bench_ab_vec_vs_sharded():
@@ -584,6 +651,8 @@ def _run_sub(name: str) -> dict:
         return bench_northstar()
     if name == "fused_northstar":
         return bench_fused_northstar()
+    if name == "onedispatch":
+        return bench_onedispatch()
     if name == "posterior_gate":
         # the 1e6 adaptive posterior-exactness gate (BASELINE.md
         # "Correctness at scale", now repeatable): perf work cannot
@@ -698,9 +767,9 @@ def main():
     compact = {k: v for k, v in sorted(extra.items())
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
-                                "posterior_gate_", "telemetry_",
-                                "resilience_", "checkpoint_", "store_",
-                                "lint_"))
+                                "onedispatch_", "posterior_gate_",
+                                "telemetry_", "resilience_",
+                                "checkpoint_", "store_", "lint_"))
                and not isinstance(v, (list, dict))}
     print(json.dumps({**header, "extra": compact}))
 

@@ -28,6 +28,16 @@ class Epsilon:
     #: compiled K-generation block would freeze its adaptation.
     device_schedule_ok = False
 
+    #: one-dispatch capability flag: True when the schedule's STOP
+    #: comparison (``eps_t <= minimum_epsilon``, or temperature == 1)
+    #: is exact when evaluated on device in f32 between generations —
+    #: ``ABCSMC._onedispatch_eligible`` consults it on top of
+    #: ``device_schedule_ok`` before routing a run through the
+    #: device-side-stopping while_loop (sampler/fused.py).  Default
+    #: False: a schedule whose threshold semantics live on the host
+    #: could stop a device-driven run a generation late.
+    device_stop_ok = False
+
     def initialize(self, t: int,
                    get_weighted_distances: Optional[Callable] = None,
                    get_all_records: Optional[Callable] = None,

@@ -15,6 +15,8 @@ class ConstantEpsilon(Epsilon):
 
     #: a constant trivially advances inside a fused block
     device_schedule_ok = True
+    #: ... and its stop comparison is a pure f32 compare on device
+    device_stop_ok = True
 
     def __init__(self, constant_epsilon_value: float):
         self.constant_epsilon_value = float(constant_epsilon_value)
@@ -52,6 +54,9 @@ class QuantileEpsilon(Epsilon):
     #: scan's in-generation epsilon (sampler/fused.py
     #: ``_weighted_quantile_device``); MedianEpsilon inherits
     device_schedule_ok = True
+    #: the in-scan quantile IS the schedule value, so comparing it
+    #: against minimum_epsilon on device is exact; MedianEpsilon inherits
+    device_stop_ok = True
 
     def __init__(self, initial_epsilon: str = "from_sample",
                  alpha: float = 0.5, quantile_multiplier: float = 1.0,

@@ -181,6 +181,13 @@ class Temperature(TemperatureBase):
         # solve itself can
         return self.device_solve_ok
 
+    @property
+    def device_stop_ok(self) -> bool:
+        # the stop test (temperature == 1) reads the in-scan solve's
+        # own output, so device-side stopping is exact whenever the
+        # solve runs on device
+        return self.device_solve_ok
+
     def get_config(self):
         return {"name": type(self).__name__,
                 "schemes": [type(s).__name__ for s in self.schemes]}

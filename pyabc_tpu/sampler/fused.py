@@ -65,6 +65,27 @@ from jax import lax
 Array = jnp.ndarray
 
 
+# ---------------------------------------------------------------------------
+# Device stop codes (one-dispatch driver)
+# ---------------------------------------------------------------------------
+# The one-dispatch while-loop latches WHY the run stopped as a small enum
+# in its control carry; ``smc.STOP_REASONS`` decodes each code to the
+# exact sequential-loop stop string, so the host learns the reason from
+# the final carry without per-block harvests.  Codes are priority-ordered
+# the same way the host loop checks them: threshold stops first, then
+# single-model, acceptance collapse, budget.  ``STOP_UNDERSHOOT`` is not
+# a run stop — it marks a generation that exhausted its round cap short
+# of ``n_target``, which the host resolves by falling back to the
+# sequential path (the fused harvest loop's undershoot semantics).
+STOP_NONE = 0
+STOP_EPS = 1
+STOP_TEMPERATURE = 2
+STOP_SINGLE_MODEL = 3
+STOP_ACC_RATE = 4
+STOP_BUDGET = 5
+STOP_UNDERSHOOT = 6
+
+
 #: device pdf-grid size for 1-D supports at scale (vs the host fit's
 #: adaptive pow2 grid with an 8192 floor): 2^14 cells over the support
 #: range gives ~100+ cells per bandwidth at any annealing stage (range
@@ -216,7 +237,7 @@ def _weighted_quantile_device(x, w, valid, alpha):
     return jnp.interp(alpha, cum - 0.5 * w_s, pts)
 
 
-def build_fused_generations(
+def _build_one_gen(
         kernel,
         bandwidth_selectors: Sequence[Callable],
         scalings: Sequence[float],
@@ -224,7 +245,6 @@ def build_fused_generations(
         n_target: int,
         B: int,
         max_rounds: int,
-        K: int,
         d: int,
         s: int,
         eps_mode: str,            # "constant" | "quantile" | "temperature"
@@ -240,47 +260,19 @@ def build_fused_generations(
         adaptive_cfg: Optional[dict] = None,
         stoch_cfg: Optional[dict] = None,
         summary_lanes: bool = False):
-    """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
-    for K generations.  ``carry`` = the previous generation's accepted
-    population on device: dict(m[i32 n], theta[f32 n,d], log_weight
-    [f32 n], distance[f32 n], stats[f32 n,s], count[i32], eps[f32],
-    rate[f32], safety[f32]); an adaptive distance adds ``dist_w``
-    [f32 s] (the RAW inverse-scale weights, pre fixed-factor), the
-    stochastic triple adds the candidate record ring ``rec_m``/
-    ``rec_theta``/``rec_dist``/``rec_loggen`` (R rows) feeding the
-    in-scan temperature solve.  The ``stats`` lane is write-only inside
-    the scan (the input seed may be zeros); it exits as the last
-    generation's accepted stats so a block-boundary
-    ``_prepare_next_iteration`` can re-evaluate distances ON device.
+    """Shared per-generation body behind :func:`build_fused_generations`
+    (which scans it K times) and :func:`build_onedispatch_run` (which
+    wraps those scans in a device-side stopping ``while_loop``).
 
-    ``rate``/``safety`` are the in-scan autotuner state: an EWMA
-    acceptance-rate estimate (gain ``autotune.tuner.EWMA_ALPHA``) and an
-    undershoot-escalated safety margin that together size each
-    generation's rejection-round cap — ``max_rounds`` stays the static
-    ceiling, so adaptation only ever SHRINKS work.
-
-    ``wires`` stacks K narrow-wire generation payloads (leading axis K):
-    the same f16/per-column-scale/bit-packed format as
-    ``device_loop.finalize`` plus per-generation ``eps``/``count``/
-    ``rounds`` scalars.  ``device_loop.slice_block_wire`` takes one
-    generation's slice for the streamed per-generation fetch.
-
-    ``raw_round(key, params) -> RoundResult`` is the SAMPLER's round
-    builder for the kernel's deferred generation round at batch ``B``
-    (``sampler._raw_round(kernel.generation_round, B,
-    with_proposal=False)``): for a ``ShardedSampler`` that is the
-    shard_mapped round, so the whole fused scan SPMDs over the mesh
-    exactly like the per-generation loop.
-
-    ``eps_mode == "temperature"`` requires ``stoch_cfg`` (keys
-    ``pdf_norm`` — the kernel-derived log normalization constant,
-    ``target_rate``, ``lin_scale``, ``record_rows``); ``adaptive_cfg``
-    (keys ``scale_fn``, ``distance_fn``, ``obs_flat``,
-    ``max_weight_ratio``, ``normalize_weights``, ``factors``) switches
-    on the in-scan distance refit.  When ``stoch_cfg`` is set the
-    returned ``fused`` takes a third argument ``final_mask`` [K bool]:
-    True pins that generation's temperature to 1
-    (``Temperature._update``'s final-generation rule).
+    Returns ``one_gen(carry, gen_key, final_flag=None, live=None) ->
+    (new_carry, wire)``.  ``final_flag`` (stochastic triple only) pins
+    the temperature to 1.  ``live=None`` adds NO ops to the trace — the
+    fused path's program is unchanged; when the one-dispatch driver
+    passes a traced ``live`` bool, a False value zeroes the generation's
+    rejection-round cap so it runs zero rounds and deposits nothing:
+    post-stop iterations become true no-ops whose outputs the caller
+    discards with a select, keeping live generations bit-identical to
+    the fused path's.
     """
     from ..autotune.tuner import EWMA_ALPHA
     from ..wire.store import summary_wire_lanes as _summary_wire_lanes
@@ -313,11 +305,7 @@ def build_fused_generations(
     rounds_hi = float(max_rounds)
     rounds_lo = min(2.0, rounds_hi)
 
-    def one_generation(carry, xs):
-        if stoch:
-            gen_key, final_flag = xs["key"], xs["final"]
-        else:
-            gen_key = xs
+    def one_gen(carry, gen_key, final_flag=None, live=None):
         m0, theta0, lw0, dist0, count0, eps0 = (
             carry["m"], carry["theta"], carry["log_weight"],
             carry["distance"], carry["count"], carry["eps"])
@@ -414,6 +402,11 @@ def build_fused_generations(
         pred = jnp.maximum(rate0, 1e-6) * jnp.float32(rate_pred_factor)
         need = jnp.ceil(jnp.float32(n_target) / (pred * B) * safety0) + 1.0
         dyn_rounds = jnp.clip(need, rounds_lo, rounds_hi).astype(jnp.int32)
+        if live is not None:
+            # one-dispatch masking: a dead generation runs ZERO rounds,
+            # so its buffers stay zeroed (count 0, rounds 0) and every
+            # carry lane it emits is discarded by the driver's select
+            dyn_rounds = jnp.where(live, dyn_rounds, jnp.int32(0))
 
         # rejection rounds with scatter compaction (device_loop protocol)
         bufs = {
@@ -568,6 +561,89 @@ def build_fused_generations(
                 m1, theta1, dist1, lw1, valid1, M))
         return new_carry, wire
 
+    return one_gen
+
+
+def build_fused_generations(
+        kernel,
+        bandwidth_selectors: Sequence[Callable],
+        scalings: Sequence[float],
+        dims: Sequence[int],
+        n_target: int,
+        B: int,
+        max_rounds: int,
+        K: int,
+        d: int,
+        s: int,
+        eps_mode: str,            # "constant" | "quantile" | "temperature"
+        eps_alpha: float,
+        eps_multiplier: float,
+        eps_weighted: bool,
+        distance_params,
+        wire_stats: bool,
+        wire_m_bits: bool,
+        raw_round: Callable,
+        support_cap: Optional[int] = None,
+        rate_pred_factor: float = 1.0,
+        adaptive_cfg: Optional[dict] = None,
+        stoch_cfg: Optional[dict] = None,
+        summary_lanes: bool = False):
+    """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
+    for K generations.  ``carry`` = the previous generation's accepted
+    population on device: dict(m[i32 n], theta[f32 n,d], log_weight
+    [f32 n], distance[f32 n], stats[f32 n,s], count[i32], eps[f32],
+    rate[f32], safety[f32]); an adaptive distance adds ``dist_w``
+    [f32 s] (the RAW inverse-scale weights, pre fixed-factor), the
+    stochastic triple adds the candidate record ring ``rec_m``/
+    ``rec_theta``/``rec_dist``/``rec_loggen`` (R rows) feeding the
+    in-scan temperature solve.  The ``stats`` lane is write-only inside
+    the scan (the input seed may be zeros); it exits as the last
+    generation's accepted stats so a block-boundary
+    ``_prepare_next_iteration`` can re-evaluate distances ON device.
+
+    ``rate``/``safety`` are the in-scan autotuner state: an EWMA
+    acceptance-rate estimate (gain ``autotune.tuner.EWMA_ALPHA``) and an
+    undershoot-escalated safety margin that together size each
+    generation's rejection-round cap — ``max_rounds`` stays the static
+    ceiling, so adaptation only ever SHRINKS work.
+
+    ``wires`` stacks K narrow-wire generation payloads (leading axis K):
+    the same f16/per-column-scale/bit-packed format as
+    ``device_loop.finalize`` plus per-generation ``eps``/``count``/
+    ``rounds`` scalars.  ``device_loop.slice_block_wire`` takes one
+    generation's slice for the streamed per-generation fetch.
+
+    ``raw_round(key, params) -> RoundResult`` is the SAMPLER's round
+    builder for the kernel's deferred generation round at batch ``B``
+    (``sampler._raw_round(kernel.generation_round, B,
+    with_proposal=False)``): for a ``ShardedSampler`` that is the
+    shard_mapped round, so the whole fused scan SPMDs over the mesh
+    exactly like the per-generation loop.
+
+    ``eps_mode == "temperature"`` requires ``stoch_cfg`` (keys
+    ``pdf_norm`` — the kernel-derived log normalization constant,
+    ``target_rate``, ``lin_scale``, ``record_rows``); ``adaptive_cfg``
+    (keys ``scale_fn``, ``distance_fn``, ``obs_flat``,
+    ``max_weight_ratio``, ``normalize_weights``, ``factors``) switches
+    on the in-scan distance refit.  When ``stoch_cfg`` is set the
+    returned ``fused`` takes a third argument ``final_mask`` [K bool]:
+    True pins that generation's temperature to 1
+    (``Temperature._update``'s final-generation rule).
+    """
+    one_gen = _build_one_gen(
+        kernel, bandwidth_selectors, scalings, dims, n_target, B,
+        max_rounds, d, s, eps_mode, eps_alpha, eps_multiplier,
+        eps_weighted, distance_params, wire_stats, wire_m_bits,
+        raw_round, support_cap=support_cap,
+        rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
+        stoch_cfg=stoch_cfg, summary_lanes=summary_lanes)
+    stoch = stoch_cfg is not None
+
+    def one_generation(carry, xs):
+        if stoch:
+            return one_gen(carry, xs["key"], final_flag=xs["final"])
+        return one_gen(carry, xs)
+
     def fused(carry, key, final_mask=None):
         keys = jax.random.split(key, K)
         if stoch:
@@ -577,3 +653,193 @@ def build_fused_generations(
         return lax.scan(one_generation, carry, xs)
 
     return fused
+
+def build_onedispatch_run(
+        kernel,
+        bandwidth_selectors: Sequence[Callable],
+        scalings: Sequence[float],
+        dims: Sequence[int],
+        n_target: int,
+        B: int,
+        max_rounds: int,
+        K: int,
+        d: int,
+        s: int,
+        eps_mode: str,            # "constant" | "quantile" | "temperature"
+        eps_alpha: float,
+        eps_multiplier: float,
+        eps_weighted: bool,
+        distance_params,
+        wire_stats: bool,
+        wire_m_bits: bool,
+        raw_round: Callable,
+        max_T: int,
+        single_model_stop: bool,
+        support_cap: Optional[int] = None,
+        rate_pred_factor: float = 1.0,
+        adaptive_cfg: Optional[dict] = None,
+        stoch_cfg: Optional[dict] = None,
+        summary_lanes: bool = False):
+    """Whole-run driver with DEVICE-side stopping: a ``lax.while_loop``
+    over K-generation ``lax.scan`` blocks of the same per-generation
+    body as :func:`build_fused_generations`, whose predicate evaluates
+    the full stop chain on device.  The host issues ONE dispatch and
+    learns why/when the run stopped from the final control carry.
+
+    Returns ``onedispatch(carry, key, ctl) -> (carry, ctl_out, wires)``:
+
+    - ``carry`` — the same population carry as the fused path;
+    - ``key`` — the orchestrator's UN-split PRNG key.  Each while
+      iteration replays the host block protocol exactly (one
+      ``jax.random.split`` into (new_key, sub), then ``split(sub, K)``
+      for the block's generation keys), so generations are
+      bit-identical to the host-driven fused blocks;
+    - ``ctl`` — traced stop thresholds, shape-only for the compile
+      cache: ``min_eps`` [f32], ``min_rate`` [f32], ``budget_rounds``
+      [i32] (ceil((max_total − sims_so_far)/B); i32 max when
+      unbounded), ``t_limit`` [i32] (generations this dispatch may
+      write, ≤ ``max_T``), ``final_rel`` [i32] (relative index of the
+      run's final generation for the temperature pin; i32 max when
+      unbounded);
+    - ``ctl_out`` — ``key`` (the advanced host key), ``t`` (generations
+      written), ``stop`` (STOP_* code), ``stop_t`` (relative index of
+      the generation that triggered it, −1 if none), ``stop_count``
+      (its accepted count — the undershoot log's numerator),
+      ``rounds`` (total rejection rounds: sims = rounds × B);
+    - ``wires`` — ``[max_T]``-slot narrow-wire buffers (slot t = the
+      t-th written generation; slots ≥ ``t`` keep their zero
+      initialization) plus a ``live`` [i32] lane the streamed drain
+      loop uses as its stop sentinel.
+
+    ``max_T`` and ``single_model_stop`` are static (program shape);
+    everything in ``ctl`` is traced, so one compiled program serves
+    every run at the same (rung, max_T).
+    """
+    one_gen = _build_one_gen(
+        kernel, bandwidth_selectors, scalings, dims, n_target, B,
+        max_rounds, d, s, eps_mode, eps_alpha, eps_multiplier,
+        eps_weighted, distance_params, wire_stats, wire_m_bits,
+        raw_round, support_cap=support_cap,
+        rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
+        stoch_cfg=stoch_cfg, summary_lanes=summary_lanes)
+    M = kernel.M
+    stoch = stoch_cfg is not None
+    temperature = eps_mode == "temperature"
+    if max_T < 1:
+        raise ValueError("max_T must be >= 1")
+
+    def onedispatch(carry, key, ctl):
+        min_eps = jnp.asarray(ctl["min_eps"], jnp.float32)
+        min_rate = jnp.asarray(ctl["min_rate"], jnp.float32)
+        budget_rounds = jnp.asarray(ctl["budget_rounds"], jnp.int32)
+        t_limit = jnp.asarray(ctl["t_limit"], jnp.int32)
+        final_rel = jnp.asarray(ctl["final_rel"], jnp.int32)
+
+        def _wire_of(c, k):
+            ff = jnp.bool_(False) if stoch else None
+            return one_gen(c, k, final_flag=ff, live=jnp.bool_(True))[1]
+
+        wire_aval = jax.eval_shape(_wire_of, carry,
+                                   jax.eval_shape(lambda x: x, key))
+        bufs0 = {k: jnp.zeros((max_T,) + tuple(a.shape), a.dtype)
+                 for k, a in wire_aval.items()}
+        bufs0["live"] = jnp.zeros((max_T,), jnp.int32)
+
+        def gen_step(st, gen_key):
+            pop, t, stop, stop_t, stop_count, rounds_tot, bufs = st
+            live0 = (stop == STOP_NONE) & (t < t_limit)
+            final_flag = (t >= final_rel) if stoch else None
+            new_pop, wire = one_gen(pop, gen_key, final_flag=final_flag,
+                                    live=live0)
+            count1 = wire["count"]
+            rounds1 = wire["rounds"]
+            eps_t = wire["eps"]
+            written = live0 & (count1 >= n_target)
+            undershoot = live0 & (count1 < n_target)
+            pop1 = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(written, a, b), new_pop, pop)
+            rounds_tot1 = rounds_tot + jnp.where(live0, rounds1, 0)
+
+            # stop chain, in the sequential loop's priority order:
+            # threshold stop first, then single-model, acceptance
+            # collapse, simulation budget (smc.py stop block)
+            if temperature:
+                thresh = eps_t <= jnp.float32(1.0)
+                thresh_code = STOP_TEMPERATURE
+            else:
+                thresh = eps_t <= min_eps
+                thresh_code = STOP_EPS
+            if single_model_stop:
+                # weight-based aliveness, the device analog of
+                # Population.nr_of_models_alive (normalized per-model
+                # weight sums, count of strictly positive entries)
+                lw = new_pop["log_weight"]
+                m_col = new_pop["m"]
+                nv = jnp.arange(lw.shape[0]) < count1
+                lw_m = jnp.max(jnp.where(nv & jnp.isfinite(lw), lw,
+                                         -jnp.inf))
+                wv = jnp.where(nv, jnp.exp(lw - lw_m), 0.0)
+                wv = wv / jnp.maximum(jnp.sum(wv), 1e-38)
+                oh = (m_col[:, None] == jnp.arange(M)[None, :])
+                pm = jnp.sum(jnp.where(oh, wv[:, None], 0.0), axis=0)
+                single = jnp.sum((pm > 0).astype(jnp.int32)) <= 1
+            else:
+                single = jnp.bool_(False)
+            acc_rate = (count1.astype(jnp.float32)
+                        / jnp.maximum(rounds1 * B, 1).astype(jnp.float32))
+            code = jnp.where(
+                thresh, thresh_code,
+                jnp.where(single, STOP_SINGLE_MODEL,
+                          jnp.where(acc_rate < min_rate, STOP_ACC_RATE,
+                                    jnp.where(rounds_tot1 >= budget_rounds,
+                                              STOP_BUDGET, STOP_NONE))))
+            code = jnp.where(written, code, STOP_NONE)
+            new_code = jnp.where(
+                stop != STOP_NONE, stop,
+                jnp.where(undershoot, STOP_UNDERSHOOT, code))
+            hit_now = (stop == STOP_NONE) & (new_code != STOP_NONE)
+            stop_t1 = jnp.where(hit_now, t, stop_t)
+            stop_count1 = jnp.where(hit_now, count1, stop_count)
+
+            # deposit into slot t; dead/undershot generations scatter
+            # out of bounds and are dropped, leaving live == 0 — the
+            # drain loop's stop sentinel
+            idx = jnp.where(written, t, jnp.int32(max_T))
+            bufs1 = {k: bufs[k].at[idx].set(wire[k], mode="drop")
+                     for k in wire}
+            bufs1["live"] = bufs["live"].at[idx].set(1, mode="drop")
+            t1 = t + written.astype(jnp.int32)
+            return (pop1, t1, new_code, stop_t1, stop_count1,
+                    rounds_tot1, bufs1), None
+
+        def w_cond(st):
+            _, key_w, t, stop = st[0], st[1], st[2], st[3]
+            del key_w
+            return (stop == STOP_NONE) & (t < t_limit)
+
+        def w_body(st):
+            pop, key_w, t, stop, stop_t, stop_count, rounds_tot, bufs = st
+            # host block protocol replayed on device: one split per
+            # K-generation block (row 0 -> advanced key, row 1 -> block
+            # subkey), then K generation keys from the subkey — the
+            # same key stream ABCSMC._split feeds the fused dispatches
+            key_arr = jax.random.split(key_w)
+            gen_keys = jax.random.split(key_arr[1], K)
+            (pop1, t1, stop1, stop_t1, stop_count1, rt1, bufs1), _ = \
+                lax.scan(gen_step,
+                         (pop, t, stop, stop_t, stop_count, rounds_tot,
+                          bufs),
+                         gen_keys)
+            return (pop1, key_arr[0], t1, stop1, stop_t1, stop_count1,
+                    rt1, bufs1)
+
+        init = (carry, key, jnp.int32(0), jnp.int32(STOP_NONE),
+                jnp.int32(-1), jnp.int32(0), jnp.int32(0), bufs0)
+        (pop_f, key_f, t_f, stop_f, stop_t_f, stop_count_f, rounds_f,
+         bufs_f) = lax.while_loop(w_cond, w_body, init)
+        ctl_out = {"key": key_f, "t": t_f, "stop": stop_f,
+                   "stop_t": stop_t_f, "stop_count": stop_count_f,
+                   "rounds": rounds_f}
+        return pop_f, ctl_out, bufs_f
+
+    return onedispatch

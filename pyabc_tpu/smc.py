@@ -38,6 +38,7 @@ from .resilience import faults as _faults
 from .resilience import retry as _retry
 from .populationstrategy import ConstantPopulationSize, PopulationStrategy
 from .random_variables import Distribution, ModelPerturbationKernel
+from .sampler import fused as _fused
 from .sampler.base import Sample, Sampler
 from .sampler.rounds import RoundKernel
 from .storage.history import PRE_TIME, History
@@ -50,6 +51,20 @@ from .weighted_statistics import effective_sample_size
 from .wire import store as _wire_store
 
 logger = logging.getLogger("ABC")
+
+#: device stop-code -> the EXACT stop strings of the sequential loop
+#: (reference smc.py:772-800).  Every engine — sequential, fused,
+#: pipelined, one-dispatch — decodes through this one table so the
+#: wording can never drift between paths (tests/test_stop_sampling.py
+#: asserts parity); the codes are minted next to the device stop chain
+#: in sampler/fused.py.
+STOP_REASONS = {
+    _fused.STOP_EPS: "Stopping: minimum epsilon reached",
+    _fused.STOP_TEMPERATURE: "Stopping: temperature reached 1",
+    _fused.STOP_SINGLE_MODEL: "Stopping: single model alive",
+    _fused.STOP_ACC_RATE: "Stopping: acceptance rate too low",
+    _fused.STOP_BUDGET: "Stopping: simulation budget exhausted",
+}
 
 
 def _default_sampler() -> Sampler:
@@ -117,6 +132,7 @@ class ABCSMC:
                  compile_cache: Optional[str] = None,
                  checkpoint_every_rounds: Optional[int] = None,
                  history_mode: Optional[str] = None,
+                 run_mode: Optional[str] = None,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -211,6 +227,37 @@ class ABCSMC:
         self.history_mode = history_mode
         #: the bound run's DeviceRunStore (lazy mode; built in _bind())
         self._store: Optional[_wire_store.DeviceRunStore] = None
+        if run_mode is None:
+            run_mode = os.environ.get("PYABC_TPU_RUN_MODE", "auto")
+        if run_mode not in ("auto", "classic", "onedispatch"):
+            raise ValueError(
+                "run_mode must be 'auto', 'classic' or 'onedispatch' "
+                f"(got {run_mode!r})")
+        #: control-plane discipline: "onedispatch" wraps the fused scan
+        #: in a device-side ``lax.while_loop`` that evaluates the FULL
+        #: stop chain on device (sampler/fused.py:build_onedispatch_run)
+        #: so a whole run costs one dispatch plus streamed egress;
+        #: "classic" keeps the per-block host stop re-check; "auto"
+        #: currently behaves as classic (the device-stop program is
+        #: opt-in while it hardens).  None defers to $PYABC_TPU_RUN_MODE.
+        self.run_mode = run_mode
+        #: program-shape knob for the one-dispatch run: the device
+        #: while-loop writes into egress buffers sized for at most this
+        #: many generations per dispatch (the CompiledLadder keys whole-
+        #: run programs by (rung, max_T)); a run needing more simply
+        #: issues another dispatch from the carried frontier.  Defers to
+        #: $PYABC_TPU_ONEDISPATCH_MAX_T (default 32).
+        self.onedispatch_max_t = max(1, int(os.environ.get(
+            "PYABC_TPU_ONEDISPATCH_MAX_T", "32")))
+        #: dispatches issued by the current run() — the one-dispatch
+        #: acceptance row asserts this stays 1 for a whole device-side-
+        #: stopped run
+        self.run_dispatches = 0
+        #: cumulative host wall spent fetching the O(bytes) control
+        #: packet (stop code / stop generation / round totals) after
+        #: each one-dispatch drain — the per-generation control
+        #: round-trip the bench row watches
+        self.control_roundtrip_s = 0.0
         self.key = jax.random.PRNGKey(seed)
         #: per-generation wall-clock seconds, keyed by t — measured
         #: append-to-append like the DB-timestamp diffs, but available
@@ -251,6 +298,10 @@ class ABCSMC:
         #: dispatch permanently drops this instance to the simpler path
         self._fault_fused_off = False
         self._fault_sequential_only = False
+        #: a failed one-dispatch drain degrades to the fused/classic
+        #: path for the rest of this instance's life (recovery boundary
+        #: for the run.drain fault site)
+        self._fault_onedispatch_off = False
         # mirror XLA compile events into the xla_* registry counters
         # (timeline compile_s/n_compiles columns, bench compile rows,
         # the zero-recompile tier-1 assertion)
@@ -640,6 +691,28 @@ class ABCSMC:
             return False  # the at-scale probe measured fused slower
         return self._device_chain_eligible()
 
+    def _onedispatch_eligible(self) -> bool:
+        """Route the steady state through the whole-run device-stop
+        program (sampler/fused.py:build_onedispatch_run)?  Opt-in via
+        ``run_mode='onedispatch'`` on top of the fused preconditions,
+        PLUS a device-evaluable stop chain: the epsilon must flag
+        ``device_stop_ok`` (its threshold comparison is exact on
+        device — a host-only schedule could stop a generation late).
+        The ``run.drain`` fault latch and the at-scale engine probe
+        demote to the classic paths exactly like ``_fused_eligible``."""
+        if self.run_mode != "onedispatch":
+            return False
+        if self._fault_onedispatch_off:
+            return False  # degraded after a failed one-dispatch drain
+        if self.fuse_generations < 2:
+            return False
+        if not getattr(self.eps, "device_stop_ok", False):
+            return False
+        if (self.population_strategy(0) > self.PROBE_MIN_POP
+                and self._engine_choice == "sequential"):
+            return False
+        return self._device_chain_eligible()
+
     def _note_sequential_gen_s(self, wall_s: float, compile_s: float = 0.0):
         """Record a sequential generation's steady-state seconds as the
         engine probe's baseline (compile time excluded — the fused
@@ -683,6 +756,11 @@ class ABCSMC:
         additionally requires a transfer-bound population size."""
         if self._fault_sequential_only:
             return False  # degraded after a pipelined dispatch failure
+        if self.run_mode == "onedispatch":
+            # the device while-loop IS the pipeline: one dispatch,
+            # streamed egress — layering the host-side block pipeline on
+            # top would re-introduce the per-block control round-trip
+            return False
         if self.ingest_mode == "sequential":
             return False
         if not self._device_chain_eligible():
@@ -821,18 +899,30 @@ class ABCSMC:
                 carry_in["rec_loggen"] = jnp.zeros((R,), jnp.float32)
         return carry_in
 
-    def _block_max_rounds(self, n: int, B: int) -> int:
-        """Per-generation round cap of a device block, derived from the
-        caller's ``min_acceptance_rate`` budget: past
-        ``ceil(n / (min_rate * B))`` evaluations the sequential loop
-        would have stopped anyway, so rounds beyond that only burn
-        device time on a generation the ingest will discard.  Capped at
-        the historical 16 (and the sequential default when no rate floor
-        is set)."""
+    def _block_max_rounds(self, n: int, B: int,
+                          rate_est: Optional[float] = None) -> int:
+        """Per-generation round cap of a device block.
+
+        The ceiling starts at the historical 16 and, when the sampler's
+        EWMA acceptance-rate estimate predicts a generation needs more
+        rounds than that (with a 4x safety factor for the in-block rate
+        decay a tightening schedule causes), grows by powers of two up
+        to 64 — so a hard-but-converging run undershoots less instead of
+        bouncing to the sequential path every block.  The
+        ``min_acceptance_rate`` budget then CLAMPS below the ceiling:
+        past ``ceil(n / (min_rate * B))`` evaluations the sequential
+        loop would have stopped anyway, so rounds beyond that only burn
+        device time on a generation the ingest will discard."""
+        hi = 16
+        if rate_est is not None and rate_est > 0:
+            need = int(np.ceil(
+                n / (max(float(rate_est), 1e-6) * B) * 4.0)) + 1
+            while hi < need and hi < 64:
+                hi *= 2
         if self.min_acceptance_rate > 0:
             return int(np.clip(
-                np.ceil(n / (self.min_acceptance_rate * B)), 1, 16))
-        return 16
+                np.ceil(n / (self.min_acceptance_rate * B)), 1, hi))
+        return hi
 
     def _lazy_gen_fetch(self, t0: int, n: int):
         """Build a ``GenStream`` fetch for lazy-History blocks: deposit
@@ -878,7 +968,8 @@ class ABCSMC:
         wire_stats = bool(samp.fetch_stats)
         wire_m_bits = self.M <= 2
         eps_mode, alpha, mult, weighted = self._eps_device_config()
-        max_rounds = self._block_max_rounds(n, B)
+        max_rounds = self._block_max_rounds(
+            n, B, rate_est=getattr(samp, "_rate_est", None))
         mode = self._block_mode()
         sup_cap = self.fused_support_cap
         record_rows = self._block_record_rows(B) if mode["stoch"] else 0
@@ -1110,23 +1201,24 @@ class ABCSMC:
                     "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
                     t_k, acc_rate, ess_k, evals_k)
                 written += 1
-                # stopping criteria, sequential order (run loop below)
+                # stopping criteria, sequential order (run loop below),
+                # decoded through the shared table so every engine emits
+                # the exact sequential strings
                 if eps_mode == "temperature":
                     if eps_k <= 1.0:
-                        stop_reason = "Stopping: temperature reached 1"
+                        stop_reason = STOP_REASONS[_fused.STOP_TEMPERATURE]
                 elif eps_k <= self.minimum_epsilon:
-                    stop_reason = "Stopping: minimum epsilon reached"
+                    stop_reason = STOP_REASONS[_fused.STOP_EPS]
                 if stop_reason is None:
                     if (self.stop_if_only_single_model_alive
                             and alive_k <= 1
                             and self.M > 1):
-                        stop_reason = "Stopping: single model alive"
+                        stop_reason = STOP_REASONS[_fused.STOP_SINGLE_MODEL]
                     elif acc_rate < self.min_acceptance_rate:
-                        stop_reason = "Stopping: acceptance rate too low"
+                        stop_reason = STOP_REASONS[_fused.STOP_ACC_RATE]
                     elif (total_sims + rounds_seen * B
                             >= max_total_nr_simulations):
-                        stop_reason = ("Stopping: simulation budget "
-                                       "exhausted")
+                        stop_reason = STOP_REASONS[_fused.STOP_BUDGET]
                 if stop_reason:
                     break
         finally:
@@ -1218,6 +1310,449 @@ class ABCSMC:
                 self._prepare_next_iteration(
                     t + written, prep, last_pop,
                     samp._rate_est)
+        return written, sims_added, stop_reason
+
+    # ------------------------------------------------------------------
+    # one-dispatch whole runs: device-side stopping (sampler/fused.py)
+    # ------------------------------------------------------------------
+
+    def _get_run_fn(self, t: int, n: int, B: int, K: int, max_T: int,
+                    summary: bool = False, aot_args=None):
+        """Build (or serve cached) the whole-run one-dispatch program —
+        the device-stop ``lax.while_loop`` over K-generation scan
+        blocks (sampler/fused.py:build_onedispatch_run).  Program shape
+        is keyed by (rung, max_T); every stop threshold rides the
+        traced ``ctl`` operand, so ONE compiled program serves every
+        run at the same shape — zero recompiles across runs.  With
+        ``aot_args`` the program is AOT-lowered and compiled at build
+        time (autotune/ladder.py:aot_compile), so the first dispatch of
+        a warm CompiledLadder pays no trace either."""
+        from .sampler.fused import build_onedispatch_run
+        samp = self.sampler
+        d, s_width = self.dim, self.spec.total_size
+        wire_stats = bool(samp.fetch_stats)
+        wire_m_bits = self.M <= 2
+        eps_mode, alpha, mult, weighted = self._eps_device_config()
+        max_rounds = self._block_max_rounds(
+            n, B, rate_est=getattr(samp, "_rate_est", None))
+        mode = self._block_mode()
+        sup_cap = self.fused_support_cap
+        record_rows = self._block_record_rows(B) if mode["stoch"] else 0
+        single_model_stop = (self.stop_if_only_single_model_alive
+                             and self.M > 1)
+        pdf_norm = 0.0
+        if mode["stoch"]:
+            norms = self.acceptor.pdf_norms
+            pdf_norm = float(norms.get(t, norms[max(norms)]
+                                       if norms else 0.0))
+        cache_key = ("onedispatch1", self._kernel._uid, samp._uid, B,
+                     n, K, max_T, d, s_width, eps_mode, alpha, mult,
+                     weighted, wire_stats, wire_m_bits, max_rounds,
+                     sup_cap, mode["adaptive"], mode["stoch"],
+                     record_rows, pdf_norm, single_model_stop,
+                     bool(summary))
+
+        def build():
+            from .autotune.ladder import aot_compile, avals_like
+            from .distance.kernel import SCALE_LIN
+            adaptive_cfg = None
+            if mode["adaptive"]:
+                dist = self.distance_function
+                adaptive_cfg = {
+                    "scale_fn": dist.scale_function,
+                    "distance_fn": dist.compute,
+                    "obs_flat": self._obs_flat,
+                    "max_weight_ratio": dist.max_weight_ratio,
+                    "normalize_weights": dist.normalize_weights,
+                    "factors": dist.factors,
+                }
+            stoch_cfg = None
+            if mode["stoch"]:
+                stoch_cfg = {
+                    "pdf_norm": pdf_norm,
+                    "target_rate": float(
+                        self.eps.schemes[0].target_rate),
+                    "lin_scale": (self.acceptor.kernel_scale
+                                  == SCALE_LIN),
+                    "record_rows": record_rows,
+                }
+            fn = jit_compile(build_onedispatch_run(
+                kernel=self._kernel,
+                raw_round=samp._raw_round(
+                    self._kernel.generation_round, B,
+                    with_proposal=False),
+                bandwidth_selectors=[tr.bandwidth_selector
+                                     for tr in self.transitions],
+                scalings=[tr.scaling for tr in self.transitions],
+                dims=[p.dim for p in self.parameter_priors],
+                n_target=n, B=B, max_rounds=max_rounds, K=K, d=d,
+                s=s_width,
+                eps_mode=eps_mode, eps_alpha=alpha, eps_multiplier=mult,
+                eps_weighted=weighted,
+                distance_params=(None if mode["adaptive"]
+                                 else jax.device_put(
+                                     self.distance_function
+                                     .get_params(t))),
+                wire_stats=wire_stats, wire_m_bits=wire_m_bits,
+                max_T=max_T, single_model_stop=single_model_stop,
+                support_cap=sup_cap,
+                rate_pred_factor=(alpha if eps_mode == "quantile"
+                                  else 1.0),
+                adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
+                summary_lanes=bool(summary)))
+            if aot_args is not None:
+                try:
+                    fn = aot_compile(fn, *avals_like(aot_args))
+                except Exception as err:  # noqa: BLE001
+                    logger.debug(
+                        "one-dispatch AOT lowering failed (%s): "
+                        "serving the JIT path", err)
+            return fn
+
+        ladder = getattr(samp, "_ladder", None)
+        if ladder is not None:
+            return ladder.get(cache_key, build)
+        fn = self._fused_cache.get(cache_key)
+        if fn is None:
+            fn = self._fused_cache[cache_key] = build()
+            while len(self._fused_cache) > 4:
+                self._fused_cache.pop(next(iter(self._fused_cache)))
+        return fn
+
+    def _onedispatch_fetch(self, t0: int, n: int, lazy: bool):
+        """GenStream fetch for the one-dispatch drain: slot ``k`` of
+        the device egress buffers carries generation ``t0 + k``'s
+        narrow wire plus the ``live`` stop-sentinel lane (0 = the
+        device stopped before writing this slot).  Matches the
+        GenStream 4-tuple contract with the payload widened to
+        ``(payload, live)`` so the drain loop terminates on the
+        sentinel instead of a host-known T; a dead slot costs one
+        O(4 B) control fetch and deposits nothing."""
+        from .sampler.base import fetch_to_host
+        from .wire import transfer as _transfer
+        from .wire.ingest import _fetch_gen
+
+        store = self._store
+
+        def fetch(k, gen_wire, n_rows):
+            gen_wire = dict(gen_wire)
+            live_lane = gen_wire.pop("live")
+            if lazy:
+                small = {key: gen_wire[key]
+                         for key in _wire_store.SUMMARY_LANE_KEYS
+                         if key in gen_wire}
+                for key in ("count", "rounds", "eps"):
+                    if key in gen_wire:
+                        small[key] = gen_wire[key]
+                small["live"] = live_lane
+                with _transfer.egress("summary"):
+                    out = fetch_to_host(small)
+                if not int(np.asarray(out.pop("live"))):
+                    return (None, 0), 0, 0, None
+                count = int(np.asarray(out["count"]))
+                rounds = int(np.asarray(out["rounds"]))
+                eps = (float(np.asarray(out["eps"], dtype=np.float64))
+                       if "eps" in out else None)
+                store.deposit(t0 + k, gen_wire, n=n_rows, count=count,
+                              eps=eps, norm="stream")
+                return ((_wire_store.summary_from_lanes(out), 1),
+                        count, rounds, eps)
+            with _transfer.egress("control"):
+                live = int(np.asarray(fetch_to_host(live_lane)))
+            if not live:
+                return (None, 0), 0, 0, None
+            payload, count, rounds, eps = _fetch_gen(gen_wire, n_rows)
+            return (payload, 1), count, rounds, eps
+
+        return fetch
+
+    def _run_onedispatch(self, t: int, t_max, total_sims: int,
+                         max_total_nr_simulations):
+        """Execute (up to) the rest of the run in ONE device dispatch —
+        the device evaluates the full stop chain between generations
+        (sampler/fused.py:build_onedispatch_run) and the host only
+        drains streamed per-generation egress until the stop sentinel,
+        then reads the O(bytes) control packet to learn why and when
+        the run stopped.
+
+        Returns ``(written, sims_added, stop_reason)`` like
+        ``_run_fused_block`` — 0 written means the caller takes the
+        classic path for ``t``.
+        """
+        import time as _time
+
+        from .sampler.base import fetch_to_host
+        from .wire import StreamingIngest
+        from .wire import transfer as _transfer
+        from .wire.ingest import GenStream, batch_to_population
+
+        carry = self._fused_carry
+        self._fused_carry = None
+        if carry is None:
+            return 0, 0, None
+        K = self.fuse_generations
+        n = self.population_strategy(t)
+        samp = self.sampler
+        if carry["theta"].shape[0] != n:
+            return 0, 0, None  # population size changed: classic path
+        B = samp.choose_batch(n)
+        mode = self._block_mode()
+        eps_mode = self._eps_device_config()[0]
+        carry_in = self._seed_block_carry(
+            t, carry, B, samp._rate_est,
+            samp._tuner.safety(samp.safety_factor))
+        if carry_in is None:
+            return 0, 0, None  # seed can't reproduce the chain state
+        lazy = self._lazy_active
+        max_T = self.onedispatch_max_t
+        i32max = int(np.iinfo(np.int32).max)
+        t_limit = (int(np.clip(t_max - t, 1, max_T))
+                   if np.isfinite(t_max) else max_T)
+        if np.isfinite(max_total_nr_simulations):
+            # integer-exact budget parity with the host re-check:
+            # total_sims + rounds*B >= max_total  <=>  rounds >=
+            # ceil((max_total - total_sims) / B)
+            budget_rounds = int(np.clip(
+                np.ceil((max_total_nr_simulations - total_sims) / B),
+                0, i32max))
+        else:
+            budget_rounds = i32max
+        final_rel = (max(int(t_max) - 1 - t, 0)
+                     if np.isfinite(t_max) else i32max)
+        ctl_in = {
+            "min_eps": jnp.float32(self.minimum_epsilon),
+            "min_rate": jnp.float32(self.min_acceptance_rate),
+            "budget_rounds": jnp.int32(budget_rounds),
+            "t_limit": jnp.int32(t_limit),
+            "final_rel": jnp.int32(final_rel),
+        }
+        # the orchestrator key goes down UN-split: the device replays
+        # the host block protocol (one split per K-block), so the
+        # generation key stream is bit-identical to the fused path
+        args = (carry_in, self.key, ctl_in)
+        t0_run = _time.perf_counter()
+        tr0_run = _transfer.snapshot()
+        cc0_run = _compile_counters()
+        fn = self._get_run_fn(t, n, B, K, max_T, summary=lazy,
+                              aot_args=args)
+        dispatch_mark = _time.perf_counter()
+        try:
+            with profile_generation(t), \
+                    _spans.span("onedispatch.dispatch", gen=t,
+                                max_t=t_limit):
+                carry_out, ctl_out, wires = self._retry.call(
+                    fn, _faults.SITE_DISPATCH, *args)
+        except _retry.RetryExhausted as err:
+            logger.warning(
+                "one-dispatch run failed after retries (%s): degrading "
+                "to the per-block paths for this run", err)
+            self._fault_onedispatch_off = True
+            return 0, 0, None
+        dispatch_s = _time.perf_counter() - dispatch_mark
+        self.run_dispatches += 1
+        _metrics.REGISTRY.counter(
+            "pyabc_tpu_run_dispatches_total",
+            "whole-run device dispatches issued by the orchestrator",
+        ).inc()
+        # adopt the advanced key WITHOUT a d2h round-trip — the host
+        # never needs its value, only to keep threading it
+        self.key = ctl_out["key"]
+
+        engine = StreamingIngest(depth=self.ingest_depth)
+        stream = GenStream(engine, wires, max_T, n,
+                           label=f"onedispatch@t={t}",
+                           fetch=self._onedispatch_fetch(t, n, lazy))
+        written = 0
+        stop_reason = None
+        interrupted = None
+        aborted = False
+        drain_error = None
+        append_s_total = 0.0
+        gen_meta = []  # (eps, accepted, evals, rounds) per written gen
+        pop_k = None
+        try:
+            for k in range(max_T):
+                t_k = t + k
+                # checkpoint/fault sites sit at the DRAIN boundary —
+                # there is no per-block host hook anymore; SIGTERM and
+                # operator stop abandon the remaining slots (device
+                # work already happened, the control packet below keeps
+                # the budget honest) and the run resumes from the last
+                # drained generation
+                if stop_requested():
+                    interrupted = "Stopping: operator stop requested"
+                    break
+                if _ckpt.preempt_requested():
+                    interrupted = ("Stopping: preemption requested "
+                                   "(SIGTERM)")
+                    break
+                _faults.fault_point(_faults.SITE_DRAIN, data={"t": t_k})
+                with _spans.span("onedispatch.ingest", gen=t_k):
+                    (payload_k, live_k), count_k, rounds_k, eps_raw = \
+                        stream.result()
+                if not live_k:
+                    break  # the device stop sentinel
+                evals_k = rounds_k * B
+                summary_k = None
+                if lazy:
+                    summary_k = payload_k
+                    pop_k = None
+                    ess_k = float(summary_k["ess"])
+                    alive_k = sum(1 for x in summary_k["model_w"]
+                                  if x > 0)
+                    if not (np.isfinite(ess_k) and ess_k > 0):
+                        logger.warning(
+                            "one-dispatch run produced degenerate "
+                            "weights at t=%d: sequential fallback", t_k)
+                        self._store.drop(t_k)
+                        aborted = True
+                        break
+                else:
+                    pop_k = batch_to_population(payload_k)
+                    if pop_k is None:
+                        logger.warning(
+                            "one-dispatch run produced degenerate "
+                            "weights at t=%d: sequential fallback", t_k)
+                        aborted = True
+                        break
+                    ess_k = float(effective_sample_size(pop_k.weight))
+                    alive_k = pop_k.nr_of_models_alive()
+                del alive_k  # the device already evaluated the stop
+                eps_k = (float(self.eps(t_k)) if eps_mode == "constant"
+                         else float(eps_raw))
+                acc_rate = count_k / max(evals_k, 1)
+                logger.info("t: %d, eps: %.8g (onedispatch)", t_k, eps_k)
+                append_mark = _time.perf_counter()
+                with _spans.span("gen.append", gen=t_k):
+                    if lazy:
+                        self.history.append_population_lazy(
+                            t_k, eps_k, evals_k, summary=summary_k,
+                            model_names=[m.name for m in self.models],
+                            param_names=self._param_names(),
+                            stat_spec=self.spec.shapes)
+                    else:
+                        self.history.append_population(
+                            t_k, eps_k, pop_k, evals_k,
+                            [m.name for m in self.models],
+                            self._param_names(),
+                            stat_spec=self.spec.shapes)
+                append_s_total += _time.perf_counter() - append_mark
+                gen_meta.append((eps_k, count_k, evals_k, rounds_k))
+                if eps_mode == "quantile":
+                    self.eps._look_up[t_k] = eps_k
+                elif eps_mode == "temperature":
+                    self.eps.temperatures[t_k] = eps_k
+                logger.info(
+                    "t: %d, acceptance rate: %.4g, ESS: %.4g, evals: %d",
+                    t_k, acc_rate, ess_k, evals_k)
+                written += 1
+        except Exception as err:  # noqa: BLE001 — degrade, don't die
+            drain_error = err
+        finally:
+            # remaining slots stay undrained on purpose: their device
+            # work is already billed by the control packet's round
+            # total, and a stopped run's tail slots were never written
+            stream.abandon()
+            engine.close()
+
+        # the O(bytes) control packet: why/when the device stopped.
+        # Fetched AFTER the drain so the wait for the device program
+        # lands on the first slot's fetch (like the fused path) and
+        # this round-trip stays pure control-plane cost.
+        ctl_mark = _time.perf_counter()
+        with _transfer.egress("control"):
+            ctl = fetch_to_host({key: v for key, v in ctl_out.items()
+                                 if key != "key"})
+        self.control_roundtrip_s += _time.perf_counter() - ctl_mark
+        stop_code = int(np.asarray(ctl["stop"]))
+        written_dev = int(np.asarray(ctl["t"]))
+        stop_t_rel = int(np.asarray(ctl["stop_t"]))
+        stop_count = int(np.asarray(ctl["stop_count"]))
+        rounds_total = int(np.asarray(ctl["rounds"]))
+        sims_added = rounds_total * B
+        samp.nr_evaluations_ += sims_added
+        if lazy:
+            # deposits past the last durably-written generation have no
+            # History row (interrupt/degenerate tails) — drop them
+            self._store.drop_from(t + written)
+
+        clean = (drain_error is None and not aborted
+                 and interrupted is None and written == written_dev)
+        if drain_error is not None:
+            logger.warning(
+                "one-dispatch drain failed at t=%d (%s): degrading to "
+                "the per-block paths for this run", t + written,
+                drain_error)
+            self._fault_onedispatch_off = True
+        elif (interrupted is None and not aborted
+                and written != written_dev):
+            logger.warning(
+                "one-dispatch drain harvested %d generation(s) but the "
+                "device wrote %d: degrading to the per-block paths",
+                written, written_dev)
+            self._fault_onedispatch_off = True
+        if clean:
+            if stop_code == _fused.STOP_UNDERSHOOT:
+                logger.info(
+                    "one-dispatch run undershot at t=%d (%d/%d "
+                    "accepted): falling back to the sequential path",
+                    t + max(stop_t_rel, 0), stop_count, n)
+            elif stop_code in STOP_REASONS:
+                stop_reason = STOP_REASONS[stop_code]
+        if interrupted is not None:
+            stop_reason = interrupted
+
+        if written:
+            run_dt = _time.perf_counter() - t0_run
+            tr_delta = _transfer.delta(tr0_run)
+            cc_delta = _compile_delta(cc0_run)
+            for k in range(written):
+                self.generation_wall_clock[t + k] = run_dt / written
+                self.generation_transfer[t + k] = {
+                    key: v / written for key, v in tr_delta.items()}
+                eps_k, count_k, evals_k, rounds_k = gen_meta[k]
+                self.timeline.record(
+                    t + k, path="onedispatch",
+                    wall_s=run_dt / written,
+                    stages={
+                        "dispatch": dispatch_s / written,
+                        "compute": tr_delta["compute_s"] / written,
+                        "fetch": tr_delta["fetch_s"] / written,
+                        "decode": tr_delta["decode_s"] / written,
+                        "append": append_s_total / written,
+                    },
+                    eps=eps_k, accepted=count_k, total=evals_k,
+                    overlap_s=tr_delta["overlap_s"] / written,
+                    compile_s=(cc_delta["compile_s"] if k == 0 else 0.0),
+                    n_compiles=(cc_delta["n_compiles"] if k == 0 else 0),
+                    engine="onedispatch")
+                _metrics.record_generation(
+                    evals_k, count_k, count_k / max(evals_k, 1),
+                    rounds=rounds_k, wall_s=run_dt / written)
+                samp.observe_generation(
+                    count_k, evals_k, rounds=rounds_k,
+                    compute_s=tr_delta["compute_s"] / written,
+                    overlap_s=tr_delta["overlap_s"] / written)
+            if self._fleet is not None:
+                self._fleet.publish(self.timeline)
+            last_pop = pop_k
+            if stop_reason is None and t + written < t_max:
+                if lazy and last_pop is None:
+                    last_pop = self.history.hydrate_population(
+                        t + written - 1)
+                prep = Sample()
+                if clean and stop_code == _fused.STOP_NONE:
+                    # t_limit hit mid-run: keep the device chain hot so
+                    # the next dispatch continues from this frontier
+                    self._fused_carry = carry_out
+                    prep.device_population = dict(carry_out)
+                    if mode["adaptive"]:
+                        self.distance_function.weights[t + written] = \
+                            np.asarray(carry_out["dist_w"], np.float32)
+                else:
+                    prep.device_population = None
+                self._prepare_next_iteration(
+                    t + written, prep, last_pop, samp._rate_est)
         return written, sims_added, stop_reason
 
     # ------------------------------------------------------------------
@@ -1561,21 +2096,20 @@ class ABCSMC:
                         if blk["kind"] == "block" else st["total_sims"])
                     if eps_mode == "temperature":
                         if eps_k <= 1.0:
-                            st["stop"] = ("Stopping: temperature "
-                                          "reached 1")
+                            st["stop"] = STOP_REASONS[
+                                _fused.STOP_TEMPERATURE]
                     elif eps_k <= self.minimum_epsilon:
-                        st["stop"] = "Stopping: minimum epsilon reached"
+                        st["stop"] = STOP_REASONS[_fused.STOP_EPS]
                     if not st["stop"]:
                         if (self.stop_if_only_single_model_alive
                                 and alive_k <= 1
                                 and self.M > 1):
-                            st["stop"] = "Stopping: single model alive"
+                            st["stop"] = STOP_REASONS[
+                                _fused.STOP_SINGLE_MODEL]
                         elif acc_rate < self.min_acceptance_rate:
-                            st["stop"] = ("Stopping: acceptance rate "
-                                          "too low")
+                            st["stop"] = STOP_REASONS[_fused.STOP_ACC_RATE]
                         elif sims_so_far >= max_total_nr_simulations:
-                            st["stop"] = ("Stopping: simulation budget "
-                                          "exhausted")
+                            st["stop"] = STOP_REASONS[_fused.STOP_BUDGET]
                     if st["stop"]:
                         break
             finally:
@@ -1696,6 +2230,7 @@ class ABCSMC:
             ingest.close()  # abandons anything still in flight
         if st["stop"]:
             logger.info(st["stop"])
+            self.timeline.stop_reason = st["stop"]
         # keep the device chain hot for a later run() continuation
         self._fused_carry = st["carry"] if st["stop"] is None else None
 
@@ -1905,6 +2440,11 @@ class ABCSMC:
         self.minimum_epsilon = minimum_epsilon
         self.max_nr_populations = max_nr_populations
         self.min_acceptance_rate = min_acceptance_rate
+        # per-run control-plane accounting (bench: dispatches_per_run,
+        # control_roundtrip_s_per_gen) and the run's stop verdict
+        self.run_dispatches = 0
+        self.control_roundtrip_s = 0.0
+        self.timeline.stop_reason = None
 
         t0 = self.history.max_t + 1
         with _spans.span("calibrate", gen=t0):
@@ -1991,13 +2531,37 @@ class ABCSMC:
             # (redis_eps/cli.py:276-277) — state is already durable in the
             # History, so a later run() resumes exactly here
             if stop_requested():
-                logger.info("Stopping: operator stop requested")
+                self.timeline.stop_reason = \
+                    "Stopping: operator stop requested"
+                logger.info(self.timeline.stop_reason)
                 break
             if _ckpt.preempt_requested():
                 # signal arrived between generations: nothing in flight,
                 # the History frontier is already durable
-                logger.info("Stopping: preemption requested (SIGTERM)")
+                self.timeline.stop_reason = \
+                    "Stopping: preemption requested (SIGTERM)"
+                logger.info(self.timeline.stop_reason)
                 break
+            # one-dispatch whole runs: the device evaluates the stop
+            # chain itself, so the remaining run (up to max_T
+            # generations) goes down as a single dispatch
+            if (self._onedispatch_eligible()
+                    and self._fused_carry is not None):
+                written, sims, stop_reason = self._run_onedispatch(
+                    t, t_max, total_sims, max_total_nr_simulations)
+                total_sims += sims
+                if written:
+                    t += written
+                    gen_mark = _time.perf_counter()
+                    tr_mark = _transfer.snapshot()
+                    cc_mark = _compile_counters()
+                if stop_reason is not None:
+                    logger.info(stop_reason)
+                    self.timeline.stop_reason = stop_reason
+                    break
+                if written:
+                    continue
+                # no generation written: classic path for this t
             # enter a fused block only when ALL K generations fit before
             # t_max — the compiled program always executes K, so a tail
             # block would burn device work on discarded generations
@@ -2014,6 +2578,7 @@ class ABCSMC:
                     cc_mark = _compile_counters()
                     if stop_reason is not None:
                         logger.info(stop_reason)
+                        self.timeline.stop_reason = stop_reason
                         break
                     continue
                 # no generation written: sequential path for this t
@@ -2077,9 +2642,11 @@ class ABCSMC:
                                     splice["nr_evaluations"])
             sample_s = _time.perf_counter() - sample_mark
             if sample.n_accepted < n:
-                logger.info(
-                    "Stopping: acceptance rate fell below min_acceptance_rate"
-                    " (%d/%d accepted)", sample.n_accepted, n)
+                self.timeline.stop_reason = (
+                    "Stopping: acceptance rate fell below "
+                    "min_acceptance_rate (%d/%d accepted)"
+                    % (sample.n_accepted, n))
+                logger.info(self.timeline.stop_reason)
                 break
             # lazy-History gate (wire/store.py tentpole): the deferred
             # wire must still be device-resident, with no host-side rows
@@ -2185,9 +2752,9 @@ class ABCSMC:
             tuner = getattr(self.sampler, "_tuner", None)
             if tuner is not None:
                 tuner.observe_timing(tr_t["compute_s"], tr_t["overlap_s"])
-            if self._fused_eligible():
+            if self._fused_eligible() or self._onedispatch_eligible():
                 # accepted buffers of THIS generation stay device-resident
-                # as the next fused block's carry
+                # as the next fused block's / one-dispatch run's carry
                 dp = getattr(sample, "device_population", None)
                 self._fused_carry = (
                     dp if dp is not None and "distance" in dp else None)
@@ -2196,22 +2763,33 @@ class ABCSMC:
                 t, acceptance_rate, ess, sample.nr_evaluations)
 
             # ---- stopping criteria (reference smc.py:940-949) ------------
+            # decoded through the shared code->string table so every
+            # engine's stop_reason wording stays identical
             if (not isinstance(self.eps, TemperatureBase)
                     and current_eps <= minimum_epsilon):
-                logger.info("Stopping: minimum epsilon reached")
+                self.timeline.stop_reason = STOP_REASONS[_fused.STOP_EPS]
+                logger.info(self.timeline.stop_reason)
                 break
             if isinstance(self.eps, TemperatureBase) and current_eps <= 1.0:
-                logger.info("Stopping: temperature reached 1")
+                self.timeline.stop_reason = \
+                    STOP_REASONS[_fused.STOP_TEMPERATURE]
+                logger.info(self.timeline.stop_reason)
                 break
             if (self.stop_if_only_single_model_alive
                     and population.nr_of_models_alive() <= 1 and self.M > 1):
-                logger.info("Stopping: single model alive")
+                self.timeline.stop_reason = \
+                    STOP_REASONS[_fused.STOP_SINGLE_MODEL]
+                logger.info(self.timeline.stop_reason)
                 break
             if acceptance_rate < min_acceptance_rate:
-                logger.info("Stopping: acceptance rate too low")
+                self.timeline.stop_reason = \
+                    STOP_REASONS[_fused.STOP_ACC_RATE]
+                logger.info(self.timeline.stop_reason)
                 break
             if total_sims >= max_total_nr_simulations:
-                logger.info("Stopping: simulation budget exhausted")
+                self.timeline.stop_reason = \
+                    STOP_REASONS[_fused.STOP_BUDGET]
+                logger.info(self.timeline.stop_reason)
                 break
             if t + 1 >= t_max:
                 break

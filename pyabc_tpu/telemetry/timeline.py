@@ -38,6 +38,12 @@ class GenerationTimeline:
         #: by the orchestrator so bench/heartbeat consumers can tell
         #: which dataflow produced the rows (wire/store.py)
         self.history_mode: Optional[str] = None
+        #: why the run stopped — the orchestrator assigns the EXACT
+        #: sequential stop string (smc.py:STOP_REASONS, plus the
+        #: operator/preemption/undershoot messages) at every stop site,
+        #: any engine; None while running or when the run exhausted
+        #: max_nr_populations without tripping a criterion
+        self.stop_reason: Optional[str] = None
 
     def record(self, t: int, *, path: str, wall_s: float,
                stages: Optional[dict] = None, eps: Optional[float] = None,
@@ -120,6 +126,7 @@ class GenerationTimeline:
             "n_compiles_total": int(sum(r["n_compiles"] for r in rows)),
             "engine_decision": engine,
             "history_mode": self.history_mode,
+            "stop_reason": self.stop_reason,
         }
 
     def render_ascii(self) -> str:

@@ -73,6 +73,11 @@ _HELP = "device-resident population store; see pyabc_tpu/wire/store.py"
 SUMMARY_LANE_KEYS = ("sm_ess", "sm_mean", "sm_var", "sm_mw", "sm_mn",
                      "sm_dmin", "sm_dmean")
 
+#: control-plane lanes of the one-dispatch egress buffers (the drain's
+#: stop sentinel) — never population data, so a deposit strips them:
+#: a hydrated population must be bit-identical to the per-block wire
+CONTROL_LANE_KEYS = ("live",)
+
 
 def default_max_gens() -> int:
     """Ring capacity from ``$PYABC_TPU_STORE_GENS`` (default 12)."""
@@ -350,6 +355,9 @@ class DeviceRunStore:
         from ..resilience.journal import manifest_of
 
         _faults.fault_point(_faults.SITE_STORE_DEPOSIT)
+        if any(k in wire for k in CONTROL_LANE_KEYS):
+            wire = {k: v for k, v in wire.items()
+                    if k not in CONTROL_LANE_KEYS}
         entry = {
             "t": int(t), "wire": wire, "n": int(n), "count": int(count),
             "eps": None if eps is None else float(eps),

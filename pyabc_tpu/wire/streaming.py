@@ -196,11 +196,14 @@ class StreamingIngest:
         return ticket
 
     def drain(self):
-        """Abandon every outstanding ticket (stop/teardown path)."""
+        """Abandon every outstanding ticket (stop/teardown path).
+        Returns how many tickets were abandoned, so a variable-length
+        drain (one-dispatch runs) can report what it cut short."""
         with self._lock:
             pending = list(self._outstanding)
         for ticket in pending:
             ticket.abandon()
+        return len(pending)
 
     def close(self):
         self.drain()

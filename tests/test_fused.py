@@ -384,6 +384,148 @@ def test_fused_simulation_budget_stop():
     assert sims.sum() >= 4000
 
 
+def _onedispatch_abc(run_mode="onedispatch", fuse=2, pop=200, batch=2048,
+                     eps_value=0.2, seed=0, **kwargs):
+    """Two-gaussians config for the one-dispatch tests, with the
+    sampler batch PINNED (min == max) so _block_max_rounds is identical
+    at every compile point — see test_stop_sampling.py for why that is
+    required for bit-identity against the per-block fused path."""
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    eps=pt.ConstantEpsilon(eps_value),
+                    sampler=pt.VectorizedSampler(min_batch_size=batch,
+                                                 max_batch_size=batch),
+                    fuse_generations=fuse, run_mode=run_mode,
+                    seed=seed, **kwargs)
+    abc.new("sqlite://", observed)
+    return abc
+
+
+def test_onedispatch_bit_identical_to_fused():
+    """The whole-run device-stop program vs the per-block fused loop:
+    same config, ONE dispatch vs one-per-block — every generation's
+    population must be bit-identical, because both paths execute the
+    same compiled block body on the same key schedule."""
+    a_o = _onedispatch_abc()
+    h_o = a_o.run(max_nr_populations=7)
+    a_f = _onedispatch_abc(run_mode=None)
+    h_f = a_f.run(max_nr_populations=7)
+    assert h_o.max_t == 6 and h_f.max_t == 6
+    assert a_o.run_dispatches == 1
+    rows = a_o.timeline.to_rows()
+    # t=0 seeds the carry sequentially; t=1..6 ride the one dispatch
+    assert [r["path"] for r in rows] == \
+        ["sequential"] + ["onedispatch"] * 6
+    assert all(r["engine"] == "onedispatch"
+               for r in rows if r["path"] == "onedispatch")
+    # the counter tracks device-stop program dispatches only: the
+    # per-block fused run never touches it
+    assert a_f.run_dispatches == 0
+    for t in range(7):
+        for m in range(2):
+            df_o, w_o = h_o.get_distribution(m=m, t=t)
+            df_f, w_f = h_f.get_distribution(m=m, t=t)
+            assert len(df_o) == len(df_f), (t, m)
+            if len(df_o) == 0:
+                continue
+            np.testing.assert_array_equal(df_o["mu"].to_numpy(),
+                                          df_f["mu"].to_numpy())
+            np.testing.assert_array_equal(w_o, w_f)
+    counts = h_o.get_nr_particles_per_population()
+    assert all(counts[t] == 200 for t in range(7))
+
+
+def test_onedispatch_eligibility_gating():
+    # opt-in only: the default run mode never routes here
+    abc0 = _onedispatch_abc(run_mode=None)
+    assert abc0._onedispatch_eligible() is False
+    assert abc0._fused_eligible() is True  # ... but still fuses
+    # the blessed config
+    abc1 = _onedispatch_abc()
+    assert abc1._onedispatch_eligible() is True
+    # epsilon without a device-exact threshold (ListEpsilon carries no
+    # device_stop_ok flag): the stop chain cannot run on device
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc2 = pt.ABCSMC(models, priors, distance, population_size=200,
+                     eps=pt.ListEpsilon([0.5, 0.3, 0.2, 0.1, 0.05]),
+                     sampler=pt.VectorizedSampler(),
+                     fuse_generations=2, run_mode="onedispatch", seed=0)
+    abc2.new("sqlite://", observed)
+    assert abc2._onedispatch_eligible() is False
+    # no fused blocks -> no one-dispatch program either
+    abc3 = _onedispatch_abc(fuse=1)
+    assert abc3._onedispatch_eligible() is False
+    # the run.drain fault latch demotes for the rest of the run
+    abc4 = _onedispatch_abc()
+    abc4._fault_onedispatch_off = True
+    assert abc4._onedispatch_eligible() is False
+    # at-scale engine probe: a measured sequential win retires it
+    abc5 = _onedispatch_abc(pop=1_000_000)
+    assert abc5._onedispatch_eligible() is True
+    abc5._engine_choice = "sequential"
+    assert abc5._onedispatch_eligible() is False
+
+
+def test_onedispatch_redispatch_past_max_t():
+    """A compiled run program covers at most ``onedispatch_max_t``
+    generations; a run that needs more re-dispatches the SAME compiled
+    program from the drained frontier — complete History, one dispatch
+    per max_T window."""
+    abc = _onedispatch_abc()
+    abc.onedispatch_max_t = 2
+    h = abc.run(max_nr_populations=7)
+    assert h.max_t == 6
+    # gens 1..6 in windows of <= 2 -> 3 dispatches
+    assert abc.run_dispatches == 3
+    counts = h.get_nr_particles_per_population()
+    assert all(counts[t] == 200 for t in range(7))
+    # bit-identity with the single-dispatch run is NOT expected here
+    # (the key split schedule advances per dispatch), but the paths are
+    rows = abc.timeline.to_rows()
+    assert [r["path"] for r in rows] == \
+        ["sequential"] + ["onedispatch"] * 6
+
+
+def test_onedispatch_lazy_history():
+    """One-dispatch over the lazy (device-resident) History: the drain
+    deposits wire slices into the DeviceRunStore instead of shipping
+    populations d2h — same History contract."""
+    abc = _onedispatch_abc(history_mode="lazy")
+    h = abc.run(max_nr_populations=6)
+    assert h.max_t == 5
+    assert abc.run_dispatches == 1
+    counts = h.get_nr_particles_per_population()
+    assert all(counts[t] == 200 for t in range(6))
+    df, w = h.get_distribution(m=1, t=5)
+    assert np.all(np.isfinite(df["mu"].to_numpy()))
+    assert np.isclose(w.sum(), 1.0, atol=1e-5)
+
+
+def test_block_max_rounds_policy():
+    """Unit pins of the round-budget policy: pow2 ceiling growth from
+    the EWMA rate estimate (16 -> 32 -> 64, never beyond) and the
+    min_acceptance_rate clamp below it."""
+    abc = _onedispatch_abc()
+    abc.min_acceptance_rate = 0.0
+    # no estimate, or an ample one: the historical 16
+    assert abc._block_max_rounds(400, 4096) == 16
+    assert abc._block_max_rounds(400, 4096, rate_est=0.5) == 16
+    # need = ceil(n/(rate*B) * 4) + 1; n=100, B=100, rate=0.15 -> 28
+    assert abc._block_max_rounds(100, 100, rate_est=0.15) == 32
+    # a vanishing rate estimate saturates at the 64 cap
+    assert abc._block_max_rounds(400, 4096, rate_est=1e-9) == 64
+    # min_acceptance_rate clamps BELOW the ceiling: past this many
+    # rounds the sequential loop would have stopped the run anyway
+    abc.min_acceptance_rate = 0.625
+    assert abc._block_max_rounds(1000, 100) == 16  # ceil(1000/62.5)
+    abc.min_acceptance_rate = 0.9
+    assert abc._block_max_rounds(1000, 100) == 12
+    # ... and never exceeds the (possibly grown) ceiling
+    abc.min_acceptance_rate = 1e-6
+    assert abc._block_max_rounds(1000, 100) == 16
+    assert abc._block_max_rounds(1000, 100, rate_est=1e-9) == 64
+
+
 def test_systematic_weighted_choice_unit():
     """ops.choice.systematic_weighted_choice (the capped-support
     resampler): index bounds, O(1/n) weighted-moment preservation, and

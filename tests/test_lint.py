@@ -245,12 +245,15 @@ def test_fused_eligibility_missing_and_suppressed(tmp_path):
     got = mod.check(root=str(pkg))
     assert {msg for _, _, msg in got} == {
         "_device_chain_eligible() not found",
-        "_fused_eligible() not found"}
+        "_fused_eligible() not found",
+        "_onedispatch_eligible() not found"}
     (pkg / "smc.py").write_text(
         "class ABCSMC:\n"
         "    def _device_chain_eligible(self):\n"
         "        return False  # eligibility-ok\n"
         "    def _fused_eligible(self):\n"
+        "        return False  # eligibility-ok\n"
+        "    def _onedispatch_eligible(self):\n"
         "        return False  # eligibility-ok\n")
     assert mod.check(root=str(pkg)) == []
 

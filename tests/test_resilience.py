@@ -498,6 +498,41 @@ def test_checkpointer_base_splice_survives_second_preemption(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# one-dispatch drain chaos
+# ---------------------------------------------------------------------------
+
+def test_run_drain_fault_latches_onedispatch_off():
+    """``run.drain`` chaos: a failure while draining the one-dispatch
+    egress stream abandons the stream, latches the engine off for the
+    rest of the run, and the run completes on the classic paths —
+    generations drained BEFORE the fault stay durable."""
+    faults.install(faults.FaultPlan.parse(
+        "run.drain@2:raise=ConnectionResetError"))
+    from pyabc_tpu.models import make_two_gaussians_problem
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=200,
+                    eps=pt.ConstantEpsilon(0.2),
+                    sampler=pt.VectorizedSampler(min_batch_size=2048,
+                                                 max_batch_size=2048),
+                    fuse_generations=2, run_mode="onedispatch", seed=0)
+    abc.new("sqlite://", observed)
+    h = abc.run(max_nr_populations=6)
+    # the run still completes every generation with full populations
+    assert h.max_t == 5
+    counts = h.get_nr_particles_per_population()
+    assert all(counts[t] == 200 for t in range(6))
+    # the latch: no further one-dispatch attempts this run (or the next)
+    assert abc._fault_onedispatch_off is True
+    assert abc._onedispatch_eligible() is False
+    paths = [r["path"] for r in abc.timeline.to_rows()]
+    # drain slot 1 (t=1) was harvested before the slot-2 fault; every
+    # generation after the abandoned stream rode the classic paths
+    assert paths[0] == "sequential"
+    assert paths[1] == "onedispatch"
+    assert "onedispatch" not in paths[2:]
+
+
+# ---------------------------------------------------------------------------
 # disabled-path overhead
 # ---------------------------------------------------------------------------
 

@@ -45,3 +45,196 @@ def test_stop_on_minimum_epsilon(db_path):
     # generation at eps=0.3 runs, then the criterion fires
     assert float(pops[pops.t >= 0].epsilon.min()) == pytest.approx(0.3)
     assert h.n_populations == 2
+
+
+# ---------------------------------------------------------------------
+# One-dispatch parity gate: for each stop criterion the device-side
+# stop chain (run_mode="onedispatch"), the fused-K host loop, and the
+# sequential engine must stop for the SAME reason, with bit-identical
+# populations between onedispatch and fused (the sequential engine
+# draws a different RNG schedule, so only its stop STRING is compared).
+#
+# Every config pins the sampler batch (min == max): the fused path
+# recompiles each block with the then-current acceptance-rate estimate,
+# and a floating batch can grow the compiled round budget (16 -> 32)
+# mid-run, while the one-dispatch program compiles exactly once.  A
+# pinned batch keeps _block_max_rounds identical at every compile
+# point, which is what makes bit-identity a fair contract.
+# ---------------------------------------------------------------------
+
+
+def _pinned(batch):
+    return pt.VectorizedSampler(min_batch_size=batch,
+                                max_batch_size=batch)
+
+
+def _assert_stop_parity(a_o, h_o, a_f, h_f, a_s, reason, n_models=2):
+    assert a_o.timeline.stop_reason == reason
+    assert a_f.timeline.stop_reason == reason
+    assert a_s.timeline.stop_reason == reason
+    assert a_o.timeline.summary()["stop_reason"] == reason
+    # the device-stop program actually carried the run: one dispatch
+    assert a_o.run_dispatches == 1
+    paths = [r["path"] for r in a_o.timeline.to_rows()]
+    assert "onedispatch" in paths, paths
+    assert h_o.max_t == h_f.max_t
+    for t in range(h_o.max_t + 1):
+        for m in range(n_models):
+            df_o, w_o = h_o.get_distribution(m=m, t=t)
+            df_f, w_f = h_f.get_distribution(m=m, t=t)
+            assert len(df_o) == len(df_f), (t, m)
+            if len(df_o) == 0:
+                continue  # dead model: empty frame, nothing to compare
+            np.testing.assert_array_equal(df_o["mu"].to_numpy(),
+                                          df_f["mu"].to_numpy())
+            np.testing.assert_array_equal(w_o, w_f)
+
+
+def test_onedispatch_stop_parity_minimum_epsilon():
+    def build(run_mode, fuse):
+        models, priors, distance, observed, _ = \
+            make_two_gaussians_problem()
+        abc = pt.ABCSMC(models, priors, distance, population_size=400,
+                        eps=pt.QuantileEpsilon(alpha=0.8),
+                        sampler=_pinned(4096), fuse_generations=fuse,
+                        run_mode=run_mode, seed=0)
+        abc.new("sqlite://", observed)
+        abc.onedispatch_max_t = 16
+        return abc
+
+    a_o = build("onedispatch", 4)
+    h_o = a_o.run(max_nr_populations=14, minimum_epsilon=0.25)
+    a_f = build(None, 4)
+    h_f = a_f.run(max_nr_populations=14, minimum_epsilon=0.25)
+    a_s = build(None, 1)
+    a_s.run(max_nr_populations=14, minimum_epsilon=0.25)
+    _assert_stop_parity(a_o, h_o, a_f, h_f, a_s,
+                        "Stopping: minimum epsilon reached")
+    # the criterion fired before the generation cap on every engine
+    assert h_o.max_t < 13
+
+
+def test_onedispatch_stop_parity_min_acceptance_rate():
+    def build(run_mode, fuse):
+        models, priors, distance, observed, _ = \
+            make_two_gaussians_problem()
+        abc = pt.ABCSMC(models, priors, distance, population_size=150,
+                        sampler=_pinned(4096), fuse_generations=fuse,
+                        run_mode=run_mode, seed=1)  # default MedianEps
+        abc.new("sqlite://", observed)
+        abc.onedispatch_max_t = 16
+        return abc
+
+    a_o = build("onedispatch", 3)
+    h_o = a_o.run(max_nr_populations=14, min_acceptance_rate=0.1)
+    a_f = build(None, 3)
+    h_f = a_f.run(max_nr_populations=14, min_acceptance_rate=0.1)
+    a_s = build(None, 1)
+    a_s.run(max_nr_populations=14, min_acceptance_rate=0.1)
+    _assert_stop_parity(a_o, h_o, a_f, h_f, a_s,
+                        "Stopping: acceptance rate too low")
+    assert h_o.max_t < 13
+
+
+def test_onedispatch_stop_parity_simulation_budget():
+    """Boundary regression: the budget is set to the EXACT cumulative
+    simulation count at generation 3, so a >=-vs-> or ceil slip on any
+    engine moves the stop generation."""
+    def build(run_mode, fuse):
+        models, priors, distance, observed, _ = \
+            make_two_gaussians_problem()
+        abc = pt.ABCSMC(models, priors, distance, population_size=200,
+                        eps=pt.ConstantEpsilon(0.2),
+                        sampler=_pinned(2048), fuse_generations=fuse,
+                        run_mode=run_mode, seed=0)
+        abc.new("sqlite://", observed)
+        abc.onedispatch_max_t = 16
+        return abc
+
+    # probe: exact per-generation counts for this (deterministic) config
+    probe = build(None, 1)
+    h_p = probe.run(max_nr_populations=6)
+    sims = h_p.get_all_populations()
+    sims = sims[sims.t >= 0].samples.to_numpy()
+    budget = int(sims[:4].sum())  # exact total at the END of gen 3
+
+    a_o = build("onedispatch", 2)
+    h_o = a_o.run(max_nr_populations=6, max_total_nr_simulations=budget)
+    a_f = build(None, 2)
+    h_f = a_f.run(max_nr_populations=6, max_total_nr_simulations=budget)
+    a_s = build(None, 1)
+    h_s = a_s.run(max_nr_populations=6, max_total_nr_simulations=budget)
+    _assert_stop_parity(a_o, h_o, a_f, h_f, a_s,
+                        "Stopping: simulation budget exhausted")
+    # exact boundary: stop at gen 3 itself, not one early / one late
+    assert h_o.max_t == 3
+    assert h_s.max_t == 3
+
+
+def test_onedispatch_stop_parity_temperature():
+    """The stochastic triple's temperature hitting exactly 1 stops the
+    run with the same string on all three engines."""
+    import jax
+
+    def build(run_mode, fuse):
+        def model(key, theta):
+            return {"y": theta[:, 0]
+                    + 0.2 * jax.random.normal(key, theta.shape[:1])}
+
+        abc = pt.ABCSMC(
+            pt.SimpleModel(model),
+            pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+            pt.IndependentNormalKernel(var=0.1 ** 2),
+            population_size=400,
+            eps=pt.Temperature(schemes=[pt.AcceptanceRateScheme()]),
+            acceptor=pt.StochasticAcceptor(
+                pdf_norm_method=pt.pdf_norm_from_kernel),
+            sampler=_pinned(4096), fuse_generations=fuse,
+            run_mode=run_mode, seed=9)
+        abc.new("sqlite://", {"y": 0.5})
+        abc.onedispatch_max_t = 16
+        return abc
+
+    a_o = build("onedispatch", 3)
+    h_o = a_o.run(max_nr_populations=7)
+    a_f = build(None, 3)
+    h_f = a_f.run(max_nr_populations=7)
+    a_s = build(None, 1)
+    a_s.run(max_nr_populations=7)
+    _assert_stop_parity(a_o, h_o, a_f, h_f, a_s,
+                        "Stopping: temperature reached 1", n_models=1)
+    assert h_o.max_t < 6
+
+
+def test_onedispatch_stop_parity_single_model_alive():
+    """Model selection where the far model CANNOT reach the observed
+    data (noiseless, minimum distance 0.1): median-epsilon annealing
+    kills it deterministically, and the single-model-alive stop fires
+    identically on every engine."""
+    def build(run_mode, fuse):
+        def mk(shift):
+            def fn(key, theta):
+                return {"y": theta[:, 0] + shift}
+            return fn
+
+        models = [pt.SimpleModel(mk(0.0), name="near"),
+                  pt.SimpleModel(mk(1.6), name="far")]
+        priors = [pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0))
+                  for _ in range(2)]
+        abc = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
+                        population_size=300, sampler=_pinned(4096),
+                        fuse_generations=fuse, run_mode=run_mode,
+                        seed=0, stop_if_only_single_model_alive=True)
+        abc.new("sqlite://", {"y": 0.5})
+        abc.onedispatch_max_t = 16
+        return abc
+
+    a_o = build("onedispatch", 3)
+    h_o = a_o.run(max_nr_populations=14)
+    a_f = build(None, 3)
+    h_f = a_f.run(max_nr_populations=14)
+    a_s = build(None, 1)
+    a_s.run(max_nr_populations=14)
+    _assert_stop_parity(a_o, h_o, a_f, h_f, a_s,
+                        "Stopping: single model alive")
+    assert h_o.max_t < 13

@@ -53,6 +53,14 @@ WATCHED = (
     ("northstar_pop1e6_accepted_per_sec", "higher", 0.18),
     ("northstar_pop1e6_wallclock_s_per_gen", "lower", 0.25),
     ("fused_northstar_s_per_gen", "lower", 0.25),
+    # one-dispatch whole runs (smc.py _run_onedispatch): the entire
+    # post-calibration run must stay ONE device dispatch — any second
+    # dispatch means the device-side stop chain degraded back to
+    # per-block host control, so fail high with zero tolerance
+    ("onedispatch_pop1e6_dispatches_per_run", "lower", 0.0),
+    # ... and the residual control plane (one O(scalar) packet fetch
+    # amortized over the run) staying cheap is the point of the row
+    ("onedispatch_pop1e6_control_roundtrip_s_per_gen", "lower", 0.50),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

@@ -7,3 +7,7 @@ class ABCSMC:
 
     def _fused_eligible(self, n):
         return n >= self.PROBE_MIN_POP
+
+    def _onedispatch_eligible(self):
+        return (getattr(self.eps, "device_stop_ok", False)
+                and self._device_chain_eligible())

@@ -67,6 +67,11 @@ MANIFEST = {
                          ("SITE_MATERIALIZE", "shared_policy().call(")),
     "SITE_JOURNAL": ("resilience/journal.py",
                      ("SITE_JOURNAL", "shared_policy().call(")),
+    # the one-dispatch egress drain's boundary: a drain failure latches
+    # the degradation flag and the run resumes on the per-block paths
+    # from the last durably-appended generation
+    "SITE_DRAIN": ("smc.py",
+                   ("SITE_DRAIN", "_fault_onedispatch_off")),
 }
 
 _CONST_RE = re.compile(r'^(SITE_[A-Z_]+)\s*=\s*"([^"]+)"', re.M)

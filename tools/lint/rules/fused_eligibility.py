@@ -19,7 +19,9 @@ Checks:
 - ``ABCSMC._device_chain_eligible``'s body consults every flag;
 - ``ABCSMC._fused_eligible`` consults the named ``PROBE_MIN_POP``
   threshold, and neither body re-hardcodes the retired ``1 << 17``
-  population cutoff.
+  population cutoff;
+- ``ABCSMC._onedispatch_eligible`` consults the ``device_stop_ok``
+  capability flag (the device-side stop chain's extra gate).
 
 Legacy suppression: ``# eligibility-ok`` inside the function body;
 ``# graftlint: allow(fused-eligibility)`` also works on line-anchored
@@ -43,11 +45,21 @@ FLAG_OWNERS = {
     "device_solve_ok": "epsilon/temperature.py",
     "device_refit_ok": "distance/distance.py",
     "device_support_ok": "transition/base.py",
+    "device_stop_ok": "epsilon/base.py",
 }
+
+#: flags the fused-chain body itself must consult; ``device_stop_ok``
+#: is the one-dispatch path's EXTRA gate, consulted by
+#: ``ONEDISPATCH_FN`` instead of the shared chain check
+CHAIN_FLAGS = ("device_accept_ok", "device_schedule_ok",
+               "device_solve_ok", "device_refit_ok",
+               "device_support_ok")
 
 SMC_FILE = "smc.py"
 CHAIN_FN = "_device_chain_eligible"
 FUSED_FN = "_fused_eligible"
+ONEDISPATCH_FN = "_onedispatch_eligible"
+STOP_FLAG = "device_stop_ok"
 PROBE_ATTR = "PROBE_MIN_POP"
 RETIRED_LITERAL = "1 << 17"
 
@@ -99,7 +111,7 @@ def check(root: str = None) -> list:
                                f"{CHAIN_FN}() not found"))
         else:
             if SUPPRESS not in chain_src:
-                for flag in FLAG_OWNERS:
+                for flag in CHAIN_FLAGS:
                     if flag not in chain_src:
                         violations.append((
                             SMC_FILE, chain_line,
@@ -125,6 +137,22 @@ def check(root: str = None) -> list:
                     SMC_FILE, fused_line,
                     f"{FUSED_FN}() hardcodes {RETIRED_LITERAL!r}; use "
                     f"the named {PROBE_ATTR} attribute"))
+        one_src, one_line = _function_segment(text, ONEDISPATCH_FN)
+        if one_src is None:
+            violations.append((SMC_FILE, 0,
+                               f"{ONEDISPATCH_FN}() not found"))
+        elif SUPPRESS not in one_src:
+            if STOP_FLAG not in one_src:
+                violations.append((
+                    SMC_FILE, one_line,
+                    f"{ONEDISPATCH_FN}() no longer consults "
+                    f"{STOP_FLAG!r} (the device-side stop gate)"))
+            if RETIRED_LITERAL in one_src:
+                violations.append((
+                    SMC_FILE, one_line,
+                    f"{ONEDISPATCH_FN}() hardcodes "
+                    f"{RETIRED_LITERAL!r}; use the named {PROBE_ATTR} "
+                    f"attribute"))
     return violations
 
 
