@@ -477,6 +477,92 @@ def bench_onedispatch():
     }
 
 
+def bench_kernel():
+    """Speed-of-light kernel row (docs/performance.md "Speed of
+    light"): the north-star one-dispatch run with the in-scan kernel
+    cuts enabled — sketch-annealed eps (``device_sketch=True``),
+    donated carries (default on), bf16 KDE/distance lanes — so
+    ``onedispatch_pop1e6_s_per_gen`` prices the fastest supported
+    configuration.  The bench sentinel watches that key at ZERO slack:
+    this row may only ever get faster.  Companions:
+    ``onedispatch_pop1e6_eps_sketch_err`` (realized |sketch − exact|
+    median on the run's own final weighted distances — must sit inside
+    ``sketch_error_bound``) and ``onedispatch_pop1e6_hbm_carry_mb``
+    (the carry footprint donation keeps single-buffered).  Runs AFTER
+    the plain onedispatch row and overrides its ``s_per_gen`` on the
+    compact line on purpose: the headline number is the tuned kernel;
+    the plain row's other keys (dispatch count, control plane) are
+    config-invariant."""
+    import jax.numpy as jnp
+
+    import pyabc_tpu as pt
+    from pyabc_tpu import weighted_statistics as ws
+    from pyabc_tpu.autotune import compile_counters, compile_delta
+    from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.ops import precision as _precision
+    from pyabc_tpu.ops.quantile_sketch import (sketch_error_bound,
+                                               sketch_weighted_quantile)
+
+    # per-component precision policy: bf16 MXU lanes, f32 accumulators
+    # (docs/performance.md precision table); set before the first trace
+    # — the sub-bench runs in its own process, so nothing else sees it
+    os.environ[_precision.PRECISION_ENV] = "bf16"
+    _precision._reset_for_testing()
+
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(
+        models, priors, distance,
+        population_size=NORTHSTAR_POP,
+        # annealing quantile schedule THROUGH the device sketch: the
+        # in-scan eps update is the sort-free histogram kernel
+        eps=pt.MedianEpsilon(device_sketch=True),
+        sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                     max_rounds_per_call=16),
+        stores_sum_stats=False,
+        fuse_generations=4,
+        run_mode="onedispatch",
+        seed=0)
+    abc.new("sqlite://", observed)
+    cc0 = compile_counters()
+    t0 = time.perf_counter()
+    abc.run(max_nr_populations=1 + ONEDISPATCH_GENS)
+    wall = time.perf_counter() - t0
+    cc = compile_delta(cc0)
+    gens = sum(1 for r in abc.timeline.to_rows()
+               if r.get("path") == "onedispatch")
+    spg = (max(wall - cc["compile_s"], 0.0) / gens) if gens else None
+    out = {
+        "onedispatch_pop1e6_s_per_gen": (None if spg is None
+                                         else round(spg, 2)),
+        "kernel_onedispatch_generations": gens,
+        "kernel_precision_lanes": "bf16",
+        "kernel_compile_s": round(cc["compile_s"], 2),
+    }
+    carry = getattr(abc, "_fused_carry", None)
+    if carry:
+        # donated-carry HBM footprint: host-side sum over the avals —
+        # the bytes the in-place update keeps single- (not double-)
+        # buffered at the dispatch boundary
+        hbm = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                  for v in carry.values() if hasattr(v, "dtype")) / 1e6
+        out["onedispatch_pop1e6_hbm_carry_mb"] = round(hbm, 1)
+        # realized sketch error on the final weighted distance sample
+        d = jnp.asarray(carry["distance"], jnp.float32)
+        w = jnp.exp(jnp.asarray(carry["log_weight"], jnp.float32))
+        exact = float(ws.weighted_quantile(
+            np.asarray(d), np.asarray(w), 0.5))
+        sk = float(sketch_weighted_quantile(d, w, 0.5))
+        out["onedispatch_pop1e6_eps_sketch_err"] = round(
+            abs(sk - exact), 6)
+        finite = np.asarray(jnp.isfinite(d))
+        if finite.any():
+            d_ok = np.asarray(d)[finite]
+            out["kernel_eps_sketch_bound"] = round(float(
+                sketch_error_bound(float(d_ok.min()),
+                                   float(d_ok.max()))), 6)
+    return out
+
+
 def bench_kde_1e6():
     """Standalone 1e6-query × 1e6-support streamed weighted-KDE log-pdf
     (the SURVEY.md §7 '1e6 × 1e6 KDE' hard part)."""
@@ -548,8 +634,9 @@ def _bench_problem(make_problem, pop, prefix):
 
 
 SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
-               "posterior_gate", "lotka_volterra", "sir", "petab_ode",
-               "sharded_mesh1", "ab_vec_sharded", "sharded_cpu8")
+               "kernel", "posterior_gate", "lotka_volterra", "sir",
+               "petab_ode", "sharded_mesh1", "ab_vec_sharded",
+               "sharded_cpu8")
 
 
 def bench_ab_vec_vs_sharded():
@@ -653,6 +740,8 @@ def _run_sub(name: str) -> dict:
         return bench_fused_northstar()
     if name == "onedispatch":
         return bench_onedispatch()
+    if name == "kernel":
+        return bench_kernel()
     if name == "posterior_gate":
         # the 1e6 adaptive posterior-exactness gate (BASELINE.md
         # "Correctness at scale", now repeatable): perf work cannot
@@ -767,7 +856,8 @@ def main():
     compact = {k: v for k, v in sorted(extra.items())
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
-                                "onedispatch_", "posterior_gate_",
+                                "onedispatch_", "kernel_",
+                                "posterior_gate_",
                                 "telemetry_", "resilience_",
                                 "checkpoint_", "store_", "lint_"))
                and not isinstance(v, (list, dict))}

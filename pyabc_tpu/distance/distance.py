@@ -27,6 +27,7 @@ import numpy as np
 
 from .base import Distance, to_distance
 from .scale import SCALE_FUNCTIONS, median_absolute_deviation
+from ..ops import precision as _precision
 
 #: jitted scale functions, weakly cached by function identity: the scale
 #: math is a chain of reductions whose EAGER per-op dispatches each pay
@@ -138,10 +139,17 @@ class PNormDistance(Distance):
     # -- pure kernel ------------------------------------------------------
 
     def compute(self, stats: Array, obs: Array, params) -> Array:
+        # residual in f32 (the subtract is cancellation-sensitive); the
+        # opt-in bf16 lane (ops/precision.py, PYABC_TPU_PRECISION_LANES)
+        # rounds the weighted residual to bf16 — relative error 2^-8,
+        # half the VPU bytes through the norm — and accumulates in f32
         diff = jnp.abs(params["w"] * (stats - obs))
+        if _precision.lanes("distance") == "bf16":
+            diff = diff.astype(jnp.bfloat16)
         if np.isinf(self.p):
-            return jnp.max(diff, axis=-1)
-        return jnp.sum(diff**self.p, axis=-1) ** (1.0 / self.p)
+            return jnp.max(diff, axis=-1).astype(jnp.float32)
+        acc = jnp.sum(diff.astype(jnp.float32) ** self.p, axis=-1)
+        return acc ** (1.0 / self.p)
 
     def get_config(self):
         return {"name": type(self).__name__, "p": self.p}

@@ -38,6 +38,17 @@ class Epsilon:
     #: could stop a device-driven run a generation late.
     device_stop_ok = False
 
+    #: sketch-eps capability flag: True when the schedule consents to
+    #: its in-scan device update running on the SORT-FREE streaming
+    #: quantile sketch (``ops.quantile_sketch``) instead of the exact
+    #: argsort — a bounded approximation (~1e-6 of the distance range),
+    #: NOT bit-identical, so it is a per-instance opt-in
+    #: (``QuantileEpsilon(device_sketch=True)``), never a default.
+    #: Schedules whose device update involves no sort (a constant, the
+    #: bisection temperature solve) may report True vacuously — the
+    #: flag then changes nothing in the trace.
+    device_sketch_ok = False
+
     def initialize(self, t: int,
                    get_weighted_distances: Optional[Callable] = None,
                    get_all_records: Optional[Callable] = None,

@@ -17,6 +17,9 @@ class ConstantEpsilon(Epsilon):
     device_schedule_ok = True
     #: ... and its stop comparison is a pure f32 compare on device
     device_stop_ok = True
+    #: vacuously sketch-safe: a constant's device update sorts nothing,
+    #: so opting in changes no op in the trace
+    device_sketch_ok = True
 
     def __init__(self, constant_epsilon_value: float):
         self.constant_epsilon_value = float(constant_epsilon_value)
@@ -60,13 +63,20 @@ class QuantileEpsilon(Epsilon):
 
     def __init__(self, initial_epsilon: str = "from_sample",
                  alpha: float = 0.5, quantile_multiplier: float = 1.0,
-                 weighted: bool = True):
+                 weighted: bool = True, device_sketch: bool = False):
         if not 0 < alpha <= 1:
             raise ValueError("alpha must be in (0, 1]")
         self.alpha = float(alpha)
         self.initial_epsilon = initial_epsilon
         self.quantile_multiplier = float(quantile_multiplier)
         self.weighted = weighted
+        #: per-instance opt-in (``device_sketch=True``): the fused/
+        #: onedispatch in-scan quantile runs on the sort-free histogram
+        #: sketch instead of the exact argsort — faster at large B,
+        #: approximate within ``ops.quantile_sketch.sketch_error_bound``
+        #: (posterior parity gated by tests/test_posterior_gate.py);
+        #: host-side ``_update`` always stays exact
+        self.device_sketch_ok = bool(device_sketch)
         self._look_up: dict = {}
 
     def requires_calibration(self) -> bool:
@@ -109,7 +119,8 @@ class MedianEpsilon(QuantileEpsilon):
     """α = 0.5 quantile — the reference default (epsilon.py:231-243)."""
 
     def __init__(self, initial_epsilon="from_sample",
-                 median_multiplier: float = 1.0, weighted: bool = True):
+                 median_multiplier: float = 1.0, weighted: bool = True,
+                 device_sketch: bool = False):
         super().__init__(initial_epsilon=initial_epsilon, alpha=0.5,
                          quantile_multiplier=median_multiplier,
-                         weighted=weighted)
+                         weighted=weighted, device_sketch=device_sketch)

@@ -188,6 +188,13 @@ class Temperature(TemperatureBase):
         # solve runs on device
         return self.device_solve_ok
 
+    @property
+    def device_sketch_ok(self) -> bool:
+        # vacuously true whenever the solve runs on device: the
+        # acceptance-rate solve is a sort-free bisection already, so
+        # the sketch flag adds no op to its trace
+        return self.device_solve_ok
+
     def get_config(self):
         return {"name": type(self).__name__,
                 "schemes": [type(s).__name__ for s in self.schemes]}

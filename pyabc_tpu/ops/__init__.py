@@ -1,7 +1,12 @@
 """Hot-op kernels (MXU-native formulations; pallas variants live here)."""
 
-from .choice import fast_weighted_choice
+from .choice import (fast_weighted_choice, residual_weighted_choice,
+                     systematic_weighted_choice)
 from .kde import weighted_kde_logpdf, weighted_kde_logpdf_auto
+from .quantile_sketch import (sketch_error_bound, sketch_topk_mask,
+                              sketch_weighted_quantile)
 
 __all__ = ["weighted_kde_logpdf", "weighted_kde_logpdf_auto",
-           "fast_weighted_choice"]
+           "fast_weighted_choice", "systematic_weighted_choice",
+           "residual_weighted_choice", "sketch_weighted_quantile",
+           "sketch_topk_mask", "sketch_error_bound"]

@@ -106,3 +106,26 @@ def systematic_weighted_choice(key, log_w: Array, n: int) -> Array:
     u0 = jax.random.uniform(key, (), dtype=cdf.dtype)
     u = (u0 + jnp.arange(n, dtype=cdf.dtype)) / n * cdf[-1]
     return _invert_cdf(cdf, _cap_draws(cdf, u))
+
+
+def residual_weighted_choice(log_w: Array, n: int,
+                             rank_cap: int = None) -> Array:
+    """Deterministic residual resampling: ``n`` indices ∝ ``exp(log_w)``
+    with zero sampling noise — ⌊n·w⌋ copies each, the remaining slots to
+    the largest remainders.
+
+    The residual *ranking* is the interesting part at scale: below
+    ``rank_cap`` support points it is an exact ``argsort(-residual)``;
+    above, it routes through the sort-free top-k sketch
+    (``ops.quantile_sketch.sketch_topk_mask``) — same counts except for
+    residuals within the sketch resolution (~1e-6) of the cut, and the
+    sub-cap program stays byte-identical because the cap check is a
+    static shape test (``weighted_statistics.
+    resample_indices_deterministic``, which owns the cap default).
+    """
+    from ..weighted_statistics import (RESIDUAL_RANK_CAP,
+                                       resample_indices_deterministic)
+    w = jax.nn.softmax(log_w)
+    if rank_cap is None:
+        rank_cap = RESIDUAL_RANK_CAP
+    return resample_indices_deterministic(w, n, rank_cap=rank_cap)

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.scipy.linalg import solve_triangular
 
+from .precision import bf16x3_matmul, lanes as _policy_lanes
+
 Array = jnp.ndarray
 
 #: default block sizes: queries per outer chunk, support per streamed block
@@ -42,15 +44,22 @@ def _pad_rows(a: Array, to: int, fill: float = 0.0) -> Array:
     return jnp.pad(a, cfg, constant_values=fill)
 
 
-@partial(jax.jit, static_argnames=("query_block", "support_block"))
+@partial(jax.jit,
+         static_argnames=("query_block", "support_block", "lanes"))
 def weighted_kde_logpdf(x: Array, support: Array, log_w: Array, chol: Array,
                         log_norm: Array,
                         query_block: int = QUERY_BLOCK,
-                        support_block: int = SUPPORT_BLOCK) -> Array:
+                        support_block: int = SUPPORT_BLOCK,
+                        lanes: str = "f32") -> Array:
     """log Σ_j exp(log_w_j) N(x_i; X_j, Σ) for all i — streamed.
 
     x: [M, D]; support: [N, D]; log_w: [N]; chol: [D, D] (lower);
     log_norm: scalar −D/2·log 2π − Σ log L_dd.
+
+    ``lanes``: "f32" runs the cross product at ``Precision.HIGHEST``;
+    "bf16" runs it as the three-pass ``reduce_precision`` split with
+    f32 accumulation (ops/precision.py ``bf16x3_matmul``) — ~2x the
+    MXU rate, logit error ~2^-20 instead of bf16's O(0.1).
     """
     m, d = x.shape
     n = support.shape[0]
@@ -59,8 +68,11 @@ def weighted_kde_logpdf(x: Array, support: Array, log_w: Array, chol: Array,
     # f32 cancellation in the maha = |z_x|² − 2 z_x·z_s + |z_s|² expansion),
     # then whiten once: z = L^{-1} v  (maha = |z_x - z_s|²)
     # WEIGHTED center: zero-mass (padded) support rows then cannot
-    # shift the whitening origin, so padding is exactly neutral
-    center = jax.nn.softmax(log_w) @ support
+    # shift the whitening origin, so padding is exactly neutral.  The
+    # [N] @ [N, D] contraction is tiny but feeds every z — keep it f32
+    # regardless of the lane policy
+    center = jnp.matmul(jax.nn.softmax(log_w), support,
+                        precision=lax.Precision.HIGHEST)
     z_x = solve_triangular(chol, (x - center).T, lower=True).T        # [M, D]
     z_s = solve_triangular(chol, (support - center).T, lower=True).T  # [N, D]
     sq_x = jnp.sum(z_x**2, axis=-1)                            # [M]
@@ -86,8 +98,14 @@ def weighted_kde_logpdf(x: Array, support: Array, log_w: Array, chol: Array,
             # default lets XLA run this in bf16, which injects O(0.1)
             # absolute error into the Mahalanobis exponent (measured);
             # f32 MXU passes cost ~2x bf16 but the exponent needs them.
-            comp = ab[None, :] + jnp.matmul(
-                zq, zb.T, precision=lax.Precision.HIGHEST)      # [Q, K]
+            # The opt-in bf16 lane recovers most of the bf16 rate via the
+            # three-pass split (products still accumulate in f32).
+            if lanes == "bf16":
+                cross = bf16x3_matmul(zq, zb.T)                 # [Q, K]
+            else:
+                cross = jnp.matmul(
+                    zq, zb.T, precision=lax.Precision.HIGHEST)
+            comp = ab[None, :] + cross                          # [Q, K]
             blk_max = jnp.max(comp, axis=-1)
             new_mx = jnp.maximum(mx, blk_max)
             scale = jnp.exp(mx - new_mx)
@@ -137,7 +155,9 @@ def weighted_kde_logpdf_auto(x: Array, support: Array, log_w: Array,
     if pallas_available() and (d >= 2 or n <= (1 << 17)):
         # query_block intentionally not forwarded: the Pallas kernel's
         # blocks are fixed by its VMEM budget, and its memory does not
-        # grow with the caller's chunking choice
+        # grow with the caller's chunking choice.  (The kernel is the
+        # bf16x3 split already — the lane policy has nothing to add.)
         return weighted_kde_logpdf_pallas(x, support, log_w, chol, log_norm)
     return weighted_kde_logpdf(x, support, log_w, chol, log_norm,
-                               query_block=query_block)
+                               query_block=query_block,
+                               lanes=_policy_lanes("kde"))

@@ -87,8 +87,10 @@ def weighted_kde_logpdf_pallas(x: Array, support: Array, log_w: Array,
     n = support.shape[0]
 
     # WEIGHTED center: zero-mass (padded) support rows then cannot
-    # shift the whitening origin, so padding is exactly neutral
-    center = jax.nn.softmax(log_w) @ support
+    # shift the whitening origin, so padding is exactly neutral; the
+    # tiny [N] @ [N, D] contraction feeds every z — keep it f32
+    center = jnp.matmul(jax.nn.softmax(log_w), support,
+                        precision=jax.lax.Precision.HIGHEST)
     z_x = solve_triangular(chol, (x - center).T, lower=True).T
     z_s = solve_triangular(chol, (support - center).T, lower=True).T
     a_x = 0.5 * jnp.sum(z_x * z_x, axis=-1)                # [M]

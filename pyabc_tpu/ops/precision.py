@@ -1,0 +1,108 @@
+"""Mixed-precision lane policy for the hot compute paths.
+
+The TPU's MXU runs bf16 passes at ~2x the f32 rate and the VPU moves
+half the bytes per element, but ABC acceptance is a THRESHOLD test —
+a distance that lands on the wrong side of eps flips a particle.  So
+precision is a per-component POLICY, never a global cast:
+
+- ``kde``      — the transition-density cross product (``ops/kde.py``).
+                 bf16 lane = the three-pass ``reduce_precision`` split
+                 matmul (``bf16x3_matmul``), the same decomposition the
+                 Pallas kernel uses (ops/kde_pallas.py): products carry
+                 ~f32 mantissa into f32 accumulators, so the logit error
+                 stays ~2^-20 of the exponent instead of the O(0.1)
+                 single-pass bf16 injects.
+- ``distance`` — the p-norm sum-stat evaluation (``distance/``).  bf16
+                 lane rounds the weighted residuals to bf16 (relative
+                 error 2^-8) and accumulates the norm in f32.
+
+Policy comes from ``PYABC_TPU_PRECISION_LANES``:
+
+- ``f32`` (default) — every component exact; fused/onedispatch traces
+  are bit-identical to the pre-policy programs.
+- ``bf16``          — every component takes its bf16 lane.
+- per-component, comma-separated: ``kde=bf16,distance=f32``.
+
+The policy is resolved ONCE per process (first use) and frozen: the
+lanes are baked into jitted programs whose cache keys do not carry the
+env, so a mid-run flip could serve stale traces.  Set the variable
+before constructing the run.  Posterior equivalence of the bf16 lanes
+is gated by tests/test_posterior_gate.py (slow battery).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+PRECISION_ENV = "PYABC_TPU_PRECISION_LANES"
+
+#: components a policy may address
+COMPONENTS = ("kde", "distance")
+_MODES = ("f32", "bf16")
+
+
+@lru_cache(maxsize=None)
+def _resolve() -> dict:
+    raw = os.environ.get(PRECISION_ENV, "f32").strip().lower()
+    if raw in _MODES:
+        return {c: raw for c in COMPONENTS}
+    policy = {c: "f32" for c in COMPONENTS}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, mode = part.partition("=")
+        key, mode = key.strip(), mode.strip()
+        if not sep or key not in COMPONENTS or mode not in _MODES:
+            raise ValueError(
+                f"{PRECISION_ENV}={raw!r}: expected 'f32', 'bf16', or "
+                f"comma-separated component=mode pairs with components "
+                f"in {COMPONENTS} and modes in {_MODES}")
+        policy[key] = mode
+    return policy
+
+
+def lanes(component: str) -> str:
+    """The frozen precision mode ('f32' | 'bf16') for ``component``."""
+    if component not in COMPONENTS:
+        raise ValueError(f"unknown precision component {component!r}; "
+                         f"expected one of {COMPONENTS}")
+    return _resolve()[component]
+
+
+def _reset_for_testing():
+    """Drop the frozen policy so tests can exercise both lanes."""
+    _resolve.cache_clear()
+
+
+def split_bf16(a):
+    """High/low bf16 split of an f32 array: ``hi + lo == a`` to ~2^-20.
+
+    The rounding must be ``jax.lax.reduce_precision``, NOT a bf16 cast
+    round-trip — under ``--xla_allow_excess_precision`` (set on this
+    TPU stack) XLA folds ``convert(convert(x, bf16), f32)`` back to
+    ``x``, which silently zeroes the low parts and degrades a split
+    product to single-pass bf16.
+    """
+    hi = jax.lax.reduce_precision(a, exponent_bits=8, mantissa_bits=7)
+    return hi.astype(jnp.bfloat16), (a - hi).astype(jnp.bfloat16)
+
+
+def bf16x3_matmul(a, b):
+    """``a @ b`` as three bf16 MXU passes with f32 accumulation.
+
+    ``(ah+al)(bh+bl) ~= ah·bh + ah·bl + al·bh`` — the dropped ``al·bl``
+    term is O(2^-16) relative, so the result tracks the f32 product to
+    ~2^-20 while each pass runs at the MXU's bf16 rate (the XLA-path
+    generalization of the ops/kde_pallas.py kernel's split).
+    """
+    ah, al = split_bf16(a)
+    bh, bl = split_bf16(b)
+    f32 = jnp.float32
+    return (jnp.matmul(ah, bh, preferred_element_type=f32)
+            + jnp.matmul(ah, bl, preferred_element_type=f32)
+            + jnp.matmul(al, bh, preferred_element_type=f32))

@@ -225,12 +225,22 @@ def _refit_model(theta, log_w, valid, m_col, j, dim_j, n_target,
     return params, resolved
 
 
-def _weighted_quantile_device(x, w, valid, alpha):
+def _weighted_quantile_device(x, w, valid, alpha, sketch=False):
     """``weighted_statistics.weighted_quantile`` on masked device rows:
-    invalid rows sort to +inf with zero weight."""
+    invalid rows sort to +inf with zero weight.
+
+    ``sketch=True`` (the ``device_sketch_ok`` opt-in threaded down from
+    the epsilon schedule) swaps the O(B log B) in-scan argsort for the
+    sort-free histogram sketch — same masking semantics, within
+    ``ops.quantile_sketch.sketch_error_bound`` of the inverse CDF.  The
+    default stays the exact sort: it is the bit-identity baseline and
+    the sketch's correctness oracle."""
+    if sketch:
+        from ..ops.quantile_sketch import sketch_weighted_quantile
+        return sketch_weighted_quantile(x, w, alpha, valid=valid)
     xs = jnp.where(valid, x, jnp.inf)
     ws = jnp.where(valid, w, 0.0)
-    order = jnp.argsort(xs)
+    order = jnp.argsort(xs)  # graftlint: allow(sort-discipline)
     pts = xs[order]
     w_s = ws[order] / jnp.maximum(jnp.sum(ws), 1e-38)
     cum = jnp.cumsum(w_s)
@@ -259,7 +269,8 @@ def _build_one_gen(
         rate_pred_factor: float = 1.0,
         adaptive_cfg: Optional[dict] = None,
         stoch_cfg: Optional[dict] = None,
-        summary_lanes: bool = False):
+        summary_lanes: bool = False,
+        eps_sketch: bool = False):
     """Shared per-generation body behind :func:`build_fused_generations`
     (which scans it K times) and :func:`build_onedispatch_run` (which
     wraps those scans in a device-side stopping ``while_loop``).
@@ -349,7 +360,8 @@ def _build_one_gen(
             # QuantileEpsilon._update semantics
             qw = w if eps_weighted else jnp.where(valid0, 1.0, 0.0)
             eps_t = (_weighted_quantile_device(dist0, qw, valid0,
-                                               eps_alpha)
+                                               eps_alpha,
+                                               sketch=eps_sketch)
                      * eps_multiplier)
         else:  # "temperature": in-scan acceptance-rate solve
             from ..epsilon.temperature import acceptance_rate_solve_trace
@@ -587,7 +599,8 @@ def build_fused_generations(
         rate_pred_factor: float = 1.0,
         adaptive_cfg: Optional[dict] = None,
         stoch_cfg: Optional[dict] = None,
-        summary_lanes: bool = False):
+        summary_lanes: bool = False,
+        eps_sketch: bool = False):
     """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
     for K generations.  ``carry`` = the previous generation's accepted
     population on device: dict(m[i32 n], theta[f32 n,d], log_weight
@@ -636,7 +649,8 @@ def build_fused_generations(
         eps_weighted, distance_params, wire_stats, wire_m_bits,
         raw_round, support_cap=support_cap,
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
-        stoch_cfg=stoch_cfg, summary_lanes=summary_lanes)
+        stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
+        eps_sketch=eps_sketch)
     stoch = stoch_cfg is not None
 
     def one_generation(carry, xs):
@@ -679,7 +693,8 @@ def build_onedispatch_run(
         rate_pred_factor: float = 1.0,
         adaptive_cfg: Optional[dict] = None,
         stoch_cfg: Optional[dict] = None,
-        summary_lanes: bool = False):
+        summary_lanes: bool = False,
+        eps_sketch: bool = False):
     """Whole-run driver with DEVICE-side stopping: a ``lax.while_loop``
     over K-generation ``lax.scan`` blocks of the same per-generation
     body as :func:`build_fused_generations`, whose predicate evaluates
@@ -721,7 +736,8 @@ def build_onedispatch_run(
         eps_weighted, distance_params, wire_stats, wire_m_bits,
         raw_round, support_cap=support_cap,
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
-        stoch_cfg=stoch_cfg, summary_lanes=summary_lanes)
+        stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
+        eps_sketch=eps_sketch)
     M = kernel.M
     stoch = stoch_cfg is not None
     temperature = eps_mode == "temperature"

@@ -28,6 +28,7 @@ from .autotune import (compile_counters as _compile_counters,
                        compile_delta as _compile_delta,
                        configure_compile_cache, install_compile_listener,
                        jit_compile)
+from .autotune import occupancy as _occupancy
 from .distance import Distance, PNormDistance, StochasticKernel, to_distance
 from .epsilon import Epsilon, MedianEpsilon, TemperatureBase
 from .model import Model, SimpleModel
@@ -249,6 +250,29 @@ class ABCSMC:
         #: $PYABC_TPU_ONEDISPATCH_MAX_T (default 32).
         self.onedispatch_max_t = max(1, int(os.environ.get(
             "PYABC_TPU_ONEDISPATCH_MAX_T", "32")))
+        #: donated carry layout: the fused-block and one-dispatch
+        #: programs take their population carry with
+        #: ``donate_argnums=(0,)``, so the cap-sized buffers update in
+        #: place instead of round-tripping HBM every block.  The carry
+        #: is the ONLY donated operand — the PRNG key and the ctl packet
+        #: are threaded back to the host and must survive the call.
+        #: Consumers always read the returned ``carry_out`` (never the
+        #: input), and a dispatch that fails mid-attempt surfaces as a
+        #: fatal donated-buffer error that the retry policy degrades to
+        #: the sequential path (resilience/retry.py).  On CPU, XLA
+        #: ignores donation (correctness unchanged).  Opt out with
+        #: $PYABC_TPU_DONATE_CARRY=0.
+        self._donate_carry = os.environ.get(
+            "PYABC_TPU_DONATE_CARRY", "1") not in ("0", "false", "no")
+        #: joint (K, max_T, rung) occupancy tuning for fused blocks
+        #: (autotune/occupancy.py).  Opt-in: changing K mid-run changes
+        #: the device key-split stream, so the default stays the static
+        #: shape for bit-reproducibility.
+        self._occupancy = None
+        if os.environ.get(_occupancy.JOINT_AUTOTUNE_ENV,
+                          "0") in ("1", "true", "yes"):
+            self._occupancy = _occupancy.OccupancyTuner(
+                k_max=max(self.fuse_generations, 1))
         #: dispatches issued by the current run() — the one-dispatch
         #: acceptance row asserts this stays 1 for a whole device-side-
         #: stopped run
@@ -775,22 +799,36 @@ class ABCSMC:
         return self.population_strategy(0) >= self.OVERLAP_MIN_POP
 
     def _eps_device_config(self):
-        """(mode, alpha, multiplier, weighted) for the device-side eps
-        schedule of a generation block."""
+        """(mode, alpha, multiplier, weighted, sketch) for the
+        device-side eps schedule of a generation block.  ``sketch`` is
+        the schedule's ``device_sketch_ok`` opt-in: True routes the
+        in-scan quantile through the sort-free histogram sketch
+        (``ops.quantile_sketch``); only the quantile mode has a sort to
+        replace, so the flag is forced False elsewhere to keep cache
+        keys canonical."""
         from .epsilon.epsilon import ConstantEpsilon
         if isinstance(self.eps, ConstantEpsilon):
-            return "constant", 0.5, 1.0, True
+            return "constant", 0.5, 1.0, True, False
         if isinstance(self.eps, TemperatureBase):
             # the in-scan acceptance-rate solve replaces the quantile
             # schedule; alpha/multiplier/weighted are unused
-            return "temperature", 0.5, 1.0, True
+            return "temperature", 0.5, 1.0, True, False
         return ("quantile", self.eps.alpha, self.eps.quantile_multiplier,
-                self.eps.weighted)
+                self.eps.weighted,
+                bool(getattr(self.eps, "device_sketch_ok", False)))
 
     def _block_mode(self) -> dict:
         """Which in-scan adaptation chains a device block must carry."""
         return {"adaptive": self._distance_is_adaptive(),
                 "stoch": isinstance(self.acceptor, StochasticAcceptor)}
+
+    def _donate_jit_kwargs(self) -> dict:
+        """jit kwargs for the block/one-dispatch programs: donate the
+        population carry (operand 0) so its cap-sized buffers update in
+        place.  The PRNG key, ctl packet and final mask are never
+        donated — the host reads them back (onedispatch) or reuses the
+        split chain (fused)."""
+        return {"donate_argnums": (0,)} if self._donate_carry else {}
 
     def _block_record_rows(self, B: int) -> int:
         """Record-ring rows of a stochastic-triple block (<= one round's
@@ -956,20 +994,37 @@ class ABCSMC:
         return fetch
 
     def _get_block_fn(self, t: int, n: int, B: int, K: int,
-                      summary: bool = False):
+                      summary: bool = False, donate: bool = True,
+                      max_rounds: Optional[int] = None):
         """Build (or serve cached) the jitted K-generation device block
         for the current configuration — shared by ``_run_fused_block``
         and the overlapped pipeline (which uses K=1 blocks at
         transfer-bound sizes).  ``summary`` adds the in-scan ``sm_*``
-        posterior-summary wire lanes (lazy-History mode)."""
+        posterior-summary wire lanes (lazy-History mode).
+
+        ``donate=False`` disables carry donation for THIS program: the
+        overlapped pipeline must pass it, because harvest stashes
+        ``blk["carry_out"]`` for LATER host reads (``st["last_dp"]``,
+        the adaptive weight pre-seed) after that same carry may already
+        have been donated into the next speculative dispatch — reading
+        a donated buffer raises.  The classic fused loop reads its
+        carry_out synchronously before the next dispatch, so it keeps
+        donation."""
         from .sampler.fused import build_fused_generations
         samp = self.sampler
         d, s_width = self.dim, self.spec.total_size
         wire_stats = bool(samp.fetch_stats)
         wire_m_bits = self.M <= 2
-        eps_mode, alpha, mult, weighted = self._eps_device_config()
-        max_rounds = self._block_max_rounds(
-            n, B, rate_est=getattr(samp, "_rate_est", None))
+        eps_mode, alpha, mult, weighted, eps_sketch = \
+            self._eps_device_config()
+        eff_donate = self._donate_carry and donate
+        if max_rounds is None:
+            max_rounds = self._block_max_rounds(
+                n, B, rate_est=getattr(samp, "_rate_est", None))
+        else:
+            # joint occupancy tuning (autotune/occupancy.py) chose the
+            # round budget together with (K, B); already in the key
+            max_rounds = int(max_rounds)
         mode = self._block_mode()
         sup_cap = self.fused_support_cap
         record_rows = self._block_record_rows(B) if mode["stoch"] else 0
@@ -984,11 +1039,11 @@ class ABCSMC:
         # samp._uid: the compiled fn closes over the sampler's round
         # builder (for ShardedSampler that bakes in mesh + axis), so a
         # swapped sampler must never be served a stale program
-        cache_key = ("fused2", self._kernel._uid, samp._uid, B,
+        cache_key = ("fused3", self._kernel._uid, samp._uid, B,
                      n, K, d, s_width, eps_mode, alpha, mult, weighted,
-                     wire_stats, wire_m_bits, max_rounds, sup_cap,
-                     mode["adaptive"], mode["stoch"], record_rows,
-                     pdf_norm, bool(summary))
+                     eps_sketch, wire_stats, wire_m_bits, max_rounds,
+                     sup_cap, mode["adaptive"], mode["stoch"],
+                     record_rows, pdf_norm, bool(summary), eff_donate)
 
         def build():
             from .distance.kernel import SCALE_LIN
@@ -1043,7 +1098,8 @@ class ABCSMC:
                 rate_pred_factor=(alpha if eps_mode == "quantile"
                                   else 1.0),
                 adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
-                summary_lanes=bool(summary)))
+                summary_lanes=bool(summary), eps_sketch=eps_sketch),
+                **({"donate_argnums": (0,)} if eff_donate else {}))
 
         # block programs live in the sampler's CompiledLadder (one
         # bounded LRU for every per-generation executable; stale-owner
@@ -1084,6 +1140,16 @@ class ABCSMC:
         if carry["theta"].shape[0] != n:
             return 0, 0, None  # population size changed: sequential
         B = samp.choose_batch(n)
+        occ_max_rounds = None
+        if self._occupancy is not None:
+            # joint shape: K, round budget and rung chosen TOGETHER
+            # from the decay/timing telemetry instead of independently
+            K_j, max_T_j, B_j = self._occupancy.propose(
+                n, max(float(samp._rate_est or 0.0), 1e-6), B,
+                samp._round_to_valid_batch)
+            K = max(1, min(int(K_j), self.fuse_generations))
+            B = int(B_j)
+            occ_max_rounds = int(max_T_j)
         mode = self._block_mode()
         eps_mode = self._eps_device_config()[0]
         carry_in = self._seed_block_carry(
@@ -1092,7 +1158,8 @@ class ABCSMC:
         if carry_in is None:
             return 0, 0, None  # seed can't reproduce the chain state
         lazy = self._lazy_active
-        fn = self._get_block_fn(t, n, B, K, summary=lazy)
+        fn = self._get_block_fn(t, n, B, K, summary=lazy,
+                                max_rounds=occ_max_rounds)
 
         t0_block = _time.perf_counter()
         tr0_block = _transfer.snapshot()
@@ -1106,9 +1173,11 @@ class ABCSMC:
                 carry_out, wires = self._retry.call(
                     fn, _faults.SITE_DISPATCH, *args)
         except _retry.RetryExhausted as err:
-            # the carry is NOT donated, so the inputs survived every
-            # failed attempt — degrade to the per-generation sequential
-            # path for the rest of this run and redo t there
+            # the carry IS donated (in-place buffer update): a failed
+            # attempt may have consumed it, and the retry policy treats
+            # donated-buffer errors as fatal — so land here and degrade
+            # to the per-generation sequential path, which redoes t
+            # from host/History state, not from the dead carry
             logger.warning(
                 "fused block dispatch failed after retries (%s): "
                 "disabling generation fusion for this run", err)
@@ -1240,6 +1309,12 @@ class ABCSMC:
             block_dt = _time.perf_counter() - t0_block
             tr_delta = _transfer.delta(tr0_block)
             cc_delta = _compile_delta(cc0_block)
+            if self._occupancy is not None:
+                # close the joint-shape loop: per-gen rounds feed the
+                # decay estimate, the compile-free wall the rung timing
+                self._occupancy.observe_block(
+                    K, B, [g[3] for g in gen_meta],
+                    max(block_dt - cc_delta["compile_s"], 0.0), written)
             at_scale = n > self.PROBE_MIN_POP
             if at_scale and self._engine_choice is None:
                 # at-scale probe: this block's measured steady-state
@@ -1332,7 +1407,8 @@ class ABCSMC:
         d, s_width = self.dim, self.spec.total_size
         wire_stats = bool(samp.fetch_stats)
         wire_m_bits = self.M <= 2
-        eps_mode, alpha, mult, weighted = self._eps_device_config()
+        eps_mode, alpha, mult, weighted, eps_sketch = \
+            self._eps_device_config()
         max_rounds = self._block_max_rounds(
             n, B, rate_est=getattr(samp, "_rate_est", None))
         mode = self._block_mode()
@@ -1345,12 +1421,13 @@ class ABCSMC:
             norms = self.acceptor.pdf_norms
             pdf_norm = float(norms.get(t, norms[max(norms)]
                                        if norms else 0.0))
-        cache_key = ("onedispatch1", self._kernel._uid, samp._uid, B,
+        cache_key = ("onedispatch2", self._kernel._uid, samp._uid, B,
                      n, K, max_T, d, s_width, eps_mode, alpha, mult,
-                     weighted, wire_stats, wire_m_bits, max_rounds,
-                     sup_cap, mode["adaptive"], mode["stoch"],
-                     record_rows, pdf_norm, single_model_stop,
-                     bool(summary))
+                     weighted, eps_sketch, wire_stats, wire_m_bits,
+                     max_rounds, sup_cap, mode["adaptive"],
+                     mode["stoch"], record_rows, pdf_norm,
+                     single_model_stop, bool(summary),
+                     self._donate_carry)
 
         def build():
             from .autotune.ladder import aot_compile, avals_like
@@ -1399,7 +1476,8 @@ class ABCSMC:
                 rate_pred_factor=(alpha if eps_mode == "quantile"
                                   else 1.0),
                 adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
-                summary_lanes=bool(summary)))
+                summary_lanes=bool(summary), eps_sketch=eps_sketch),
+                **self._donate_jit_kwargs())
             if aot_args is not None:
                 try:
                     fn = aot_compile(fn, *avals_like(aot_args))
@@ -1874,7 +1952,12 @@ class ABCSMC:
                 # (e.g. nothing prepared for t_d): sequential rebuild
                 st["carry"] = None
                 return False
-            fn = self._get_block_fn(t_d, n, B, K, summary=lazy)
+            # donate=False: harvest reads this block's carry_out on the
+            # host (st["last_dp"], the adaptive weight pre-seed) AFTER
+            # the next speculative dispatch may have consumed it — the
+            # pipeline's speculation depth makes donation unsafe here
+            fn = self._get_block_fn(t_d, n, B, K, summary=lazy,
+                                    donate=False)
             args = (carry_in, self._split())
             if mode["stoch"]:
                 args += (self._final_mask(t_d, K),)

@@ -24,19 +24,34 @@ def _xp(*arrays):
     return jnp
 
 
-def weighted_quantile(points: Array, weights: Array = None, alpha: float = 0.5) -> Array:
+def weighted_quantile(points: Array, weights: Array = None, alpha: float = 0.5,
+                      method: str = "exact") -> Array:
     """Weighted ``alpha``-quantile (reference: weighted_statistics.py:27-43).
 
-    Same convention as the reference: linear interpolation of the sorted
-    points at midpoint cumulative weights, ``interp(alpha, cs - w/2, pts)``
-    — works identically under numpy and jnp.
+    ``method="exact"`` (default) is the reference convention: linear
+    interpolation of the sorted points at midpoint cumulative weights,
+    ``interp(alpha, cs - w/2, pts)`` — works identically under numpy and
+    jnp, and is the correctness oracle for the sketch.
+
+    ``method="sketch"`` routes device inputs through the sort-free
+    histogram sketch (:mod:`pyabc_tpu.ops.quantile_sketch`) — O(N)
+    scatter passes instead of an O(N log N) sort, within
+    ``sketch_error_bound`` of the inverse CDF.  Host (numpy) inputs
+    always take the exact path: the control plane calls this once per
+    generation, where a sort is free and exactness is the point.
     """
     xp = _xp(points, weights)
+    if method == "sketch" and xp is jnp:
+        from .ops.quantile_sketch import sketch_weighted_quantile
+        return sketch_weighted_quantile(points, weights, alpha)
+    if method not in ("exact", "sketch"):
+        raise ValueError(f"unknown quantile method {method!r}")
     points = xp.asarray(points)
     if weights is None:
         weights = xp.full(points.shape, 1.0 / points.shape[0])
     weights = weights / xp.sum(weights)
-    order = xp.argsort(points)
+    # exact path: full sort is the oracle the sketch is gated against
+    order = xp.argsort(points)  # graftlint: allow(sort-discipline)
     pts = points[order]
     w = weights[order]
     cum = xp.cumsum(w)
@@ -87,12 +102,29 @@ def resample(key, points: Array, weights: Array, n: int) -> Array:
     return points[idx]
 
 
-def resample_indices_deterministic(weights: Array, n: int) -> Array:
+#: support size above which the deterministic resampler's residual
+#: ranking switches from a full argsort to the sort-free top-k sketch;
+#: at or below it the compiled program is bit-identical to the pre-cap
+#: one (the sketch branch is never traced)
+RESIDUAL_RANK_CAP = 1 << 14
+
+
+def resample_indices_deterministic(weights: Array, n: int,
+                                   rank_cap: int = RESIDUAL_RANK_CAP) -> Array:
     """Systematic/deterministic residual resampling indices.
 
     Parity with ``resample_deterministic`` (weighted_statistics.py:111-160):
     each point is replicated ``floor(n * w)`` times, the residual mass is
     assigned by largest remainder.  Fixed output size ``n``, jit-safe.
+
+    Above ``rank_cap`` support points (a *static* shape check, so
+    sub-cap programs stay byte-identical) the largest-remainder ranking
+    runs through :func:`ops.quantile_sketch.sketch_topk_mask` instead
+    of ``argsort(-residual)``: exact ties still break by ascending
+    index (the stable-sort order), and near-ties within the sketch's
+    resolution may swap which point gets an extra copy — a ±1-count
+    perturbation on residuals ~1e-6 apart, not a bias.  ``rank_cap=None``
+    forces the sort everywhere.
     """
     weights = weights / jnp.sum(weights)
     scaled = weights * n
@@ -101,11 +133,17 @@ def resample_indices_deterministic(weights: Array, n: int) -> Array:
     n_base = jnp.sum(base)
     # Assign the remaining n - n_base slots to the largest residuals.
     n_points = weights.shape[0]
-    rank = jnp.argsort(-residual)
-    extra_mask = jnp.arange(n_points) < (n - n_base)
-    extra = jnp.zeros(n_points, dtype=jnp.int32).at[rank].set(
-        extra_mask.astype(jnp.int32)
-    )
+    if rank_cap is not None and n_points > rank_cap:
+        from .ops.quantile_sketch import sketch_topk_mask
+        extra = sketch_topk_mask(residual, n - n_base).astype(jnp.int32)
+    else:
+        # sub-cap: exact largest-remainder order (bit-identity pin:
+        # tests/test_quantile_sketch.py)
+        rank = jnp.argsort(-residual)  # graftlint: allow(sort-discipline)
+        extra_mask = jnp.arange(n_points) < (n - n_base)
+        extra = jnp.zeros(n_points, dtype=jnp.int32).at[rank].set(
+            extra_mask.astype(jnp.int32)
+        )
     counts = base + extra
     # Expand counts -> indices with fixed output shape n.
     ends = jnp.cumsum(counts)

@@ -52,11 +52,12 @@ def test_repo_tree_is_clean():
 
 
 def test_ten_rules_registered():
-    assert len(ALL_RULES) == 10
+    assert len(ALL_RULES) == 12
     assert set(ALL_RULES) == {
         "wire-chokepoint", "no-inline-jit", "retry-sites",
         "fused-eligibility", "span-pairs", "fault-sites",
-        "host-sync", "lock-discipline", "prng-keys", "env-drift"}
+        "host-sync", "lock-discipline", "prng-keys", "env-drift",
+        "sort-discipline", "precision-policy"}
 
 
 # ---------------------------------------------------------------------------
@@ -443,6 +444,63 @@ def test_prng_keys_fold_in_and_split_reset(tmp_path):
         "        return jax.random.normal(key)\n"
         "    return jax.random.uniform(key)\n")
     assert findings == []
+
+
+def test_sort_discipline_scope_and_suppress(tmp_path):
+    """Sorts flag only in the traced surface; searchsorted and host
+    modules never flag; both suppression spellings work."""
+    from tools.lint.rules import sort_discipline as mod
+    pkg = tmp_path / "pkg"
+    (pkg / "ops").mkdir(parents=True)
+    (pkg / "sampler").mkdir()
+    (pkg / "epsilon").mkdir()
+    (pkg / "ops" / "hot.py").write_text(
+        "import jax.numpy as jnp\n"
+        "a = jnp.argsort(x)\n"
+        "b = jnp.sort(x)\n"
+        "ok = jnp.argsort(x)  # sort-ok\n"
+        "c = jnp.searchsorted(cum, t)\n"
+        "d = xp.argsort(points)\n"
+        "# a comment naming jnp.sort is not a violation\n")
+    # host-side schedules may sort freely — out of scope
+    (pkg / "epsilon" / "cold.py").write_text(
+        "import numpy as np\nq = np.argsort(d)\n")
+    (pkg / "weighted_statistics.py").write_text(
+        "r = jnp.argsort(-residual)\n")
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [
+        ("ops/hot.py", 2), ("ops/hot.py", 3), ("ops/hot.py", 6),
+        ("weighted_statistics.py", 1)]
+
+
+def test_precision_policy_ast_semantics(tmp_path):
+    """Multi-line annotated calls pass; bare @ always flags; np.dot
+    (host numpy) and out-of-scope modules are ignored."""
+    from tools.lint.rules import precision_policy as mod
+    pkg = tmp_path / "pkg"
+    (pkg / "ops").mkdir(parents=True)
+    (pkg / "transition").mkdir()
+    (pkg / "ops" / "kernels.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(a, b):\n"
+        "    good = jnp.matmul(a, b,\n"
+        "                      precision=jax.lax.Precision.HIGHEST)\n"
+        "    acc = jnp.dot(a, b,\n"
+        "                  preferred_element_type=jnp.float32)\n"
+        "    host = np.dot(a, b)\n"
+        "    bad = jnp.matmul(a, b)\n"
+        "    bare = a @ b\n"
+        "    ok = a @ b  # precision-ok\n"
+        "    return good + acc + host + bad + bare + ok\n")
+    # transition/ is outside the kernel surface
+    (pkg / "transition" / "fit.py").write_text(
+        "import jax.numpy as jnp\ny = jnp.matmul(a, b)\n")
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [
+        ("ops/kernels.py", 10), ("ops/kernels.py", 11)]
+    assert "bare '@'" in got[1][2]
 
 
 def test_env_drift_two_way(tmp_path):

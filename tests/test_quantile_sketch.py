@@ -1,0 +1,230 @@
+"""Property battery for the sort-free quantile sketch (ISSUE 11 S3).
+
+Pins the semantics promised by ``pyabc_tpu/ops/quantile_sketch.py``:
+sketch-vs-exact agreement to ``sketch_error_bound`` on dense data and
+atoms, exact exclusion of masked/sentinel rows, extreme-alpha clamping,
+exactly-k top-k masks with stable tie order, and the sub-cap
+bit-identity of the deterministic residual resampler.  The slow arm
+runs the north-star posterior gate across >= 4 seeds under the
+sketch-eps and bf16-lane configs (docs/performance.md "Speed of
+light") so neither opt-in can silently trade statistical bias.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyabc_tpu import weighted_statistics as ws
+from pyabc_tpu.ops.quantile_sketch import (
+    DEFAULT_BINS,
+    DEFAULT_PASSES,
+    sketch_error_bound,
+    sketch_topk_mask,
+    sketch_weighted_quantile,
+)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from verify_northstar_posterior import run_gate  # noqa: E402
+
+
+def _inverse_cdf(points, weights, alpha):
+    """Reference inverse weighted CDF: smallest x with CDF(x) >= alpha*W."""
+    order = np.argsort(points)
+    pts, w = points[order], weights[order]
+    cum = np.cumsum(w)
+    k = int(np.searchsorted(cum, alpha * cum[-1], side="left"))
+    return float(pts[min(k, len(pts) - 1)])
+
+
+@pytest.mark.parametrize("alpha", [0.01, 0.1, 0.25, 0.5, 0.9, 0.99])
+def test_sketch_brackets_inverse_cdf_weighted(alpha):
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-3.0, 7.0, size=50_000).astype(np.float32)
+    w = rng.gamma(2.0, 1.0, size=x.shape).astype(np.float32)
+    got = float(sketch_weighted_quantile(jnp.asarray(x), jnp.asarray(w),
+                                         alpha))
+    ref = _inverse_cdf(x, w, alpha)
+    bound = float(sketch_error_bound(x.min(), x.max()))
+    # interpolation inside the final bracket stays within one bracket
+    # width of the CDF crossing; f32 bucketing adds ulp-scale slack
+    assert abs(got - ref) <= bound + 1e-5 * (x.max() - x.min())
+
+
+def test_sketch_unweighted_default_and_passes_refine():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=20_000).astype(np.float32))
+    q2 = float(sketch_weighted_quantile(x, None, 0.5))
+    q3 = float(sketch_weighted_quantile(x, None, 0.5, passes=3))
+    ref = _inverse_cdf(np.asarray(x), np.ones(x.shape[0]), 0.5)
+    b2 = float(sketch_error_bound(float(x.min()), float(x.max())))
+    b3 = float(sketch_error_bound(float(x.min()), float(x.max()), passes=3))
+    assert abs(q2 - ref) <= b2 + 1e-6
+    assert abs(q3 - ref) <= b3 + 1e-6
+    assert b3 < b2  # extra pass genuinely tightens the bracket
+
+
+def test_atoms_recovered_to_bound():
+    """Ties: all mass of an atom lands in one bucket every pass."""
+    rng = np.random.default_rng(2)
+    atoms = np.array([0.1, 0.2, 0.7], dtype=np.float32)
+    x = rng.choice(atoms, size=10_000, p=[0.3, 0.45, 0.25])
+    got = float(sketch_weighted_quantile(jnp.asarray(x), None, 0.5))
+    bound = float(sketch_error_bound(0.1, 0.7))
+    assert abs(got - 0.2) <= bound
+
+
+def test_masked_sentinel_rows_are_excluded_exactly():
+    """The fused scan's sentinel slots (+inf distance, zero weight,
+    valid=False) must not move the schedule."""
+    rng = np.random.default_rng(3)
+    x = rng.uniform(0.0, 1.0, size=4096).astype(np.float32)
+    w = rng.uniform(0.5, 1.5, size=4096).astype(np.float32)
+    clean = sketch_weighted_quantile(jnp.asarray(x), jnp.asarray(w), 0.3)
+
+    pad_x = np.concatenate([x, np.full(1024, np.inf, np.float32),
+                            np.full(512, np.nan, np.float32),
+                            np.full(512, 1e9, np.float32)])
+    pad_w = np.concatenate([w, np.zeros(1024, np.float32),
+                            np.ones(512, np.float32),
+                            np.ones(512, np.float32)])
+    valid = np.concatenate([np.ones(4096, bool), np.zeros(2048, bool)])
+    dirty = sketch_weighted_quantile(jnp.asarray(pad_x), jnp.asarray(pad_w),
+                                     0.3, valid=jnp.asarray(valid))
+    assert float(clean) == float(dirty)
+
+
+def test_extreme_alpha_clamps_to_support():
+    x = jnp.asarray(np.array([2.0, -1.0, 5.0, 0.5], np.float32))
+    bound = float(sketch_error_bound(-1.0, 5.0))
+    assert abs(float(sketch_weighted_quantile(x, None, 0.0)) - (-1.0)) \
+        <= bound
+    assert abs(float(sketch_weighted_quantile(x, None, 1.0)) - 5.0) <= bound
+    # out-of-range alpha clips rather than extrapolating
+    assert -1.0 <= float(sketch_weighted_quantile(x, None, 2.0)) <= 5.0
+
+
+def test_no_valid_rows_returns_nan():
+    x = jnp.asarray(np.full(16, np.inf, np.float32))
+    assert np.isnan(float(sketch_weighted_quantile(x, None, 0.5)))
+
+
+def test_weighted_quantile_method_routing():
+    rng = np.random.default_rng(4)
+    x_np = rng.uniform(size=8192).astype(np.float32)
+    w_np = rng.uniform(0.1, 1.0, size=8192).astype(np.float32)
+    # device inputs: "sketch" routes through the sketch kernel
+    dev = float(ws.weighted_quantile(jnp.asarray(x_np), jnp.asarray(w_np),
+                                     0.5, method="sketch"))
+    exact = float(ws.weighted_quantile(jnp.asarray(x_np), jnp.asarray(w_np),
+                                       0.5, method="exact"))
+    bound = float(sketch_error_bound(x_np.min(), x_np.max()))
+    # midpoint-interpolation vs inverse-CDF conventions differ by at
+    # most the local order-statistic gap; dense uniform data keeps that
+    # below a few bucket widths
+    gap = float(np.max(np.diff(np.sort(x_np))))
+    assert abs(dev - exact) <= bound + gap
+    # host (numpy) inputs always take the exact path, bit-for-bit
+    host_sketch = ws.weighted_quantile(x_np, w_np, 0.5, method="sketch")
+    host_exact = ws.weighted_quantile(x_np, w_np, 0.5, method="exact")
+    assert float(host_sketch) == float(host_exact)
+    with pytest.raises(ValueError):
+        ws.weighted_quantile(x_np, w_np, 0.5, method="bogus")
+
+
+def test_topk_mask_exact_count_and_content():
+    rng = np.random.default_rng(5)
+    # well-separated values: min gap far above the sketch resolution
+    vals = rng.permutation(np.arange(4096, dtype=np.float32))
+    for k in (0, 1, 7, 100, 4096):
+        mask = np.asarray(sketch_topk_mask(jnp.asarray(vals), k))
+        assert int(mask.sum()) == k
+        if k:
+            assert set(np.nonzero(mask)[0]) == \
+                set(np.argsort(-vals)[:k])
+
+
+def test_topk_mask_traced_k_and_invalid_rows():
+    vals = np.arange(256, dtype=np.float32)
+    vals[::4] = np.nan  # invalid rows never selected
+    k = jnp.asarray(10, jnp.int32)
+    mask = np.asarray(jax.jit(sketch_topk_mask)(jnp.asarray(vals), k))
+    assert int(mask.sum()) == 10
+    assert not mask[::4].any()
+    # k above the valid count clips to it
+    mask_all = np.asarray(sketch_topk_mask(jnp.asarray(vals), 10_000))
+    assert int(mask_all.sum()) == np.isfinite(vals).sum()
+
+
+def test_topk_mask_exact_ties_use_stable_sort_order():
+    """Exactly tied inputs must match the stable ``argsort(-x)`` path
+    bit-for-bit: ascending-index order inside the tie."""
+    vals = jnp.zeros(64, jnp.float32)
+    mask = np.asarray(sketch_topk_mask(vals, 5))
+    assert mask[:5].all() and not mask[5:].any()
+
+
+def test_resampler_bit_identity_below_cap():
+    """Sub-cap supports never trace the sketch branch: the default must
+    reproduce the exact largest-remainder path bit-for-bit."""
+    rng = np.random.default_rng(6)
+    w = jnp.asarray(rng.uniform(0.1, 1.0, size=4096).astype(np.float32))
+    got = np.asarray(ws.resample_indices_deterministic(w, 4096))
+    exact = np.asarray(ws.resample_indices_deterministic(
+        w, 4096, rank_cap=None))
+    assert np.array_equal(got, exact)
+
+
+def test_resampler_above_cap_bounded_perturbation():
+    """Above the cap the sketched ranking may swap near-tied residuals
+    (±1 copies), never shift mass: counts match the exact path except
+    on a small near-tie fraction, totals identical."""
+    n_points = ws.RESIDUAL_RANK_CAP + 1024
+    n = n_points
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.gamma(2.0, 1.0, size=n_points).astype(np.float32))
+    idx_sketch = np.asarray(ws.resample_indices_deterministic(w, n))
+    idx_exact = np.asarray(ws.resample_indices_deterministic(
+        w, n, rank_cap=None))
+    c_sketch = np.bincount(idx_sketch, minlength=n_points)
+    c_exact = np.bincount(idx_exact, minlength=n_points)
+    diff = c_sketch - c_exact
+    assert diff.sum() == 0  # total copies preserved exactly
+    assert np.isin(diff, (-1, 0, 1)).all()  # swaps only, never shifts
+    assert (diff != 0).mean() < 0.01  # near-ties are rare
+
+
+# ---------------------------------------------------------------------------
+# Posterior gates: the speed-of-light opt-ins must not bias the answer.
+# ---------------------------------------------------------------------------
+
+
+def test_gate_smoke_sketch_eps():
+    out = run_gate(pop=15_000, gens=5, seed=0, device_sketch=True)
+    assert out["posterior_gate_ok"], out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_gate_multi_seed_sketch_eps(seed):
+    """Sketch-annealed eps vs the exact-argsort schedule: same analytic
+    posterior at 1/sqrt(pop) tolerance across >= 4 seeds."""
+    out = run_gate(pop=100_000, gens=11, seed=seed, device_sketch=True)
+    assert out["posterior_gate_ok"], out
+    assert out["posterior_gate_final_eps"] < 0.05, out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_gate_multi_seed_bf16_lanes(seed):
+    """bf16 KDE/distance lanes with f32 accumulators: posterior stays
+    in the f32 tolerance band across >= 4 seeds."""
+    out = run_gate(pop=100_000, gens=11, seed=seed,
+                   precision_lanes="bf16")
+    assert out["posterior_gate_ok"], out
+    assert out["posterior_gate_final_eps"] < 0.05, out

@@ -61,6 +61,10 @@ WATCHED = (
     # ... and the residual control plane (one O(scalar) packet fetch
     # amortized over the run) staying cheap is the point of the row
     ("onedispatch_pop1e6_control_roundtrip_s_per_gen", "lower", 0.50),
+    # speed-of-light kernel row (bench_kernel: sketch eps + donated
+    # carries + bf16 lanes): ZERO slack — this row may only ever get
+    # faster; _SECONDS_FLOOR still absorbs timer noise near zero
+    ("onedispatch_pop1e6_s_per_gen", "lower", 0.0),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

@@ -1,0 +1,13 @@
+import jax.numpy as jnp
+
+
+def cross(zq, zb):
+    return jnp.matmul(zq, zb.T)
+
+
+def center(w, support):
+    return w @ support
+
+
+def logits(a, b):
+    return jnp.dot(a, b)

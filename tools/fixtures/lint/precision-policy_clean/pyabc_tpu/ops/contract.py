@@ -1,0 +1,14 @@
+import jax
+import jax.numpy as jnp
+
+
+def cross(zq, zb):
+    return jnp.matmul(zq, zb.T, precision=jax.lax.Precision.HIGHEST)
+
+
+def center(w, support):
+    return w @ support  # graftlint: allow(precision-policy)
+
+
+def logits(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)

@@ -1,0 +1,10 @@
+import jax.numpy as jnp
+
+
+def eps_from_distances(dist, alpha):
+    order = jnp.argsort(dist)  # graftlint: allow(sort-discipline)
+    return dist[order[jnp.int32(alpha * dist.shape[0])]]
+
+
+def rank_residuals(residual):
+    return jnp.sort(-residual)  # graftlint: allow(sort-discipline)

@@ -1,6 +1,6 @@
-"""graftlint rule modules — importing this package registers all ten
-rules with :data:`tools.lint.core.RULES` (registration order is the
-default run order: the six ported gates first, then the new
+"""graftlint rule modules — importing this package registers all
+twelve rules with :data:`tools.lint.core.RULES` (registration order is
+the default run order: the six ported gates first, then the new
 analyzers)."""
 
 from . import wire_chokepoint    # noqa: F401
@@ -13,3 +13,5 @@ from . import host_sync          # noqa: F401
 from . import lock_discipline    # noqa: F401
 from . import prng_keys          # noqa: F401
 from . import env_drift          # noqa: F401
+from . import sort_discipline    # noqa: F401
+from . import precision_policy   # noqa: F401

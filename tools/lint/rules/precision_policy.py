@@ -1,0 +1,128 @@
+"""Rule ``precision-policy``: every MXU contraction in the numeric
+kernels states its precision explicitly.
+
+On TPU, an unannotated ``jnp.dot``/``jnp.matmul`` runs at XLA's
+``Precision.DEFAULT`` — single-pass bf16, which injects O(0.1)
+absolute error into a Mahalanobis exponent (measured; see
+ops/kde.py).  Whether that is acceptable is a per-site NUMERICAL
+decision, so the kernels must write it down: either a ``precision=``
+kwarg (``HIGHEST`` for exact f32 passes) or
+``preferred_element_type=`` (the bf16x3 split's f32 accumulators —
+ops/precision.py).  The bare ``@`` operator cannot carry either, so
+it is always flagged in scope.
+
+Scope: ``ops/`` and ``distance/`` — the modules whose contractions
+run inside compiled sampling programs.  AST-based: multi-line calls
+annotate on any line; comments can't false-positive.
+
+Suppression: ``# precision-ok`` on the reported line;
+``# graftlint: allow(precision-policy)`` also works.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from ..core import Finding, Rule, default_package_root, dotted_name, register
+
+#: numeric-kernel surface (package-root-relative, forward slashes)
+SCAN_PREFIXES = ("ops/", "distance/")
+
+SUPPRESS = "# precision-ok"
+
+#: contraction callables that accept precision kwargs
+_CONTRACTIONS = ("dot", "matmul", "einsum", "tensordot", "vdot")
+#: module spellings whose contractions hit the MXU
+_BASES = ("jnp", "jax.numpy")
+_KWARGS = ("precision", "preferred_element_type")
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def _scan_source(rel: str, text: str) -> list:
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return []  # the interpreter will complain louder than we can
+    lines = text.splitlines()
+
+    def line_of(node) -> str:
+        lineno = getattr(node, "lineno", 0)
+        return lines[lineno - 1] if 1 <= lineno <= len(lines) else ""
+
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            if SUPPRESS in line_of(node):
+                continue
+            out.append((rel, node.lineno,
+                        "bare '@' matmul cannot state a precision — "
+                        "spell it jnp.matmul(..., precision=...) or "
+                        "use ops.precision.bf16x3_matmul"))
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            base, _, attr = name.rpartition(".")
+            if attr not in _CONTRACTIONS or base not in _BASES:
+                continue
+            if any(kw.arg in _KWARGS for kw in node.keywords):
+                continue
+            if SUPPRESS in line_of(node):
+                continue
+            out.append((rel, node.lineno,
+                        f"{name}(...) without precision= or "
+                        "preferred_element_type= runs at DEFAULT "
+                        "(single-pass bf16) — state the lane"))
+    return out
+
+
+def check(root: str = None) -> list:
+    """Scan the kernel surface; returns
+    ``[(relpath, lineno, message), ...]`` violations (empty = clean)."""
+    root = _package_root(root)
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if not rel.startswith(SCAN_PREFIXES):
+                continue
+            with open(path, encoding="utf-8") as f:
+                violations.extend(_scan_source(rel, f.read()))
+    violations.sort(key=lambda v: (v[0], v[1]))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("precision policy: clean (every kernel contraction "
+              "states its lane)")
+        return 0
+    print("unannotated MXU contraction in ops//distance/ (add "
+          "precision= or preferred_element_type=, or justify with "
+          f"'{SUPPRESS}'):")
+    for rel, lineno, msg in violations:
+        print(f"  pyabc_tpu/{rel}:{lineno}: {msg}")
+    return 1
+
+
+@register
+class PrecisionPolicyRule(Rule):
+    id = "precision-policy"
+    description = ("ops/ and distance/ contractions state precision= or "
+                   "preferred_element_type= explicitly (no DEFAULT bf16)")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, msg)
+                for rel, lineno, msg in check(tree.package_root)]

@@ -1,0 +1,97 @@
+"""Rule ``sort-discipline``: no sorts inside traced hot-path modules.
+
+A ``jnp.sort``/``jnp.argsort`` baked into a per-generation compiled
+program is O(B log B) of serial-ish lane work — usually to extract ONE
+order statistic (an eps quantile, a residual ranking).  PR 11 replaced
+the in-scan cases with the sort-free histogram sketch
+(``pyabc_tpu/ops/quantile_sketch.py``): a handful of scatter-add passes
+that brackets the same statistic to ~1e-6 of the value range.  This
+rule keeps the diet: a new sort in the hot path must either route
+through the sketch or justify itself with an explicit allow-comment —
+the surviving exact sorts (the bit-identity baseline quantile, the
+sub-cap residual ranking) are annotated at the call site.
+
+Scope: modules whose code is traced into per-generation device
+programs — ``sampler/``, ``ops/``, ``weighted_statistics.py`` and
+``smc.py``.  Host-side modules (epsilon/, transition fitting, ...) may
+sort freely: their numpy sorts run once per generation on the host.
+
+Suppression: ``# sort-ok`` on the line;
+``# graftlint: allow(sort-discipline)`` also works.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from ..core import Finding, Rule, default_package_root, register
+
+#: traced hot-path surface (package-root-relative, forward slashes)
+SCAN_PREFIXES = ("sampler/", "ops/")
+SCAN_FILES = ("weighted_statistics.py", "smc.py")
+
+SUPPRESS = "# sort-ok"
+
+# device-array sorts: jnp./lax./jax.numpy./jax.lax. plus the
+# ``xp``-dispatching idiom of weighted_statistics.py.  ``searchsorted``
+# does not match (the token after the dot must BE sort/argsort).
+_SORT = re.compile(
+    r"\b(?:jnp|xp|lax|jax\.numpy|jax\.lax)\.(?:arg)?sort\b")
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def check(root: str = None) -> list:
+    """Scan the traced surface; returns
+    ``[(relpath, lineno, line), ...]`` violations (empty = clean)."""
+    root = _package_root(root)
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if not (rel in SCAN_FILES or rel.startswith(SCAN_PREFIXES)):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if SUPPRESS in line:
+                        continue
+                    code = line.split("#", 1)[0]
+                    if _SORT.search(code):
+                        violations.append((rel, lineno, line.rstrip()))
+    violations.sort(key=lambda v: (v[0], v[1]))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("sort discipline: clean (hot paths are sort-free or "
+              "annotated)")
+        return 0
+    print("device sort in a traced hot-path module (route order "
+          "statistics through ops/quantile_sketch.py, or justify the "
+          f"exact sort with '{SUPPRESS}'):")
+    for rel, lineno, line in violations:
+        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
+    return 1
+
+
+@register
+class SortDisciplineRule(Rule):
+    id = "sort-discipline"
+    description = ("traced hot-path modules use the sort-free sketch "
+                   "(ops/quantile_sketch.py); exact sorts are annotated")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, line.strip())
+                for rel, lineno, line in check(tree.package_root)]

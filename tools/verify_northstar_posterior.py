@@ -31,18 +31,46 @@ import sys
 
 
 def run_gate(pop: int = 1_000_000, gens: int = 11,
-             seed: int = 0) -> dict:
+             seed: int = 0, *, device_sketch: bool = False,
+             precision_lanes: str = None) -> dict:
+    """Run the gate; optional speed-of-light configs (docs/performance.md
+    "Speed of light"): ``device_sketch=True`` anneals eps through the
+    sort-free sketch, ``precision_lanes`` pins the per-component
+    precision policy (e.g. ``"bf16"``) for the duration of the run."""
+    import os as _os
+
     import numpy as np
 
     import pyabc_tpu as pt
     from pyabc_tpu.models import make_two_gaussians_problem
+    from pyabc_tpu.ops import precision as _precision
 
+    _env_prev = _os.environ.get(_precision.PRECISION_ENV)
+    if precision_lanes is not None:
+        _os.environ[_precision.PRECISION_ENV] = precision_lanes
+        _precision._reset_for_testing()
+    try:
+        return _run_gate_inner(pop, gens, seed, device_sketch,
+                               np, pt, make_two_gaussians_problem)
+    finally:
+        if precision_lanes is not None:
+            if _env_prev is None:
+                _os.environ.pop(_precision.PRECISION_ENV, None)
+            else:
+                _os.environ[_precision.PRECISION_ENV] = _env_prev
+            _precision._reset_for_testing()
+
+
+def _run_gate_inner(pop, gens, seed, device_sketch,
+                    np, pt, make_two_gaussians_problem):
     models, priors, distance, observed, posterior_fn = \
         make_two_gaussians_problem()
     abc = pt.ABCSMC(
         models, priors, distance,
         population_size=pop,
-        eps=pt.MedianEpsilon(),  # anneals: exercises refit every gen
+        # anneals: exercises refit every gen (sketched on device when
+        # device_sketch — the eps-accuracy arm of the posterior gate)
+        eps=pt.MedianEpsilon(device_sketch=device_sketch),
         sampler=pt.VectorizedSampler(
             max_batch_size=1 << 19, max_rounds_per_call=16),
         # the bench's north-star wire mode: stats off the wire entirely
