@@ -60,6 +60,12 @@ is one-off).
   mesh (shard_map overhead vs the primary row must be ~0)
 - ``sharded_cpu8_*``       — the same sharded program on an 8-device
   virtual CPU mesh (collective data-plane correctness timing)
+- ``podstar_pop1e7_*``     — config #4's pod-sharded deployment: the
+  one-dispatch SIR run on a REAL 2-process ``jax.distributed`` pod
+  (CPU-federated on this rig, so a data-plane figure like
+  sharded_cpu8; ``podstar_pop1e7_population`` records the measured
+  population); ``dispatches_per_run`` must read 1 PER HOST with the
+  stop chain resolving on-fabric
 
 Every row times its generations individually (5-8 on the headline
 primary/north-star rows, 3 elsewhere) and reports the MEDIAN, with the
@@ -636,7 +642,7 @@ def _bench_problem(make_problem, pop, prefix):
 SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
                "kernel", "posterior_gate", "lotka_volterra", "sir",
                "petab_ode", "sharded_mesh1", "ab_vec_sharded",
-               "sharded_cpu8")
+               "sharded_cpu8", "podstar")
 
 
 def bench_ab_vec_vs_sharded():
@@ -731,6 +737,164 @@ def bench_sharded(pop: int, prefix: str, fuse: int = 1,
             **{f"{prefix}_{k}": v for k, v in transfer.items()}}
 
 
+#: the pod row's nominal contract population (BASELINE.md config #4's
+#: pod-sharded deployment target; the key prefix is fixed even when the
+#: rig underneath measures a scaled population — see bench_podstar)
+PODSTAR_NOMINAL_POP = 10_000_000
+PODSTAR_HOSTS = 2
+PODSTAR_GENS = 4
+
+PODSTAR_PROGRAM = """
+import json, os, time
+
+import jax
+import pyabc_tpu as pt
+from pyabc_tpu.autotune import compile_counters, compile_delta
+from pyabc_tpu.models import make_sir_problem
+from pyabc_tpu.telemetry.metrics import REGISTRY
+from pyabc_tpu.wire import transfer as _wt
+
+pop = int(os.environ["PODSTAR_POP"])
+gens = int(os.environ["PODSTAR_GENS"])
+models, priors, distance, observed = make_sir_problem()
+# BASELINE.md config #4: SIR tau-leap, ADAPTIVE epsilon, pod-sharded —
+# the annealing median schedule and the adaptive-distance refit both
+# run in-scan, so the stop chain stays on device across the pod
+abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                eps=pt.MedianEpsilon(),
+                run_mode="onedispatch", history_mode="lazy",
+                fuse_generations=4, stores_sum_stats=False, seed=0)
+abc.new("sqlite:///" + os.environ["POD_DB"], observed)
+eg0 = {k: v / 1e6 for k, v in _wt.egress_breakdown().items()}
+cc0 = compile_counters()
+t0 = time.perf_counter()
+abc.run(max_nr_populations=1 + gens)
+wall = time.perf_counter() - t0
+cc = compile_delta(cc0)
+eg = {k: v / 1e6 - eg0.get(k, 0.0)
+      for k, v in _wt.egress_breakdown().items()}
+od_gens = sum(1 for r in abc.timeline.to_rows()
+              if r.get("path") == "onedispatch")
+with open(os.environ["CLUSTER_TEST_OUT"], "w") as f:
+    json.dump({"process_index": jax.process_index(),
+               "process_count": jax.process_count(),
+               "n_devices": len(jax.devices()),
+               "sampler": type(abc.sampler).__name__,
+               "dispatches": int(abc.run_dispatches),
+               "stop": abc.timeline.stop_reason,
+               "generations": od_gens,
+               "wall_s": wall,
+               "compile_s": cc["compile_s"],
+               "collective_s": float(REGISTRY.to_dict().get(
+                   "wire_collective_seconds_total", 0.0)),
+               "egress_mb": eg}, f)
+"""
+
+
+def bench_podstar():
+    """Pod-scale one-dispatch row — BASELINE.md config #4 (SIR tau-leap,
+    adaptive epsilon, pod-sharded) run as a REAL 2-process
+    ``jax.distributed`` pod: every host executes the same onedispatch
+    program over the global mesh, the five-criterion stop chain resolves
+    through on-fabric collectives, and each host drains only its own
+    shard (docs/performance.md "Pod scale").
+
+    Acceptance artifacts: ``podstar_pop1e7_dispatches_per_run`` must be
+    1 on EVERY host (the whole post-calibration run is one SPMD dispatch
+    per host — zero steady-state host-side cross-host synchronization;
+    the collective-discipline lint guards the code side of the same
+    claim) and ``podstar_pop1e7_hosts`` records the pod width.
+
+    Like ``sharded_cpu8``, the pod here is CPU-federated (two worker
+    processes x 4 forced host devices — a single TPU chip cannot be
+    shared by two processes), so the timing keys are DATA-PLANE
+    correctness figures at a scaled population, not TPU rates; the key
+    prefix carries the config's nominal pod target (pop 1e7) and
+    ``podstar_pop1e7_population`` records what was actually measured
+    (``PODSTAR_POP`` env to override; a real multi-host slice runs the
+    nominal population with the same worker program)."""
+    import socket
+    import subprocess
+    import tempfile
+
+    pop = int(os.environ.get("PODSTAR_POP", "8192"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "podstar_prog.py")
+        with open(script, "w") as f:
+            f.write(PODSTAR_PROGRAM)
+        procs, outs = [], []
+        for i in range(PODSTAR_HOSTS):
+            out = os.path.join(td, f"podstar_out_{i}.json")
+            outs.append(out)
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS="cpu",
+                PYTHONPATH=os.path.dirname(os.path.abspath(__file__)),
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PODSTAR_POP=str(pop),
+                PODSTAR_GENS=str(PODSTAR_GENS),
+                POD_DB=os.path.join(td, f"podstar_h{i}.db"),
+                CLUSTER_TEST_OUT=out,
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "pyabc_tpu.parallel.cli",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--num-processes", str(PODSTAR_HOSTS),
+                 "--process-id", str(i), script],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        errs = [p.communicate(timeout=1500)[1] for p in procs]
+        for p, se in zip(procs, errs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"podstar worker failed: {se.decode()[-500:]}")
+        infos = []
+        for out in outs:
+            with open(out) as f:
+                infos.append(json.load(f))
+
+    gens = infos[0]["generations"]
+    # the pod runs in SPMD lockstep: the run's wall clock is the slowest
+    # host's, and the one-off compile bill is backed out per-host before
+    # taking that max (hosts compile concurrently, not additively)
+    steady = max(max(i["wall_s"] - i["compile_s"], 0.0) for i in infos)
+    spg = steady / gens if gens else None
+    return {
+        # every host must report ONE dispatch — report the max so any
+        # host degrading back to per-block control fails the sentinel
+        "podstar_pop1e7_dispatches_per_run": max(
+            i["dispatches"] for i in infos),
+        "podstar_pop1e7_hosts": infos[0]["process_count"],
+        "podstar_pop1e7_s_per_gen": (None if spg is None
+                                     else round(spg, 2)),
+        "podstar_pop1e7_accepted_per_s": (
+            None if not spg else round(pop * gens / steady, 1)),
+        "podstar_pop1e7_population": pop,
+        "podstar_pop1e7_generations": gens,
+        "podstar_pop1e7_n_devices": infos[0]["n_devices"],
+        "podstar_pop1e7_stop_reason": infos[0]["stop"],
+        "podstar_pop1e7_stop_parity": len(
+            {i["stop"] for i in infos}) == 1,
+        "podstar_pop1e7_compile_s": round(
+            max(i["compile_s"] for i in infos), 2),
+        # host-side collective seconds (wire_collective_seconds_total),
+        # summed over hosts: the steady state charges NOTHING here (the
+        # stop chain is on-fabric) — what remains is gen 0's
+        # calibration fetch and the run-end flush, amortized
+        "podstar_pop1e7_collective_s_per_gen": round(
+            sum(i["collective_s"] for i in infos) / gens, 4) if gens
+            else None,
+        # per-host egress SUMMED across the pod: each host drains only
+        # its addressable shard, so the pod-wide bill is the same O(KB)
+        # a single host pays, split |hosts| ways
+        **{f"podstar_pop1e7_egress_{k}_mb": round(
+            sum(i["egress_mb"].get(k, 0.0) for i in infos), 3)
+           for k in ("population", "history", "summary", "control")},
+    }
+
+
 def _run_sub(name: str) -> dict:
     if name == "kde_1e6":
         return bench_kde_1e6()
@@ -766,6 +930,8 @@ def _run_sub(name: str) -> dict:
         return bench_ab_vec_vs_sharded()
     if name == "sharded_cpu8":
         return bench_sharded(POP, "sharded_cpu8")
+    if name == "podstar":
+        return bench_podstar()
     raise ValueError(name)
 
 
@@ -856,7 +1022,7 @@ def main():
     compact = {k: v for k, v in sorted(extra.items())
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
-                                "onedispatch_", "kernel_",
+                                "onedispatch_", "kernel_", "podstar_",
                                 "posterior_gate_",
                                 "telemetry_", "resilience_",
                                 "checkpoint_", "store_", "lint_"))

@@ -189,6 +189,25 @@ def _render_top(run_dir) -> str:
         f"fleet: hosts={len(snaps)} gens={tot['generations']} "
         f"evals={tot['evaluations']} acc_rate={acc_rate:.4g} "
         f"d2h={tot['d2h_mb']:.2f}MB engine={engine or '-'}")
+    # pod shard attribution (SPMD multi-process runs): which process
+    # each snapshot is, its accepted share, and the host-side
+    # collective time — flat zero in the one-dispatch steady state
+    pods = [(s, s.get("pod")) for s in snaps if s.get("pod")]
+    if pods:
+        n_pod = max(int(p["process_count"]) for _, p in pods)
+        coll = sum(float((s.get("metrics") or {}).get(
+            "wire_collective_seconds_total", 0.0)) for s, _ in pods)
+        gens = max([int((s.get("heartbeat") or {}).get("generations", 0))
+                    for s, _ in pods] or [0])
+        shares = " ".join(
+            f"h{p['process_index']}="
+            f"{(s.get('heartbeat') or {}).get('accepted', 0)}"
+            for s, p in sorted(pods,
+                               key=lambda x: x[1]["process_index"]))
+        lines.append(
+            f"pod: hosts={n_pod} collective={coll:.3f}s "
+            f"({coll / gens if gens else 0.0:.4f}s/gen) "
+            f"accepted {shares}")
     lines.append(
         f"resilience: retries={tot['retries']} "
         f"degrades={tot['degrades']} checkpoints={tot['checkpoints']} "

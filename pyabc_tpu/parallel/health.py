@@ -297,7 +297,8 @@ def stop_requested(directory: Optional[str] = None) -> bool:
         import numpy as np
         local = (bool(directory) and
                  os.path.exists(os.path.join(directory, STOP_SENTINEL)))
-        seen = multihost_utils.process_allgather(np.asarray(local))
+        seen = multihost_utils.process_allgather(  # collective-ok: stop-sentinel poll, SPMD-ordered at generation boundaries
+            np.asarray(local))
         return bool(np.any(seen))
     if not directory:
         return False

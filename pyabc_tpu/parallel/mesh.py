@@ -6,11 +6,21 @@ Redis blackboard (SURVEY.md §5.8).  The TPU equivalent: one
 over every chip; acceptance counting and weight reductions become XLA
 collectives over ICI, and multi-host scale-out is the same program under
 ``jax.distributed`` over DCN — no broker, no pickling.
+
+Pod scale (docs/performance.md "Pod scale"): a multi-host run builds ONE
+global mesh over every process's devices.  Device order is host-major —
+each host's addressable devices are contiguous along the "particles"
+axis — so a P("particles") array splits into per-host contiguous shards
+and each host can drain its own slice without touching DCN.  On real
+pods the order comes from ``create_hybrid_device_mesh`` (slow DCN axis
+outermost, ICI innermost, so resample/refit collectives stay on ICI
+where the topology allows); on CPU test rigs the same contract is kept
+by sorting on (process_index, id).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -18,13 +28,98 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PARTICLE_AXIS = "particles"
 
+# DCN (inter-host) x ICI (intra-host) axis names for the 2-D hybrid
+# mesh; the flat run mesh collapses both into PARTICLE_AXIS.
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+# t5x-style logical axis rules (SNIPPETS.md [1]): logical array axes on
+# the left, mesh axes they may shard over on the right.  The particle
+# batch is the only sharded logical axis in this codebase; everything
+# else (params vectors, eps scalars, kernel state) is replicated.
+LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("particles", PARTICLE_AXIS),
+    ("batch", PARTICLE_AXIS),
+    ("params", None),
+    ("stats", None),
+)
+
 
 def make_mesh(devices: Optional[Sequence] = None,
               axis_name: str = PARTICLE_AXIS) -> Mesh:
-    """A 1-D mesh over all (or the given) devices."""
+    """A 1-D mesh over all (or the given) devices.
+
+    Under ``jax.distributed`` this is already the GLOBAL device list, in
+    host-major order (``make_pod_mesh``), so single- and multi-process
+    callers share one code path.
+    """
     if devices is None:
+        if jax.process_count() > 1:
+            return make_pod_mesh(axis_name=axis_name)
         devices = jax.devices()
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def _host_major_devices() -> list:
+    """Global device list with each process's devices contiguous.
+
+    ``jax.devices()`` already orders by process on every backend we run
+    on, but the per-host drain contract (each host's shard of a
+    P("particles") array is one contiguous slice of its addressable
+    devices) is load-bearing for pod runs, so sort explicitly.
+    """
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
+def make_pod_mesh(axis_name: str = PARTICLE_AXIS) -> Mesh:
+    """The flat 1-D pod mesh: every device of every host, host-major.
+
+    On TPU pods the order is derived from ``create_hybrid_device_mesh``
+    so the fast ICI links sit innermost and the DCN hop outermost
+    (SNIPPETS.md [2]); CPU/test backends fall back to an explicit
+    (process_index, id) sort which satisfies the same contiguity
+    contract.
+    """
+    n_local = len(jax.local_devices())
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return Mesh(np.asarray(jax.devices()), (axis_name,))
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (n_local,), (n_proc,), devices=jax.devices())
+        return Mesh(np.asarray(arr).reshape(-1), (axis_name,))
+    except Exception:
+        # CPU fallback (SNIPPETS.md [1]): no ICI topology to discover
+        return Mesh(np.asarray(_host_major_devices()), (axis_name,))
+
+
+def make_hybrid_mesh(axis_names: Tuple[str, str] = (DCN_AXIS, ICI_AXIS)
+                     ) -> Mesh:
+    """2-D (hosts, local devices) hybrid mesh for collectives that must
+    distinguish the DCN hop from ICI (e.g. a refit that all-reduces
+    moments over ICI first, then once over DCN)."""
+    n_local = len(jax.local_devices())
+    n_proc = jax.process_count()
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (1, n_local), (n_proc, 1), devices=jax.devices())
+    except Exception:
+        arr = np.asarray(_host_major_devices()).reshape(n_proc, n_local)
+    return Mesh(np.asarray(arr).reshape(n_proc, n_local), axis_names)
+
+
+def logical_sharding(mesh: Mesh, *logical_axes: Optional[str]
+                     ) -> NamedSharding:
+    """Resolve logical axis names through LOGICAL_AXIS_RULES against the
+    given mesh (axes the mesh doesn't carry fall back to replicated)."""
+    rules = dict(LOGICAL_AXIS_RULES)
+    spec = []
+    for ax in logical_axes:
+        mesh_ax = rules.get(ax) if ax is not None else None
+        spec.append(mesh_ax if mesh_ax in mesh.axis_names else None)
+    return NamedSharding(mesh, P(*spec))
 
 
 def particle_sharding(mesh: Mesh, axis_name: str = PARTICLE_AXIS
@@ -37,12 +132,36 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def host_shard_slice(mesh: Mesh, n: int) -> slice:
+    """This host's contiguous slice of a length-``n`` P("particles")
+    array on the host-major pod mesh — the rows this process may drain
+    without any cross-host traffic."""
+    devs = list(mesh.devices.flat)
+    per_dev = n // len(devs)
+    mine = [i for i, d in enumerate(devs)
+            if d.process_index == jax.process_index()]
+    if not mine:
+        return slice(0, 0)
+    return slice(mine[0] * per_dev, (mine[-1] + 1) * per_dev)
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None):
     """Multi-host bring-up (replaces the reference's Redis broker for
     inter-node coordination, redis_eps/sampler.py:15-153): each host joins
-    the same SPMD program via jax.distributed over DCN."""
+    the same SPMD program via jax.distributed over DCN.
+
+    The CPU backend needs an explicit cross-process collectives
+    implementation (gloo) or the first sharded dispatch dies with
+    "Multiprocess computations aren't implemented on the CPU backend";
+    it must be configured before the backend initializes, i.e. here.
+    On TPU the flag is inert (collectives ride ICI/DCN natively).
+    """
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jaxlib without the flag: TPU path unaffected
     kwargs = {}
     if coordinator_address is not None:
         kwargs.update(coordinator_address=coordinator_address,

@@ -176,18 +176,124 @@ def _fsync_enabled() -> bool:
         "0", "off", "false", "no")
 
 
+def _pod_suffix() -> str:
+    """Per-host namespace under ``jax.distributed``: pod processes may
+    share a filesystem (one run dir on NFS), so each host journals into
+    its own ``h<process_index>`` subdirectory — shard-local bytes, no
+    cross-host file clobbering, and the sibling layout is what
+    :func:`pod_sibling_dirs` reassembles full generations from."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return f"h{jax.process_index():03d}"
+    except Exception:
+        pass
+    return ""
+
+
 def journal_dir_for(db_path: str, in_memory: bool) -> Optional[str]:
     """Resolve the journal directory for a History: the env override
     wins, else ``<db>.journal`` next to a file-backed DB; None (journal
-    off) for in-memory DBs without an override or when disabled."""
+    off) for in-memory DBs without an override or when disabled.  Under
+    a multi-process pod every host gets its own ``h<process_index>``
+    subdirectory of the resolved location."""
     if not journal_enabled():
         return None
     override = os.environ.get(JOURNAL_DIR_ENV, "").strip()
-    if override:
-        return override
-    if in_memory:
+    base = override or (None if in_memory else db_path + ".journal")
+    if base is None:
         return None
-    return db_path + ".journal"
+    suffix = _pod_suffix()
+    return os.path.join(base, suffix) if suffix else base
+
+
+def pod_sibling_dirs(directory: str) -> list:
+    """All per-host journal directories of the pod run that
+    ``directory`` belongs to, host-major (``h000``, ``h001``, ...).
+    Returns ``[directory]`` when it is not pod-namespaced.  Only
+    meaningful on a shared filesystem — hosts with private disks see
+    just their own shard (documented in docs/resilience.md)."""
+    head, tail = os.path.split(os.path.normpath(directory))
+    if not (len(tail) == 4 and tail[0] == "h" and tail[1:].isdigit()):
+        return [directory]
+    try:
+        sibs = sorted(n for n in os.listdir(head)
+                      if len(n) == 4 and n[0] == "h" and n[1:].isdigit()
+                      and os.path.isdir(os.path.join(head, n)))
+    except OSError:
+        return [directory]
+    return [os.path.join(head, n) for n in sibs] or [directory]
+
+
+def merge_shard_wires(shards: list, global_manifest: Optional[dict]
+                      ) -> Dict[str, np.ndarray]:
+    """Reassemble one generation's full host wire from per-host
+    shard-local journal payloads (host-major order).
+
+    Per-row lanes (leading axis sharded over "particles") are
+    concatenated; replicated lanes (scalars, per-column scales, summary
+    lanes) are taken from the first shard.  The deposit-time GLOBAL
+    manifest decides which is which: a key whose recorded leading dim
+    differs from the shard's is row-sharded.  The merged wire is then
+    manifest-verified by the caller's normal digest path."""
+    first = shards[0]
+    out: Dict[str, np.ndarray] = {}
+    for k in sorted(first):
+        want = (global_manifest or {}).get(k)
+        v0 = np.asarray(first[k])
+        sharded = (want is not None and len(want[1]) >= 1
+                   and v0.ndim >= 1
+                   and int(want[1][0]) != int(v0.shape[0]))
+        if sharded:
+            out[k] = np.concatenate(
+                [np.asarray(s[k]) for s in shards], axis=0)
+        else:
+            out[k] = v0
+    return out
+
+
+def pod_pending(journal) -> Dict[int, dict]:
+    """``journal.pending()``, pod-aware: when the journal lives in a
+    per-host ``h<process_index>`` namespace, scan every sibling host's
+    journal and reassemble full generations from their shard payloads
+    (host-major row concat, :func:`merge_shard_wires`).  Generations
+    missing a shard are logged and left out — ``purge_stale_lazy``
+    then drops their summary rows, same as any unrecoverable loss.
+    Merged entries carry a manifest-only digest (the deposit-time
+    GLOBAL manifest): the per-shard CRCs were already verified by each
+    sibling's ``pending()`` scan."""
+    dirs = pod_sibling_dirs(journal.dir)
+    if len(dirs) <= 1:
+        return journal.pending()
+    mine = os.path.normpath(journal.dir)
+    per = []
+    for d in dirs:
+        j = journal if os.path.normpath(d) == mine else SpillJournal(d)
+        per.append(j.pending())
+    out: Dict[int, dict] = {}
+    for t in sorted(set().union(*map(set, per))):
+        recs = [p[t] for p in per if t in p]
+        shards = sorted((r for r in recs if r.get("shard")),
+                        key=lambda r: int(r["shard"][0]))
+        if not shards:
+            out[t] = recs[0]  # un-sharded payload (single-host write)
+            continue
+        want = int(shards[0]["shard"][1])
+        if len(shards) < want:
+            _counter("resilience_journal_bad_records_total").inc()
+            logger.warning(
+                "pod journal replay: generation %d has %d/%d shard "
+                "payload(s) — left for purge", t, len(shards), want)
+            continue
+        gm = shards[0].get("global_manifest")
+        out[t] = {
+            "t": t, "n": shards[0]["n"], "count": shards[0]["count"],
+            "eps": shards[0]["eps"], "norm": shards[0]["norm"],
+            "host_wire": merge_shard_wires(
+                [r["host_wire"] for r in shards], gm),
+            "digest": {"crc": None, "manifest": gm} if gm else None,
+        }
+    return out
 
 
 def _pack_payload(host_wire: Dict[str, np.ndarray], keys) -> bytes:
@@ -447,6 +553,13 @@ class SpillJournal:
                     "host_wire": wire,
                     "digest": rec.get("digest"),
                 }
+                if rec.get("shard") is not None:
+                    # pod shard payload: this record holds ONE host's
+                    # rows; pod_pending() reassembles the generation
+                    out[t]["shard"] = [int(rec["shard"][0]),
+                                       int(rec["shard"][1])]
+                    out[t]["global_manifest"] = rec.get(
+                        "global_manifest")
         for t in mat:
             out.pop(t, None)
         return out

@@ -128,9 +128,18 @@ def fetch_to_host(tree):
             return leaf  # bulk-fetched below
         if getattr(leaf, "is_fully_replicated", False):
             return np.asarray(leaf.addressable_shards[0].data)
+        # cross-host assembly: the classic per-generation path's only
+        # global sync point.  Pod one-dispatch runs never reach here in
+        # steady state (summary lanes are replicated, wires drain
+        # shard-local); setup/teardown and eager multi-host fetches do,
+        # and the seconds land on the ledger's ``collective_s`` so the
+        # zero-steady-state-sync contract is machine-checkable.
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(leaf,
-                                                            tiled=True))
+        c0 = _time.perf_counter()
+        out = np.asarray(multihost_utils.process_allgather(  # collective-ok: d2h chokepoint, SPMD-ordered
+            leaf, tiled=True))
+        transfer.record_collective(_time.perf_counter() - c0)
+        return out
     import jax.tree_util as tu
 
     def _fetch():
@@ -147,6 +156,53 @@ def fetch_to_host(tree):
     # path on every caller — sampler loops and background ingest
     # workers alike (tools/check_retry_sites.py)
     return _retry.shared_policy().call(_fetch, _faults.SITE_FETCH)
+
+
+def fetch_local_shard(tree):
+    """This process's contiguous rows of a (possibly global) device
+    pytree — NO cross-host traffic, ever.
+
+    The pod drain/durability contract (docs/performance.md "Pod
+    scale"): on the host-major pod mesh each process's addressable
+    shards of a P("particles") array are one contiguous row range, so
+    concatenating them in shard order yields exactly this host's slice
+    of the global value.  Replicated leaves (scales, counters) return
+    the full local replica; single-process arrays are equivalent to
+    ``fetch_to_host``.  Used by the per-host journal spill
+    (wire/store.py) and the preemption barrier, where a collective
+    would hang on already-dying peers.
+    """
+    import time as _time
+
+    import jax
+
+    from ..wire import transfer
+
+    t0 = _time.perf_counter()
+    try:
+        jax.block_until_ready(tree)
+    except Exception:
+        pass
+    transfer.record_compute(_time.perf_counter() - t0)
+
+    def get(leaf):
+        if getattr(leaf, "is_fully_addressable", True) \
+                or getattr(leaf, "is_fully_replicated", False):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is not None and not leaf.is_fully_addressable:
+                return np.asarray(shards[0].data)
+            return np.asarray(leaf)
+        shards = sorted(leaf.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards],
+                              axis=0)
+    import jax.tree_util as tu
+
+    # booked under the CALLER's egress label (journal spills wrap this
+    # in egress("history"); checkpoints in egress("checkpoint"))
+    with transfer.timed_d2h() as timer:
+        out = tu.tree_map(get, tree)
+    return timer.commit(out)
 
 
 def widen_wire(out: dict, take: int) -> dict:
@@ -215,6 +271,17 @@ class Sample:
     schemes via configure_sampler), ALL candidate sum-stats are kept up to
     ``max_records`` so per-generation adaptation can see rejected particles.
     """
+
+    #: pod opt-in (set by the orchestrator when the run is in pod
+    #: one-dispatch posture): keep ``device_population`` even when its
+    #: leaves span processes.  Under SPMD every process holds the same
+    #: GLOBAL view, all device consumers (carry seeding, on-device
+    #: refits, summary packets) are jit programs over the global mesh,
+    #: and the only host materializations are replicated reductions or
+    #: annotated setup/teardown fetches — so the single-process
+    #: addressability requirement is exactly what pod runs relax.
+    #: Default False: the classic multi-host path stays byte-identical.
+    allow_global_device_view = False
 
     def __init__(self, record_rejected: bool = False,
                  max_records: int = 1 << 21):
@@ -291,9 +358,10 @@ class Sample:
         ON device (smc.py `_device_supports`) instead of re-uploading
         ~MBs of host-padded support through the relay.
         """
-        if device_view is not None and all(
-                getattr(v, "is_fully_addressable", True)
-                for v in device_view.values()):
+        if device_view is not None and (
+                self.allow_global_device_view
+                or all(getattr(v, "is_fully_addressable", True)
+                       for v in device_view.values())):
             self.device_population = {
                 k: device_view[k]
                 for k in ("m", "theta", "log_weight", "stats",
@@ -339,9 +407,10 @@ class Sample:
         ``append_device_batch`` so undershoot checks and rate estimates
         see the same numbers whether or not the fetch ran yet.
         """
-        if device_view is not None and all(
-                getattr(v, "is_fully_addressable", True)
-                for v in device_view.values()):
+        if device_view is not None and (
+                self.allow_global_device_view
+                or all(getattr(v, "is_fully_addressable", True)
+                       for v in device_view.values())):
             self.device_population = {
                 k: device_view[k]
                 for k in ("m", "theta", "log_weight", "stats",

@@ -576,6 +576,31 @@ def _build_one_gen(
     return one_gen
 
 
+#: population-sized carry lanes (leading axis = n_target) — the ones a
+#: pod run pins to the global "particles" sharding
+_POP_CARRY_LANES = ("m", "theta", "log_weight", "distance", "stats")
+
+
+def _pod_constrain_carry(carry):
+    """Pin the population lanes of a fused/onedispatch carry to the
+    global P("particles") sharding when running multi-process SPMD.
+
+    GSPMD would usually infer this from the seed carry's committed
+    shardings, but the pin makes the contract explicit at the program
+    boundary: the carry stays partitioned over the whole pod (per-host
+    HBM holds 1/hosts of the population), reductions over it lower to
+    on-fabric all-reduces, and a replicated-carry regression becomes
+    impossible rather than silent.  Single-process programs are
+    returned UNTOUCHED — bit-identical HLO to every prior PR."""
+    if jax.process_count() <= 1:
+        return carry
+    from ..parallel.mesh import make_mesh, particle_sharding
+    psh = particle_sharding(make_mesh())
+    return {k: (jax.lax.with_sharding_constraint(v, psh)
+                if k in _POP_CARRY_LANES else v)
+            for k, v in carry.items()}
+
+
 def build_fused_generations(
         kernel,
         bandwidth_selectors: Sequence[Callable],
@@ -659,6 +684,7 @@ def build_fused_generations(
         return one_gen(carry, xs)
 
     def fused(carry, key, final_mask=None):
+        carry = _pod_constrain_carry(carry)
         keys = jax.random.split(key, K)
         if stoch:
             xs = {"key": keys, "final": final_mask}
@@ -745,6 +771,7 @@ def build_onedispatch_run(
         raise ValueError("max_T must be >= 1")
 
     def onedispatch(carry, key, ctl):
+        carry = _pod_constrain_carry(carry)
         min_eps = jnp.asarray(ctl["min_eps"], jnp.float32)
         min_rate = jnp.asarray(ctl["min_rate"], jnp.float32)
         budget_rounds = jnp.asarray(ctl["budget_rounds"], jnp.int32)

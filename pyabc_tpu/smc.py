@@ -454,6 +454,20 @@ class ABCSMC:
         tentpole): populations stay device-resident, summaries ship."""
         return self._store is not None and self.history is not None
 
+    @property
+    def _pod_active(self) -> bool:
+        """The run is in pod one-dispatch posture: multiple processes
+        federated into one SPMD program over the global particle mesh
+        (parallel/mesh.py:make_pod_mesh), with the lazy store armed so
+        steady-state egress is the replicated O(KB) summary packet and
+        each host's journal/drain stays shard-local."""
+        from .sampler.sharded import ShardedSampler
+        return (jax.process_count() > 1
+                and self.run_mode == "onedispatch"
+                and self._lazy_active
+                and isinstance(self.sampler, ShardedSampler)
+                and self.sampler.n_devices == len(jax.devices()))
+
     def _degrade_lazy(self, t: int):
         """Last rung of the integrity recovery ladder: generation ``t``
         failed checksummed hydration beyond repair.  Drop its summary
@@ -621,10 +635,18 @@ class ABCSMC:
         if not isinstance(s, VectorizedSampler):
             return False
         if isinstance(s, ShardedSampler) and jax.process_count() > 1:
-            # the block's single fetch would need cross-host assembly of
-            # every wire entry; the per-generation loop already handles
-            # that path — keep it
-            return False
+            # pod posture (docs/performance.md "Pod scale"): the device
+            # engines may run multi-host ONLY when the steady-state
+            # egress is the O(KB) replicated summary packet — i.e. the
+            # run opted into one-dispatch mode with the lazy store
+            # armed, over a mesh spanning every process.  All other
+            # engines' block fetches would assemble every wire entry
+            # with a per-generation cross-host allgather; the classic
+            # per-generation loop already handles that path — keep it.
+            if self.run_mode != "onedispatch" or self._store is None:
+                return False
+            if s.n_devices != len(jax.devices()):
+                return False  # local sub-mesh: not an SPMD pod run
         if not getattr(self.acceptor, "device_accept_ok", False):
             return False
         if not getattr(self.eps, "device_schedule_ok", False):
@@ -1611,8 +1633,12 @@ class ABCSMC:
         t0_run = _time.perf_counter()
         tr0_run = _transfer.snapshot()
         cc0_run = _compile_counters()
+        # pod runs stay on the JIT path: AOT lowering from avals drops
+        # the carry's global shardings, and a program compiled without
+        # them would silently replicate the particle axis
         fn = self._get_run_fn(t, n, B, K, max_T, summary=lazy,
-                              aot_args=args)
+                              aot_args=None if self._pod_active
+                              else args)
         dispatch_mark = _time.perf_counter()
         try:
             with profile_generation(t), \
@@ -2484,6 +2510,12 @@ class ABCSMC:
         if self.history is None:
             raise RuntimeError("call new(db, observed) or load(db) first")
         self._configure_telemetry()
+        # pod posture: device views whose leaves span processes stay on
+        # the Sample (the one-dispatch carry / lazy deposits are jit
+        # programs over the global mesh) — reset in the finally so a
+        # later single-host run in the same process is untouched
+        if self._pod_active:
+            Sample.allow_global_device_view = True
         # the run span covers EVERYTHING (calibration included) so trace
         # coverage accounting has a well-defined denominator; flushed in
         # the finally so a crashed run still leaves a loadable trace
@@ -2501,6 +2533,7 @@ class ABCSMC:
             _flight.RECORDER.dump(reason=type(err).__name__)
             raise
         finally:
+            Sample.allow_global_device_view = False
             if self._lazy_active:
                 # error-unwind safety net: anchor device-resident
                 # summary rows newest-first (no-op after a clean done(),

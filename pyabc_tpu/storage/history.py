@@ -578,7 +578,7 @@ class History:
                                      nr_simulations, summary,
                                      model_names, param_names, stat_spec,
                                      summary_grid):
-        self._drain_spills()
+        self._drain_spills(defer_pod=True)
         grid_blob = None
         if summary_grid:
             grid_blob = _pack(np.stack(
@@ -704,20 +704,31 @@ class History:
                     pass
             raise
 
-    def _drain_spills(self):
+    def _drain_spills(self, defer_pod: bool = False):
         """Materialize entries the store's ring evicted (deposits happen
         on ingest worker threads; the durable write happens here, on the
         connection's thread).  Each entry materializes under its own
         retry (``history.materialize`` fault site) — a failure requeues
         THAT entry (``store_spill_requeued_total``) and the drain moves
         on, so one bad entry can no longer drop the rest of the batch
-        on the floor."""
+        on the floor.
+
+        ``defer_pod``: the per-generation steady-state call site.  A
+        multi-process materialization is a cross-host allgather, and
+        the steady state must stay free of host-side collectives (the
+        shard bytes are already journaled by the eviction), so pod runs
+        requeue everything here and materialize only at the explicit
+        SPMD-ordered drain points (flush, reader hydration, recovery)."""
         store = self._store
         if store is None:
             return
         from ..resilience import faults as _faults
         from ..resilience import retry as _retry
         from ..resilience.journal import IntegrityError
+        if defer_pod:
+            import jax
+            if jax.process_count() > 1:
+                return
         requeue = []
         for entry in store.take_spills():
             t = entry["t"]
@@ -896,10 +907,13 @@ class History:
         whose bytes never reached the journal).  Returns
         ``{"recovered": n, "purged": m}``."""
         from ..telemetry.metrics import REGISTRY
+        from ..resilience.journal import pod_pending
         recovered = 0
         journal = self._existing_journal()
         if journal is not None:
-            for t, entry in sorted(journal.pending().items()):
+            # pod runs journal per-host shards into sibling h<NNN>
+            # directories; pod_pending reassembles full generations
+            for t, entry in sorted(pod_pending(journal).items()):
                 row = self._lazy_flag(t)
                 if row is None or not row[0]:
                     # no lazy row to fill: either the summary row never

@@ -133,11 +133,21 @@ class TelemetryPublisher:
     def _payload(self, timeline, now: float) -> dict:
         from ..wire import transfer  # function-local: wire imports telemetry
 
+        pod = None
+        try:
+            import jax
+            if jax.process_count() > 1:
+                pod = {"process_index": jax.process_index(),
+                       "process_count": jax.process_count(),
+                       "local_devices": len(jax.local_devices())}
+        except Exception:
+            pod = None
         payload = {
             "schema_version": SCHEMA_VERSION,
             "host": self.host,
             "pid": self.pid,
             "process_index": self.process_index,
+            "pod": pod,
             "written_unix": now,
             "clock": {
                 "trace_t0_unix": spans.TRACER.t0_unix(),
@@ -308,10 +318,32 @@ def fleet_rollup(run_dir: str) -> Dict:
             "p99": _percentile(vals, 0.99),
             "n_hosts": len(vals)}
         for k, vals in sorted(per_key.items())}
+    # pod shard attribution: which SPMD process each snapshot belongs
+    # to, its own accepted total, and the collective time it burned in
+    # host-side cross-process syncs (wire_collective_seconds_total —
+    # zero in the one-dispatch steady state, by contract)
+    hosts = []
+    gens = 0
+    collective_s = 0.0
+    for s in snaps:
+        m = s.get("metrics") or {}
+        hb = s.get("heartbeat") or {}
+        pod = s.get("pod") or {}
+        c = float(m.get("wire_collective_seconds_total", 0.0))
+        collective_s += c
+        gens = max(gens, int(hb.get("generations", 0)))
+        hosts.append({"host": s["host"], "pid": s["pid"],
+                      "process_index": pod.get("process_index",
+                                               s.get("process_index")),
+                      "accepted": int(hb.get("accepted", 0)),
+                      "collective_s": c,
+                      "written_unix": s.get("written_unix")})
+    pod_hosts = max([int((s.get("pod") or {}).get("process_count", 1))
+                     for s in snaps] or [1])
     return {"n_hosts": len(snaps),
-            "hosts": [{"host": s["host"], "pid": s["pid"],
-                       "written_unix": s.get("written_unix")}
-                      for s in snaps],
+            "pod_hosts": pod_hosts,
+            "collective_s_per_gen": collective_s / gens if gens else 0.0,
+            "hosts": hosts,
             "metrics": rollup}
 
 
@@ -321,7 +353,10 @@ def render_prometheus(run_dir: str) -> str:
     ``pyabc_tpu_fleet_hosts`` gauge — the scrape surface for a whole
     run directory, complementing the per-worker exporter."""
     roll = fleet_rollup(run_dir)
-    lines = [f"pyabc_tpu_fleet_hosts {roll['n_hosts']}"]
+    lines = [f"pyabc_tpu_fleet_hosts {roll['n_hosts']}",
+             f"pyabc_tpu_fleet_pod_hosts {roll['pod_hosts']}",
+             "pyabc_tpu_fleet_collective_s_per_gen "
+             f"{roll['collective_s_per_gen']}"]
     for key, aggs in roll["metrics"].items():
         for agg in ("sum", "max", "p50", "p99"):
             lines.append(

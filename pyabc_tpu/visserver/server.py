@@ -186,12 +186,20 @@ class _Handler(BaseHTTPRequestHandler):
         hosts = []
         trajectory = []
         engine = None
+        pod_hosts = 1
         for s in snaps:
             hb = s.get("heartbeat") or {}
             m = s.get("metrics") or {}
+            pod = s.get("pod") or {}
+            pod_hosts = max(pod_hosts,
+                            int(pod.get("process_count", 1)))
             hosts.append({
                 "host": s["host"], "pid": s["pid"],
                 "alive": alive.get((s["host"], s["pid"])),
+                "process_index": pod.get("process_index"),
+                "accepted": hb.get("accepted", 0),
+                "collective_s": float(m.get(
+                    "wire_collective_seconds_total", 0.0)),
                 "generations": hb.get("generations", 0),
                 "evaluations": hb.get("evaluations", 0),
                 "acceptance_rate": hb.get("acceptance_rate", 0.0),
@@ -213,6 +221,7 @@ class _Handler(BaseHTTPRequestHandler):
                     engine = r["engine"]
         trajectory.sort(key=lambda r: (r.get("gen", -1), r["host"]))
         return {"enabled": True, "hosts": hosts,
+                "pod_hosts": pod_hosts,
                 "trajectory": trajectory, "engine": engine}
 
     def _index(self):

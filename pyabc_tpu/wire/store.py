@@ -425,9 +425,29 @@ class DeviceRunStore:
 
     @staticmethod
     def _spill_once(entry: dict, journal):
-        from ..sampler.base import fetch_to_host
+        import jax
+
+        from ..sampler.base import fetch_local_shard, fetch_to_host
         from . import transfer
 
+        if jax.process_count() > 1:
+            # pod posture: journal ONLY this host's addressable shard —
+            # the spill path must never put a cross-host collective on
+            # the steady state.  Recovery reassembles the generation
+            # host-major from the sibling per-host journals
+            # (resilience/journal.py pod_pending); the entry keeps its
+            # deposit-time GLOBAL digest so a later hydration of the
+            # full wire still manifest-verifies.
+            with transfer.egress("history"):
+                shard = fetch_local_shard(_narrow_wire(entry))
+            journal.append_payload(
+                entry["t"], shard,
+                {"n": entry["n"], "count": entry["count"],
+                 "eps": entry["eps"], "norm": entry["norm"],
+                 "shard": [jax.process_index(), jax.process_count()],
+                 "global_manifest": entry["digest"]["manifest"]})
+            entry["host_shard"] = shard
+            return
         with transfer.egress("history"):
             host_wire = fetch_to_host(_narrow_wire(entry))
         entry["digest"] = journal.append_payload(

@@ -62,7 +62,7 @@ from ..telemetry.metrics import REGISTRY
 #: ledger keys, in the order snapshots report them.  ``d2h_s`` and
 #: ``fetch_s`` read the same counter (historical alias, see module doc).
 _KEYS = ("d2h_bytes", "d2h_s", "d2h_calls", "h2d_bytes", "compute_s",
-         "fetch_s", "decode_s", "overlap_s", "rewinds")
+         "fetch_s", "decode_s", "overlap_s", "rewinds", "collective_s")
 
 #: keys reported as ints (counts, not seconds)
 _INT_KEYS = frozenset({"d2h_bytes", "d2h_calls", "h2d_bytes", "rewinds"})
@@ -85,6 +85,7 @@ _METRIC = {
     "decode_s": "wire_decode_seconds_total",
     "overlap_s": "wire_overlap_seconds_total",
     "rewinds": "wire_rewinds_total",
+    "collective_s": "wire_collective_seconds_total",
 }
 
 #: the registry lock — held by ``snapshot()`` reads and counter writes
@@ -172,6 +173,16 @@ def record_overlap(seconds: float):
 def record_rewind(count: int = 1):
     """Count speculative generations discarded by a pipeline rewind."""
     _c("wire_rewinds_total").inc(int(count))
+
+
+def record_collective(seconds: float):
+    """Charge a host-side CROSS-PROCESS synchronization (an allgather
+    assembling a globally-sharded array, a broadcast).  The pod-scale
+    contract (docs/performance.md "Pod scale") is that this counter
+    stays FLAT through an eligible run's steady state — every
+    per-generation reduction resolves on fabric; the fleet rollup
+    surfaces it as ``collective_s_per_gen``."""
+    _c("wire_collective_seconds_total").inc(float(seconds))
 
 
 def _read(key: str):

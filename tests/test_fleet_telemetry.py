@@ -110,7 +110,8 @@ def test_read_snapshots_skips_garbage(tmp_path):
 # trace merge + rollup units (single process faking two hosts)
 # ---------------------------------------------------------------------------
 
-def _fake_host(run_dir, host, t0_unix_shift, ts_us, metrics=None):
+def _fake_host(run_dir, host, t0_unix_shift, ts_us, metrics=None,
+               pod=None, heartbeat=None):
     """Plant one host's span file + snapshot with a known clock anchor."""
     d = aggregate.telemetry_dir(run_dir)
     os.makedirs(d, exist_ok=True)
@@ -124,6 +125,10 @@ def _fake_host(run_dir, host, t0_unix_shift, ts_us, metrics=None):
             "clock": {"trace_t0_unix": 1000.0 + t0_unix_shift,
                       "monotonic_offset_s": 0.0},
             "metrics": metrics or {}}
+    if pod is not None:
+        snap["pod"] = pod
+    if heartbeat is not None:
+        snap["heartbeat"] = heartbeat
     with open(os.path.join(d, f"snap_{stem}.json"), "w") as f:
         json.dump(snap, f)
 
@@ -165,6 +170,38 @@ def test_fleet_rollup_and_prometheus(tmp_path):
     text = aggregate.render_prometheus(rd)
     assert "pyabc_tpu_fleet_hosts 2" in text
     assert 'pyabc_tpu_fleet_evaluations_total{agg="sum"} 400.0' in text
+
+
+def test_fleet_rollup_pod_shard_attribution(tmp_path):
+    """Pod snapshots surface per-host shard identity, accepted share and
+    collective time; the rollup derives pod_hosts + collective_s/gen."""
+    rd = str(tmp_path)
+    for i, (acc, coll) in enumerate([(512, 0.25), (480, 0.25)]):
+        _fake_host(
+            rd, f"pod{i}", 0.0, 1.0,
+            metrics={"wire_collective_seconds_total": coll},
+            pod={"process_index": i, "process_count": 2,
+                 "local_devices": 4},
+            heartbeat={"generations": 4, "accepted": acc})
+    roll = aggregate.fleet_rollup(rd)
+    assert roll["pod_hosts"] == 2
+    assert roll["collective_s_per_gen"] == pytest.approx(0.5 / 4)
+    by_idx = {h["process_index"]: h for h in roll["hosts"]}
+    assert by_idx[0]["accepted"] == 512
+    assert by_idx[1]["accepted"] == 480
+    assert by_idx[0]["collective_s"] == pytest.approx(0.25)
+    text = aggregate.render_prometheus(rd)
+    assert "pyabc_tpu_fleet_pod_hosts 2" in text
+    assert "pyabc_tpu_fleet_collective_s_per_gen 0.125" in text
+
+
+def test_fleet_rollup_without_pod_defaults_single(tmp_path):
+    rd = str(tmp_path)
+    _fake_host(rd, "solo", 0.0, 1.0, metrics={"evaluations_total": 7})
+    roll = aggregate.fleet_rollup(rd)
+    assert roll["pod_hosts"] == 1
+    assert roll["collective_s_per_gen"] == 0.0
+    assert roll["hosts"][0]["process_index"] is None
 
 
 # ---------------------------------------------------------------------------

@@ -52,12 +52,12 @@ def test_repo_tree_is_clean():
 
 
 def test_ten_rules_registered():
-    assert len(ALL_RULES) == 12
+    assert len(ALL_RULES) == 13
     assert set(ALL_RULES) == {
         "wire-chokepoint", "no-inline-jit", "retry-sites",
         "fused-eligibility", "span-pairs", "fault-sites",
         "host-sync", "lock-discipline", "prng-keys", "env-drift",
-        "sort-discipline", "precision-policy"}
+        "sort-discipline", "precision-policy", "collective-discipline"}
 
 
 # ---------------------------------------------------------------------------
@@ -400,6 +400,24 @@ def test_host_sync_propagates_through_call_graph(tmp_path):
     assert len(findings) == 1
     assert ".item()" in findings[0].message
     assert "helper" in findings[0].message
+
+
+def test_collective_discipline_requires_reasoned_annotation(tmp_path):
+    """A bare ``# collective-ok`` is itself a finding — only a reasoned
+    annotation (or a graftlint allow) exempts a host-side sync."""
+    findings = _run_on(
+        tmp_path, "collective-discipline", "parallel/sync.py",
+        "from jax.experimental import multihost_utils\n"
+        "def a(x):\n"
+        "    return multihost_utils.process_allgather(x)\n"
+        "def b(x):\n"
+        "    return multihost_utils.process_allgather(x)"
+        "  # collective-ok\n"
+        "def c(x):\n"
+        "    return multihost_utils.process_allgather(x)"
+        "  # collective-ok: teardown flush\n")
+    assert [f.line for f in findings] == [3, 5]
+    assert "needs a reason" in findings[1].message
 
 
 def test_lock_discipline_init_and_locked_helpers_exempt(tmp_path):

@@ -65,6 +65,18 @@ WATCHED = (
     # carries + bf16 lanes): ZERO slack — this row may only ever get
     # faster; _SECONDS_FLOOR still absorbs timer noise near zero
     ("onedispatch_pop1e6_s_per_gen", "lower", 0.0),
+    # pod-scale one-dispatch (bench_podstar, 2-process jax.distributed
+    # pod): EVERY host's whole post-calibration run must stay one SPMD
+    # dispatch — the row reports the max across hosts, so any host
+    # falling back to per-block host control fails high, zero tolerance
+    ("podstar_pop1e7_dispatches_per_run", "lower", 0.0),
+    # ... and the host-side cross-process sync bill: the steady state
+    # charges NOTHING here (the stop chain is on-fabric) — the row
+    # carries only gen 0's calibration fetch and the run-end flush
+    # amortized over the generations, so growth means a per-generation
+    # host sync crept back in.  50 % slack absorbs scheduler jitter on
+    # the small setup/teardown constant it prices.
+    ("podstar_pop1e7_collective_s_per_gen", "lower", 0.50),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

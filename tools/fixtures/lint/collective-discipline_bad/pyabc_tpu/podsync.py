@@ -1,0 +1,19 @@
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.experimental.multihost_utils import process_allgather
+
+
+def per_gen_barrier():
+    multihost_utils.sync_global_devices("gen-boundary")
+
+
+def share_eps(eps):
+    return multihost_utils.broadcast_one_to_all(eps)
+
+
+def gather_counts(local):
+    return process_allgather(np.asarray(local))
+
+
+def reasonless(x):
+    return multihost_utils.process_allgather(x)  # collective-ok

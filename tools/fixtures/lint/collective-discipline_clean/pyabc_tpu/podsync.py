@@ -1,0 +1,17 @@
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.experimental.multihost_utils import process_allgather
+
+
+def setup_barrier():
+    multihost_utils.sync_global_devices(  # collective-ok: one-time mesh bring-up
+        "setup")
+
+
+def flush_populations(tree):
+    return process_allgather(tree, tiled=True)  # collective-ok: teardown flush chokepoint
+
+
+def gather_counts(local):
+    return multihost_utils.process_allgather(  # graftlint: allow(collective-discipline)
+        np.asarray(local))

@@ -1,6 +1,6 @@
 """graftlint rule modules — importing this package registers all
-twelve rules with :data:`tools.lint.core.RULES` (registration order is
-the default run order: the six ported gates first, then the new
+thirteen rules with :data:`tools.lint.core.RULES` (registration order
+is the default run order: the six ported gates first, then the new
 analyzers)."""
 
 from . import wire_chokepoint    # noqa: F401
@@ -15,3 +15,4 @@ from . import prng_keys          # noqa: F401
 from . import env_drift          # noqa: F401
 from . import sort_discipline    # noqa: F401
 from . import precision_policy   # noqa: F401
+from . import collective_discipline  # noqa: F401

@@ -1,0 +1,107 @@
+"""Rule ``collective-discipline``: no host-side cross-process sync in
+the steady state.
+
+A pod run's whole point (docs/performance.md "Pod scale") is that after
+setup every host drives the SAME SPMD program and learns everything it
+needs from ON-FABRIC collectives inside compiled code — psum'd
+acceptance counters, pmax'd eps — plus local fetches of replicated
+outputs.  A host-side barrier (``multihost_utils.sync_global_devices``),
+a host broadcast (``broadcast_one_to_all``), or a per-generation
+``process_allgather`` re-introduces exactly the cross-host
+synchronization the one-dispatch architecture removed: every host
+blocks on the slowest host's Python, once per generation, over DCN.
+
+This rule bans the ``jax.experimental.multihost_utils`` host-sync
+surface everywhere in ``pyabc_tpu/`` unless the call site is annotated
+``# collective-ok: <why>`` — reserved for setup/teardown chokepoints
+that are deliberately SPMD-ordered (the ``fetch_to_host`` d2h
+chokepoint that materializes full populations at flush boundaries, the
+run-dir stop-sentinel poll).  The annotation must carry a reason: a
+bare marker is itself a finding.
+
+Suppression: ``# collective-ok: <reason>`` on the line;
+``# graftlint: allow(collective-discipline)`` also works.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from ..core import Finding, Rule, default_package_root, register
+
+SUPPRESS = "# collective-ok"
+
+#: the host-side cross-process synchronization surface.  Matches both
+#: ``multihost_utils.f(...)`` and a bare ``f(...)`` after a
+#: ``from ... import f``.
+_SYNC = re.compile(
+    r"\b(?:(?:jax\.experimental\.)?multihost_utils\s*\.\s*)?"
+    r"(sync_global_devices|broadcast_one_to_all|process_allgather"
+    r"|assert_equal|reached_preemption_sync_point)\s*\(")
+
+#: a reasonless marker is a finding too — future readers must learn WHY
+#: this sync is exempt from the zero-steady-state-sync contract
+_SUPPRESS_WITH_REASON = re.compile(r"#\s*collective-ok\s*:\s*\S")
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def check(root: str = None) -> list:
+    """Scan ``pyabc_tpu/``; returns ``[(relpath, lineno, line), ...]``
+    violations (empty = clean)."""
+    root = _package_root(root)
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    code = line.split("#", 1)[0]
+                    if not _SYNC.search(code):
+                        continue
+                    if SUPPRESS in line:
+                        if _SUPPRESS_WITH_REASON.search(line):
+                            continue
+                        violations.append(
+                            (rel, lineno,
+                             line.rstrip()
+                             + "  [collective-ok needs a reason]"))
+                        continue
+                    violations.append((rel, lineno, line.rstrip()))
+    violations.sort(key=lambda v: (v[0], v[1]))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("collective discipline: clean (no unannotated host-side "
+              "cross-process sync)")
+        return 0
+    print("host-side cross-process synchronization outside an annotated "
+          f"setup/teardown chokepoint (justify with '{SUPPRESS}: "
+          "<why>'):")
+    for rel, lineno, line in violations:
+        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
+    return 1
+
+
+@register
+class CollectiveDisciplineRule(Rule):
+    id = "collective-discipline"
+    description = ("no host-side cross-process sync (multihost_utils) "
+                   "outside '# collective-ok: <why>' chokepoints")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, line.strip())
+                for rel, lineno, line in check(tree.package_root)]
