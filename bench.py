@@ -47,6 +47,12 @@ is one-off).
   SINGLE device program with the stop chain evaluated on device;
   ``dispatches_per_run`` must read 1 and
   ``control_roundtrip_s_per_gen`` prices the residual control plane
+- ``onedispatch_pop1e6_lanes_overhead_pct`` /
+  ``onedispatch_pop1e6_telemetry_egress_mb`` — the ``lanes``
+  sub-bench: in-dispatch telemetry lanes + live progress priced as a
+  lanes-on vs lanes-off A/B in one process, plus the ``tl_*`` drain's
+  ``egress("telemetry")`` bill (docs/observability.md "Inside the
+  dispatch")
 - ``posterior_gate_*``     — the repeatable 1e6 adaptive posterior-
   exactness gate (tools/verify_northstar_posterior.py): perf work
   cannot silently trade statistical bias
@@ -483,6 +489,72 @@ def bench_onedispatch():
     }
 
 
+def bench_lanes():
+    """In-dispatch observability pricing (docs/observability.md "Inside
+    the dispatch"): the north-star one-dispatch run twice in ONE
+    process — telemetry lanes + progress callback OFF, then ON — so the
+    relay weather cancels out of the comparison.
+
+    Acceptance artifacts, both watched fail-high by the sentinel:
+    ``onedispatch_pop1e6_lanes_overhead_pct`` (lanes-on vs lanes-off
+    steady-state s/gen, compile backed out of each wall — the <2 %%
+    budget with measurement slack) and
+    ``onedispatch_pop1e6_telemetry_egress_mb`` (the ``tl_*`` lane
+    drain's ``egress("telemetry")`` bill — O(24 B)/gen by contract, so
+    MB-scale growth means the lanes stopped being scalar)."""
+    import pyabc_tpu as pt
+    from pyabc_tpu.autotune import compile_counters, compile_delta
+    from pyabc_tpu.models import make_two_gaussians_problem
+
+    def one(lanes_on):
+        models, priors, distance, observed, _ = \
+            make_two_gaussians_problem()
+        abc = pt.ABCSMC(
+            models, priors, distance,
+            population_size=NORTHSTAR_POP,
+            eps=pt.ConstantEpsilon(0.2),
+            sampler=pt.VectorizedSampler(max_batch_size=1 << 19,
+                                         max_rounds_per_call=16),
+            stores_sum_stats=False,
+            fuse_generations=4,
+            run_mode="onedispatch",
+            seed=0)
+        abc.telemetry_lanes = lanes_on
+        abc.new("sqlite://", observed)
+        eg0 = _egress_mb()
+        cc0 = compile_counters()
+        t0 = time.perf_counter()
+        abc.run(max_nr_populations=1 + ONEDISPATCH_GENS)
+        wall = time.perf_counter() - t0
+        cc = compile_delta(cc0)
+        eg = {k: v - eg0.get(k, 0.0) for k, v in _egress_mb().items()}
+        gens = sum(1 for r in abc.timeline.to_rows()
+                   if r.get("path") == "onedispatch")
+        spg = (max(wall - cc["compile_s"], 0.0) / gens) if gens else None
+        return spg, eg, gens, abc
+
+    spg_off, _, gens_off, _ = one(False)
+    spg_on, eg_on, gens_on, abc_on = one(True)
+    overhead = (None if not spg_off or spg_on is None
+                else round((spg_on - spg_off) / spg_off * 100.0, 2))
+    out = {
+        "onedispatch_pop1e6_lanes_overhead_pct": overhead,
+        "onedispatch_pop1e6_telemetry_egress_mb": round(
+            eg_on.get("telemetry", 0.0), 6),
+        "lanes_s_per_gen_off": (None if spg_off is None
+                                else round(spg_off, 2)),
+        "lanes_s_per_gen_on": (None if spg_on is None
+                               else round(spg_on, 2)),
+        "lanes_generations": gens_on,
+    }
+    # per-phase attribution medians from the lanes-on run — the
+    # "where did the dispatch's wall go" answer the lanes exist for
+    out.update({f"lanes_{k}": v
+                for k, v in abc_on.timeline.summary().items()
+                if k.startswith("ph_")})
+    return out
+
+
 def bench_kernel():
     """Speed-of-light kernel row (docs/performance.md "Speed of
     light"): the north-star one-dispatch run with the in-scan kernel
@@ -640,8 +712,8 @@ def _bench_problem(make_problem, pop, prefix):
 
 
 SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
-               "kernel", "posterior_gate", "lotka_volterra", "sir",
-               "petab_ode", "sharded_mesh1", "ab_vec_sharded",
+               "kernel", "lanes", "posterior_gate", "lotka_volterra",
+               "sir", "petab_ode", "sharded_mesh1", "ab_vec_sharded",
                "sharded_cpu8", "podstar")
 
 
@@ -906,6 +978,8 @@ def _run_sub(name: str) -> dict:
         return bench_onedispatch()
     if name == "kernel":
         return bench_kernel()
+    if name == "lanes":
+        return bench_lanes()
     if name == "posterior_gate":
         # the 1e6 adaptive posterior-exactness gate (BASELINE.md
         # "Correctness at scale", now repeatable): perf work cannot
@@ -1022,7 +1096,8 @@ def main():
     compact = {k: v for k, v in sorted(extra.items())
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
-                                "onedispatch_", "kernel_", "podstar_",
+                                "onedispatch_", "kernel_", "lanes_",
+                                "podstar_",
                                 "posterior_gate_",
                                 "telemetry_", "resilience_",
                                 "checkpoint_", "store_", "lint_"))

@@ -189,6 +189,20 @@ def _render_top(run_dir) -> str:
         f"fleet: hosts={len(snaps)} gens={tot['generations']} "
         f"evals={tot['evaluations']} acc_rate={acc_rate:.4g} "
         f"d2h={tot['d2h_mb']:.2f}MB engine={engine or '-'}")
+    # the in-dispatch progress word (telemetry/lanes.py): while a
+    # one-dispatch run is in flight the heartbeat generation counters
+    # freeze, but this line keeps ticking from the device callbacks
+    from ..telemetry.lanes import merge_progress
+    prog = merge_progress([s.get("run_progress") for s in snaps])
+    if prog is not None and prog.get("active"):
+        eps_p = prog.get("eps")
+        lines.append(
+            f"in-dispatch: gen={prog.get('gen')} "
+            f"done={prog.get('gens_done')}/{prog.get('t_limit')} "
+            f"eps={'-' if eps_p is None else format(eps_p, '.4g')} "
+            f"acc={prog.get('accepted', '-')} "
+            f"rounds={prog.get('rounds', 0)} "
+            f"hosts={prog.get('hosts_active', 1)}")
     # pod shard attribution (SPMD multi-process runs): which process
     # each snapshot is, its accepted share, and the host-side
     # collective time — flat zero in the one-dispatch steady state

@@ -270,7 +270,8 @@ def _build_one_gen(
         adaptive_cfg: Optional[dict] = None,
         stoch_cfg: Optional[dict] = None,
         summary_lanes: bool = False,
-        eps_sketch: bool = False):
+        eps_sketch: bool = False,
+        telemetry_lanes: bool = False):
     """Shared per-generation body behind :func:`build_fused_generations`
     (which scans it K times) and :func:`build_onedispatch_run` (which
     wraps those scans in a device-side stopping ``while_loop``).
@@ -315,6 +316,16 @@ def _build_one_gen(
     capped = support_cap is not None and n_target > support_cap
     rounds_hi = float(max_rounds)
     rounds_lo = min(2.0, rounds_hi)
+    tl_cost = None
+    if telemetry_lanes:
+        # static per-phase cost factors: lanes are pure functions of the
+        # dynamic round count and these constants, so enabling them
+        # cannot perturb the population math (telemetry/lanes.py)
+        from ..telemetry.lanes import phase_cost_model
+        tl_cost = phase_cost_model(
+            B=B, n_target=n_target, d=d, s=s, M=M, eps_mode=eps_mode,
+            support_rows=(support_cap if capped else n_target),
+            adaptive=adaptive)
 
     def one_gen(carry, gen_key, final_flag=None, live=None):
         m0, theta0, lw0, dist0, count0, eps0 = (
@@ -571,6 +582,13 @@ def _build_one_gen(
             # the population lanes device-resident (wire/store.py)
             wire.update(_summary_wire_lanes(
                 m1, theta1, dist1, lw1, valid1, M))
+        if telemetry_lanes:
+            # O(bytes) in-dispatch telemetry: per-generation simulation
+            # count + per-phase work-unit vector (telemetry/lanes.py) —
+            # drained under egress("telemetry"), never decoded as
+            # population data
+            from ..telemetry.lanes import phase_wire_lanes
+            wire.update(phase_wire_lanes(rounds1, B, tl_cost))
         return new_carry, wire
 
     return one_gen
@@ -625,7 +643,8 @@ def build_fused_generations(
         adaptive_cfg: Optional[dict] = None,
         stoch_cfg: Optional[dict] = None,
         summary_lanes: bool = False,
-        eps_sketch: bool = False):
+        eps_sketch: bool = False,
+        telemetry_lanes: bool = False):
     """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
     for K generations.  ``carry`` = the previous generation's accepted
     population on device: dict(m[i32 n], theta[f32 n,d], log_weight
@@ -675,7 +694,7 @@ def build_fused_generations(
         raw_round, support_cap=support_cap,
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
         stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
-        eps_sketch=eps_sketch)
+        eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes)
     stoch = stoch_cfg is not None
 
     def one_generation(carry, xs):
@@ -720,7 +739,9 @@ def build_onedispatch_run(
         adaptive_cfg: Optional[dict] = None,
         stoch_cfg: Optional[dict] = None,
         summary_lanes: bool = False,
-        eps_sketch: bool = False):
+        eps_sketch: bool = False,
+        telemetry_lanes: bool = False,
+        progress: bool = False):
     """Whole-run driver with DEVICE-side stopping: a ``lax.while_loop``
     over K-generation ``lax.scan`` blocks of the same per-generation
     body as :func:`build_fused_generations`, whose predicate evaluates
@@ -755,6 +776,13 @@ def build_onedispatch_run(
     ``max_T`` and ``single_model_stop`` are static (program shape);
     everything in ``ctl`` is traced, so one compiled program serves
     every run at the same (rung, max_T).
+
+    ``telemetry_lanes`` rides ``tl_*`` wire lanes through the egress
+    slots (telemetry/lanes.py); ``progress`` plants an unordered
+    ``jax.debug.callback`` at each generation boundary that advances
+    the process-global progress word — the host's only window into the
+    in-flight while-loop.  Both are static program-shape flags; False
+    compiles the exact pre-lanes program.
     """
     one_gen = _build_one_gen(
         kernel, bandwidth_selectors, scalings, dims, n_target, B,
@@ -763,7 +791,9 @@ def build_onedispatch_run(
         raw_round, support_cap=support_cap,
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
         stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
-        eps_sketch=eps_sketch)
+        eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes)
+    if progress:
+        from ..telemetry.lanes import device_progress_update
     M = kernel.M
     stoch = stoch_cfg is not None
     temperature = eps_mode == "temperature"
@@ -852,6 +882,15 @@ def build_onedispatch_run(
                      for k in wire}
             bufs1["live"] = bufs["live"].at[idx].set(1, mode="drop")
             t1 = t + written.astype(jnp.int32)
+            if progress:
+                # the in-dispatch progress channel: an unordered host
+                # callback with O(scalar) operands — the ONLY way any
+                # value escapes a running while_loop (every buffer read
+                # blocks until the whole dispatch returns).  Pure
+                # observation: nothing here feeds back into the trace.
+                jax.debug.callback(device_progress_update, t1, eps_t,
+                                   count1, rounds_tot1, written,
+                                   ordered=False)
             return (pop1, t1, new_code, stop_t1, stop_count1,
                     rounds_tot1, bufs1), None
 

@@ -45,8 +45,8 @@ from .sampler.rounds import RoundKernel
 from .storage.history import PRE_TIME, History
 from .sumstat import SumStatSpec
 from .telemetry import GenerationTimeline, aggregate as _aggregate, \
-    flight as _flight, metrics as _metrics, profile_generation, \
-    spans as _spans
+    flight as _flight, lanes as _lanes, metrics as _metrics, \
+    profile_generation, spans as _spans
 from .transition import MultivariateNormalTransition, Transition
 from .weighted_statistics import effective_sample_size
 from .wire import store as _wire_store
@@ -250,6 +250,17 @@ class ABCSMC:
         #: $PYABC_TPU_ONEDISPATCH_MAX_T (default 32).
         self.onedispatch_max_t = max(1, int(os.environ.get(
             "PYABC_TPU_ONEDISPATCH_MAX_T", "32")))
+        #: in-dispatch observability (telemetry/lanes.py): when on, the
+        #: one-dispatch program carries O(scalar) telemetry lanes
+        #: (``tl_*`` wire keys: cumulative sims + per-phase work units)
+        #: drained under ``egress("telemetry")``, and plants an
+        #: unordered debug callback per written generation that advances
+        #: the host-pollable progress word — ``abc-top --watch`` and the
+        #: visserver live card show generations ticking DURING the
+        #: dispatch.  Lanes are pure functions of the already-carried
+        #: round counter, so populations stay bit-identical either way.
+        #: Defers to $PYABC_TPU_TELEMETRY_LANES (default on).
+        self.telemetry_lanes = _lanes.lanes_enabled()
         #: donated carry layout: the fused-block and one-dispatch
         #: programs take their population carry with
         #: ``donate_argnums=(0,)``, so the cap-sized buffers update in
@@ -1443,13 +1454,14 @@ class ABCSMC:
             norms = self.acceptor.pdf_norms
             pdf_norm = float(norms.get(t, norms[max(norms)]
                                        if norms else 0.0))
-        cache_key = ("onedispatch2", self._kernel._uid, samp._uid, B,
+        lanes_on = bool(self.telemetry_lanes)
+        cache_key = ("onedispatch3", self._kernel._uid, samp._uid, B,
                      n, K, max_T, d, s_width, eps_mode, alpha, mult,
                      weighted, eps_sketch, wire_stats, wire_m_bits,
                      max_rounds, sup_cap, mode["adaptive"],
                      mode["stoch"], record_rows, pdf_norm,
                      single_model_stop, bool(summary),
-                     self._donate_carry)
+                     self._donate_carry, lanes_on)
 
         def build():
             from .autotune.ladder import aot_compile, avals_like
@@ -1498,7 +1510,8 @@ class ABCSMC:
                 rate_pred_factor=(alpha if eps_mode == "quantile"
                                   else 1.0),
                 adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
-                summary_lanes=bool(summary), eps_sketch=eps_sketch),
+                summary_lanes=bool(summary), eps_sketch=eps_sketch,
+                telemetry_lanes=lanes_on, progress=lanes_on),
                 **self._donate_jit_kwargs())
             if aot_args is not None:
                 try:
@@ -1525,8 +1538,11 @@ class ABCSMC:
         narrow wire plus the ``live`` stop-sentinel lane (0 = the
         device stopped before writing this slot).  Matches the
         GenStream 4-tuple contract with the payload widened to
-        ``(payload, live)`` so the drain loop terminates on the
-        sentinel instead of a host-known T; a dead slot costs one
+        ``(payload, live, tl)`` so the drain loop terminates on the
+        sentinel instead of a host-known T and receives the O(scalar)
+        ``tl_*`` telemetry lanes (drained under ``egress("telemetry")``
+        — telemetry/lanes.py) without touching the positional layout
+        ``drain_rounds``/``result`` rely on; a dead slot costs one
         O(4 B) control fetch and deposits nothing."""
         from .sampler.base import fetch_to_host
         from .wire import transfer as _transfer
@@ -1537,6 +1553,16 @@ class ABCSMC:
         def fetch(k, gen_wire, n_rows):
             gen_wire = dict(gen_wire)
             live_lane = gen_wire.pop("live")
+            tl_dev = {key: gen_wire.pop(key) for key in list(gen_wire)
+                      if key.startswith(_lanes.LANE_PREFIX)}
+
+            def drain_tl():
+                if not tl_dev:
+                    return None
+                with _transfer.egress("telemetry"):
+                    tl_out = fetch_to_host(tl_dev)
+                return {key: np.asarray(v) for key, v in tl_out.items()}
+
             if lazy:
                 small = {key: gen_wire[key]
                          for key in _wire_store.SUMMARY_LANE_KEYS
@@ -1548,21 +1574,21 @@ class ABCSMC:
                 with _transfer.egress("summary"):
                     out = fetch_to_host(small)
                 if not int(np.asarray(out.pop("live"))):
-                    return (None, 0), 0, 0, None
+                    return (None, 0, None), 0, 0, None
                 count = int(np.asarray(out["count"]))
                 rounds = int(np.asarray(out["rounds"]))
                 eps = (float(np.asarray(out["eps"], dtype=np.float64))
                        if "eps" in out else None)
                 store.deposit(t0 + k, gen_wire, n=n_rows, count=count,
                               eps=eps, norm="stream")
-                return ((_wire_store.summary_from_lanes(out), 1),
-                        count, rounds, eps)
+                return ((_wire_store.summary_from_lanes(out), 1,
+                         drain_tl()), count, rounds, eps)
             with _transfer.egress("control"):
                 live = int(np.asarray(fetch_to_host(live_lane)))
             if not live:
-                return (None, 0), 0, 0, None
+                return (None, 0, None), 0, 0, None
             payload, count, rounds, eps = _fetch_gen(gen_wire, n_rows)
-            return (payload, 1), count, rounds, eps
+            return (payload, 1, drain_tl()), count, rounds, eps
 
         return fetch
 
@@ -1639,6 +1665,27 @@ class ABCSMC:
         fn = self._get_run_fn(t, n, B, K, max_T, summary=lazy,
                               aot_args=None if self._pod_active
                               else args)
+        # arm the in-dispatch progress word BEFORE the dispatch: the
+        # compiled program's debug callbacks advance it while the run
+        # is in flight, and the poller thread publishes fleet snapshots
+        # the main thread (blocked in the first egress fetch) cannot
+        lanes_on = bool(self.telemetry_lanes)
+        poller = None
+        if lanes_on:
+            _lanes.PROGRESS.begin(
+                t0=t, t_limit=t_limit,
+                run_id=getattr(self.history, "id", None))
+            if self._fleet is not None:
+                poller = _lanes.ProgressPoller(
+                    lambda: self._fleet.publish(
+                        self.timeline, force=True)).start()
+
+        def _progress_done():
+            if poller is not None:
+                poller.stop()
+            if lanes_on:
+                _lanes.PROGRESS.finish()
+
         dispatch_mark = _time.perf_counter()
         try:
             with profile_generation(t), \
@@ -1651,6 +1698,7 @@ class ABCSMC:
                 "one-dispatch run failed after retries (%s): degrading "
                 "to the per-block paths for this run", err)
             self._fault_onedispatch_off = True
+            _progress_done()
             return 0, 0, None
         dispatch_s = _time.perf_counter() - dispatch_mark
         self.run_dispatches += 1
@@ -1673,6 +1721,7 @@ class ABCSMC:
         drain_error = None
         append_s_total = 0.0
         gen_meta = []  # (eps, accepted, evals, rounds) per written gen
+        tl_meta = []  # per-gen tl_* lane dict (or None) per written gen
         pop_k = None
         try:
             for k in range(max_T):
@@ -1692,8 +1741,8 @@ class ABCSMC:
                     break
                 _faults.fault_point(_faults.SITE_DRAIN, data={"t": t_k})
                 with _spans.span("onedispatch.ingest", gen=t_k):
-                    (payload_k, live_k), count_k, rounds_k, eps_raw = \
-                        stream.result()
+                    (payload_k, live_k, tl_k), count_k, rounds_k, \
+                        eps_raw = stream.result()
                 if not live_k:
                     break  # the device stop sentinel
                 evals_k = rounds_k * B
@@ -1742,6 +1791,7 @@ class ABCSMC:
                             stat_spec=self.spec.shapes)
                 append_s_total += _time.perf_counter() - append_mark
                 gen_meta.append((eps_k, count_k, evals_k, rounds_k))
+                tl_meta.append(tl_k)
                 if eps_mode == "quantile":
                     self.eps._look_up[t_k] = eps_k
                 elif eps_mode == "temperature":
@@ -1758,6 +1808,7 @@ class ABCSMC:
             # total, and a stopped run's tail slots were never written
             stream.abandon()
             engine.close()
+            _progress_done()
 
         # the O(bytes) control packet: why/when the device stopped.
         # Fetched AFTER the drain so the wait for the device program
@@ -1810,33 +1861,47 @@ class ABCSMC:
             run_dt = _time.perf_counter() - t0_run
             tr_delta = _transfer.delta(tr0_run)
             cc_delta = _compile_delta(cc0_run)
+            # per-generation shares: rounds-weighted when the device
+            # lanes reported them (a hard generation that burned 10x
+            # the rounds gets 10x the wall), uniform otherwise — the
+            # pre-lanes behaviour
+            rounds_sum = float(sum(gm[3] for gm in gen_meta))
             for k in range(written):
-                self.generation_wall_clock[t + k] = run_dt / written
+                rounds_k = gen_meta[k][3]
+                share = (rounds_k / rounds_sum if rounds_sum > 0
+                         else 1.0 / written)
+                wall_k = run_dt * share
+                self.generation_wall_clock[t + k] = wall_k
                 self.generation_transfer[t + k] = {
-                    key: v / written for key, v in tr_delta.items()}
+                    key: v * share for key, v in tr_delta.items()}
                 eps_k, count_k, evals_k, rounds_k = gen_meta[k]
+                tl_k = tl_meta[k] if k < len(tl_meta) else None
+                phases_k = None
+                if tl_k is not None and "tl_phase" in tl_k:
+                    phases_k = _lanes.attribute_phases(
+                        tl_k["tl_phase"], wall_k)
                 self.timeline.record(
                     t + k, path="onedispatch",
-                    wall_s=run_dt / written,
+                    wall_s=wall_k,
                     stages={
-                        "dispatch": dispatch_s / written,
-                        "compute": tr_delta["compute_s"] / written,
-                        "fetch": tr_delta["fetch_s"] / written,
-                        "decode": tr_delta["decode_s"] / written,
-                        "append": append_s_total / written,
+                        "dispatch": dispatch_s * share,
+                        "compute": tr_delta["compute_s"] * share,
+                        "fetch": tr_delta["fetch_s"] * share,
+                        "decode": tr_delta["decode_s"] * share,
+                        "append": append_s_total * share,
                     },
                     eps=eps_k, accepted=count_k, total=evals_k,
-                    overlap_s=tr_delta["overlap_s"] / written,
+                    overlap_s=tr_delta["overlap_s"] * share,
                     compile_s=(cc_delta["compile_s"] if k == 0 else 0.0),
                     n_compiles=(cc_delta["n_compiles"] if k == 0 else 0),
-                    engine="onedispatch")
+                    engine="onedispatch", phases=phases_k)
                 _metrics.record_generation(
                     evals_k, count_k, count_k / max(evals_k, 1),
-                    rounds=rounds_k, wall_s=run_dt / written)
+                    rounds=rounds_k, wall_s=wall_k)
                 samp.observe_generation(
                     count_k, evals_k, rounds=rounds_k,
-                    compute_s=tr_delta["compute_s"] / written,
-                    overlap_s=tr_delta["overlap_s"] / written)
+                    compute_s=tr_delta["compute_s"] * share,
+                    overlap_s=tr_delta["overlap_s"] * share)
             if self._fleet is not None:
                 self._fleet.publish(self.timeline)
             last_pop = pop_k

@@ -45,10 +45,12 @@ from __future__ import annotations
 import json
 import os
 import socket
+import threading
 import time
 from typing import Dict, List, Optional
 
 from . import spans
+from .lanes import PROGRESS, merge_progress
 from .metrics import REGISTRY, heartbeat_summary
 
 #: bump when the snapshot payload shape changes; consumers check this
@@ -89,10 +91,20 @@ class TelemetryPublisher:
     boundaries on every run path; it is throttled to at most one write
     per ``min_interval_s`` unless forced (run end), so pod-scale fleets
     do not grind the shared filesystem at sub-second generation rates.
+
+    ``publish()`` is thread-safe: during a one-dispatch run the
+    :class:`~.lanes.ProgressPoller` thread force-publishes concurrently
+    with the main thread's generation-boundary calls, and both target
+    the same snapshot path — the write lock keeps the tmp-then-replace
+    dance atomic per caller.
     """
+
+    #: lock-discipline contract, enforced by `abc-lint`
+    _GUARDED_BY = {"_last_write": "_write_lock"}
 
     def __init__(self, run_dir: str, min_interval_s: float = 1.0,
                  process_index: Optional[int] = None):
+        self._write_lock = threading.Lock()
         self.run_dir = run_dir
         self.min_interval_s = float(min_interval_s)
         self.process_index = process_index
@@ -116,19 +128,20 @@ class TelemetryPublisher:
         a write happened (throttled calls return False).  Never raises:
         a shared-filesystem hiccup must not kill the run it observes."""
         now = time.time()
-        if not force and now - self._last_write < self.min_interval_s:
-            return False
-        try:
-            payload = self._payload(timeline, now)
-            tmp = self.snap_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(payload, f)
-            os.replace(tmp, self.snap_path)  # atomic on POSIX
-            spans.TRACER.flush()
-        except Exception:
-            return False
-        self._last_write = now
-        return True
+        with self._write_lock:
+            if not force and now - self._last_write < self.min_interval_s:
+                return False
+            try:
+                payload = self._payload(timeline, now)
+                tmp = self.snap_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, self.snap_path)  # atomic on POSIX
+                spans.TRACER.flush()
+            except Exception:
+                return False
+            self._last_write = now
+            return True
 
     def _payload(self, timeline, now: float) -> dict:
         from ..wire import transfer  # function-local: wire imports telemetry
@@ -159,6 +172,10 @@ class TelemetryPublisher:
             "wire": transfer.snapshot(),
             "egress": transfer.egress_breakdown(),
             "heartbeat": heartbeat_summary(),
+            # the in-dispatch progress word (telemetry/lanes.py): lets
+            # readers show generations advancing while this host is
+            # still inside a one-dispatch call; None outside such runs
+            "run_progress": PROGRESS.read(),
         }
         if timeline is not None:
             rows = timeline.to_rows()
@@ -337,13 +354,18 @@ def fleet_rollup(run_dir: str) -> Dict:
                                                s.get("process_index")),
                       "accepted": int(hb.get("accepted", 0)),
                       "collective_s": c,
-                      "written_unix": s.get("written_unix")})
+                      "written_unix": s.get("written_unix"),
+                      "run_progress": s.get("run_progress")})
     pod_hosts = max([int((s.get("pod") or {}).get("process_count", 1))
                      for s in snaps] or [1])
     return {"n_hosts": len(snaps),
             "pod_hosts": pod_hosts,
             "collective_s_per_gen": collective_s / gens if gens else 0.0,
             "hosts": hosts,
+            # the fleet-merged in-dispatch progress word (lanes.py):
+            # pod processes run in lockstep, so one word speaks for all
+            "run_progress": merge_progress(
+                [s.get("run_progress") for s in snaps]),
             "metrics": rollup}
 
 
@@ -357,6 +379,17 @@ def render_prometheus(run_dir: str) -> str:
              f"pyabc_tpu_fleet_pod_hosts {roll['pod_hosts']}",
              "pyabc_tpu_fleet_collective_s_per_gen "
              f"{roll['collective_s_per_gen']}"]
+    prog = roll.get("run_progress")
+    if prog is not None:
+        lines += [
+            "pyabc_tpu_fleet_run_progress_active "
+            f"{1 if prog.get('active') else 0}",
+            f"pyabc_tpu_fleet_run_progress_gen {prog.get('gen', 0)}",
+            "pyabc_tpu_fleet_run_progress_gens_done "
+            f"{prog.get('gens_done', 0)}",
+            "pyabc_tpu_fleet_run_progress_rounds "
+            f"{prog.get('rounds', 0)}",
+        ]
     for key, aggs in roll["metrics"].items():
         for agg in ("sum", "max", "p50", "p99"):
             lines.append(

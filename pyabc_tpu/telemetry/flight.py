@@ -22,9 +22,10 @@ PR 2 is asserted in ``tests/test_fleet_telemetry.py``.
 ``PYABC_TPU_FLIGHT=0`` disables recording entirely (note() and dump()
 become no-ops).  Dumps land in the run directory when one is advertised
 (next to the aggregator's files), else ``$PYABC_TPU_FLIGHT_DIR``, else
-the working directory.  Repeat dumps for one run overwrite the same
-file — the last writer has the most context, and the ring persists
-across dumps.
+a per-user ``pyabc_tpu_flight`` directory under the system temp dir —
+never the working directory, so a crash can't litter a source
+checkout.  Repeat dumps for one run overwrite the same file — the last
+writer has the most context, and the ring persists across dumps.
 
 Leaf-package rule: wire/parallel imports are function-local.
 """
@@ -110,7 +111,21 @@ class FlightRecorder:
         d = health.run_dir()
         if d:
             return d
-        return os.environ.get(FLIGHT_DIR_ENV) or os.getcwd()
+        explicit = os.environ.get(FLIGHT_DIR_ENV)
+        if explicit:
+            return explicit
+        # no run dir and no explicit override: a stable per-user temp
+        # location, NOT the CWD (dumps from ad-hoc runs used to land in
+        # whatever directory the process started in — repo roots
+        # included)
+        import getpass
+        import tempfile
+        try:
+            user = getpass.getuser()
+        except Exception:
+            user = str(os.getuid()) if hasattr(os, "getuid") else "user"
+        return os.path.join(tempfile.gettempdir(),
+                            f"pyabc_tpu_flight_{user}")
 
     def _span_tail(self) -> list:
         t0 = spans.TRACER._t0
@@ -152,6 +167,11 @@ class FlightRecorder:
                 "egress": transfer.egress_breakdown(),
                 "recent_spans": self._span_tail(),
             }
+            # the last-polled in-dispatch progress word: a kill -9
+            # flight dump says exactly which generation died even
+            # though the one-dispatch run never returned
+            from .lanes import PROGRESS
+            payload["run_progress"] = PROGRESS.read()
             if self._timeline is not None:
                 payload["timeline_tail"] = self._timeline.to_rows()[-64:]
             d = directory or self._dump_dir()

@@ -1,0 +1,321 @@
+"""Device-resident telemetry lanes + the live in-dispatch progress word.
+
+A ``run_mode="onedispatch"`` run is ONE ``lax.while_loop`` dispatch
+(sampler/fused.py:build_onedispatch_run), so the host-side telemetry
+stack — span tracer, GenerationTimeline, fleet snapshots — sees a
+multi-minute run as a single opaque span.  This module is the
+in-dispatch half of the observability story, in two parts:
+
+**Telemetry lanes** (``tl_*`` wire lanes).  :func:`phase_wire_lanes` is
+a traceable function the fused per-generation body calls after its
+rejection loop: it emits per-generation work counters — simulations,
+and a per-phase work-unit vector over :data:`PHASES` (simulate /
+distance / eps-solve / refit / resample) — as extra wire lanes riding
+the same ``[max_T]``-slot egress buffers as the population wire.  Every
+lane is a pure arithmetic function of values the program already
+computes (the round count is the only dynamic input), so lanes-on and
+lanes-off programs produce BIT-IDENTICAL populations: no RNG ops, no
+reductions over population data, nothing feeding back into the math.
+The drain fetches them under ``wire.transfer.egress("telemetry")``
+(O(bytes) per generation) and :func:`attribute_phases` normalizes the
+work-unit vector onto the generation's measured wall to hydrate the
+timeline's per-phase columns.
+
+Honesty note: XLA exposes no per-op device clocks inside a compiled
+while-loop, so per-phase *cycle* attribution is a device-exact work
+model (dynamic round counts x static per-phase cost factors derived
+from the program shape), normalized onto measured wall seconds — the
+same flops-proportional attribution a profiler cost model uses, not a
+hardware timer.  The counters themselves (rounds, simulations,
+accepted, eps) are exact.
+
+**Progress word** (:data:`PROGRESS`).  The only host-visible channel
+out of an in-flight dispatch is a host callback: any device buffer read
+blocks until the whole while-loop completes, so the one-dispatch driver
+plants a ``jax.debug.callback`` at each generation boundary that calls
+:func:`device_progress_update` with the generation index, epsilon,
+accepted count and cumulative rounds.  The callback writes this
+process-global word (a lock-guarded dict — the callback must stay
+microseconds-cheap); nothing blocks on the run future.  A
+:class:`ProgressPoller` daemon thread samples the word every
+``$PYABC_TPU_PROGRESS_POLL_S`` seconds (default 0.5) and force-writes
+the fleet snapshot, so ``abc-top --watch``, ``/api/fleet`` and the
+Prometheus exposition show generation-level progress *during* the
+dispatch; on pods every process publishes its own word and the reader
+side merges them (:func:`merge_progress`).  The flight recorder embeds
+the last word in its dump, so a ``kill -9`` post-mortem names the
+generation that died.
+
+Leaf-package rule: telemetry imports nothing from wire/parallel at
+module level; jax is imported function-locally (the host-side helpers
+must work in processes that never touch jax).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+#: phases of one fused generation, in program order.  ``simulate`` and
+#: ``distance`` scale with the rejection rounds; ``eps_solve`` /
+#: ``refit`` / ``resample`` are once-per-generation adaptation work.
+PHASES = ("simulate", "distance", "eps_solve", "refit", "resample")
+
+#: wire-lane prefix; the store/drain exclude ``tl_*`` lanes from
+#: population decode exactly like the ``sm_*`` summary lanes
+LANE_PREFIX = "tl_"
+
+#: polling cadence of the in-dispatch progress publisher (seconds)
+POLL_ENV = "PYABC_TPU_PROGRESS_POLL_S"
+
+#: master switch for the device lanes + progress callback (default on);
+#: "0" compiles the exact pre-lanes program — the disabled-overhead gate
+LANES_ENV = "PYABC_TPU_TELEMETRY_LANES"
+
+
+def lanes_enabled() -> bool:
+    """Whether device telemetry lanes (and the in-dispatch progress
+    callback) are compiled into one-dispatch programs."""
+    return os.environ.get(LANES_ENV, "1") not in ("0", "false", "no")
+
+
+def poll_interval_s() -> float:
+    try:
+        return max(float(os.environ.get(POLL_ENV, "0.5")), 0.05)
+    except ValueError:
+        return 0.5
+
+
+# ------------------------------------------------------------- device side
+
+def phase_cost_model(*, B: int, n_target: int, d: int, s: int, M: int,
+                     eps_mode: str, support_rows: int,
+                     adaptive: bool) -> Dict[str, float]:
+    """Static per-phase cost factors for one generation, derived from
+    the program shape (batch ``B``, population ``n_target``, parameter
+    dim ``d``, summary-stat width ``s``, ``M`` models, the epsilon mode
+    and the refit support size).  Units are arbitrary work units — only
+    the RATIOS matter, because :func:`attribute_phases` normalizes onto
+    the measured wall.  Factors marked ``per_round`` multiply the
+    generation's dynamic round count on device."""
+    sup = max(int(support_rows), 1)
+    model = {
+        # one proposal + forward simulation per candidate per round
+        "simulate": {"per_round": float(B) * max(s, 1), "fixed": 0.0},
+        # distance kernel over the candidate stats per round
+        "distance": {"per_round": float(B) * max(s, 1), "fixed": 0.0},
+        # weighted quantile: O(n log n) sort (or O(n) sketch, but the
+        # ratio distinction is below attribution noise); temperature:
+        # bisection over the record ring; constant: free
+        "eps_solve": {"per_round": 0.0,
+                      "fixed": (0.0 if eps_mode == "constant"
+                                else float(n_target)
+                                * max(math.log2(max(n_target, 2)), 1.0))},
+        # per-model KDE covariance + cholesky over the (possibly
+        # capped) support; an adaptive distance refit rides here too
+        "refit": {"per_round": 0.0,
+                  "fixed": (float(M) * sup * d * d
+                            + (float(B) * max(s, 1) if adaptive
+                               else 0.0))},
+        # deferred proposal-density correction: accepted rows x support
+        "resample": {"per_round": 0.0,
+                     "fixed": float(n_target) * sup * max(d, 1)},
+    }
+    return model
+
+
+def phase_wire_lanes(rounds, B: int, cost_model: Dict[str, dict]):
+    """Traceable ``tl_*`` lane dict for one generation: ``tl_sims``
+    (i32 — candidate simulations, ``rounds * B``) and ``tl_phase``
+    (f32[len(PHASES)] — per-phase work units, ``per_round * rounds +
+    fixed``).  ``rounds`` is the only traced input; everything else is
+    static, so the lanes add a handful of scalar mul/adds to the trace
+    and touch no population math."""
+    import jax.numpy as jnp
+
+    r = rounds.astype(jnp.float32)
+    phase = jnp.stack([
+        jnp.float32(cost_model[name]["per_round"]) * r
+        + jnp.float32(cost_model[name]["fixed"])
+        for name in PHASES])
+    return {"tl_sims": rounds * jnp.int32(B), "tl_phase": phase}
+
+
+def attribute_phases(tl_phase, wall_s: float) -> Dict[str, float]:
+    """Normalize one generation's work-unit vector onto its measured
+    wall seconds: ``{phase: seconds}`` summing to ``wall_s`` (an
+    all-zero vector attributes everything to ``simulate`` rather than
+    dividing by zero)."""
+    import numpy as np
+
+    v = np.asarray(tl_phase, dtype=np.float64).reshape(-1)
+    total = float(v.sum())
+    out = {}
+    for i, name in enumerate(PHASES):
+        share = (float(v[i]) / total) if total > 0 else \
+            (1.0 if name == "simulate" else 0.0)
+        out[name] = share * float(wall_s)
+    return out
+
+
+# ----------------------------------------------------------- progress word
+
+class RunProgress:
+    """Process-global in-dispatch progress word.
+
+    ``begin()`` arms it at dispatch time with the absolute generation
+    origin; :func:`device_progress_update` (the jax.debug.callback
+    target) advances it from inside the running program; ``finish()``
+    marks the dispatch returned.  ``read()`` returns a JSON-safe dict
+    (or None when no one-dispatch run ever armed it) — the shape that
+    lands in fleet snapshots, flight dumps and ``/api/fleet``.
+    """
+
+    #: lock-discipline contract, enforced by `abc-lint`
+    _GUARDED_BY = {"_state": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Optional[dict] = None
+
+    def begin(self, *, t0: int, t_limit: int, run_id=None):
+        with self._lock:
+            self._state = {
+                "active": True,
+                "t0": int(t0),
+                "t_limit": int(t_limit),
+                "gen": int(t0),
+                "gens_done": 0,
+                "eps": None,
+                "accepted": None,
+                "rounds": 0,
+                "run_id": None if run_id is None else str(run_id),
+                "started_unix": time.time(),
+                "updated_unix": time.time(),
+            }
+
+    def update(self, gens_done: int, eps: float, accepted: int,
+               rounds: int):
+        """Advance the word; called from the debug-callback thread while
+        the dispatch is in flight, so it must stay O(dict write).
+        ``gens_done`` counts completed generations; ``gen`` is the
+        absolute index of the last completed one."""
+        with self._lock:
+            st = self._state
+            if st is None:
+                return
+            # keep the word monotone regardless of delivery order
+            # (unordered callbacks may arrive out of order)
+            gd = int(gens_done)
+            if gd < st["gens_done"]:
+                return
+            st["gens_done"] = gd
+            st["gen"] = st["t0"] + gd - 1
+            st["eps"] = float(eps)
+            st["accepted"] = int(accepted)
+            st["rounds"] = max(int(rounds), st["rounds"])
+            st["updated_unix"] = time.time()
+
+    def finish(self):
+        with self._lock:
+            if self._state is not None:
+                self._state["active"] = False
+                self._state["updated_unix"] = time.time()
+
+    def reset(self):
+        """Test isolation: forget any previous run's word."""
+        with self._lock:
+            self._state = None
+
+    def read(self) -> Optional[dict]:
+        with self._lock:
+            return None if self._state is None else dict(self._state)
+
+
+#: the process-global progress word (one in-flight one-dispatch run per
+#: process — the orchestrator is single-run by construction)
+PROGRESS = RunProgress()
+
+
+def device_progress_update(gens_done, eps, accepted, rounds, written):
+    """``jax.debug.callback`` target planted at each generation boundary
+    of the one-dispatch while-loop (sampler/fused.py:gen_step).  Arrives
+    with device scalars; must never raise — an observability callback
+    that kills the dispatch it observes is worse than no callback.
+    ``written`` gates out dead post-stop iterations (their repeated
+    frontier values carry zeroed counters, not progress)."""
+    try:
+        if not bool(written):
+            return
+        PROGRESS.update(int(gens_done), float(eps), int(accepted),
+                        int(rounds))
+    except Exception:
+        pass
+
+
+class ProgressPoller:
+    """Daemon thread publishing the progress word while a dispatch is in
+    flight.  The main thread is blocked inside the first egress fetch
+    for the whole device run, so WITHOUT this thread the fleet snapshot
+    would freeze at the pre-dispatch state; with it, every poll tick
+    that sees a fresh word force-writes the snapshot (the publisher's
+    own throttle is bypassed — the cadence knob here IS the throttle).
+    """
+
+    def __init__(self, publish: Callable[[], object],
+                 interval_s: Optional[float] = None):
+        self._publish = publish
+        self._interval = (poll_interval_s() if interval_s is None
+                          else max(float(interval_s), 0.05))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_seen = -1.0
+
+    def start(self) -> "ProgressPoller":
+        t = threading.Thread(target=self._run, daemon=True,
+                             name="abc-progress-poller")
+        self._thread = t
+        t.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            word = PROGRESS.read()
+            if word is None or not word.get("active"):
+                continue
+            if word["updated_unix"] <= self._last_seen:
+                continue  # nothing new since the last publish
+            self._last_seen = word["updated_unix"]
+            try:
+                self._publish()
+            except Exception:
+                pass  # a publish hiccup must not kill the poller
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+
+# -------------------------------------------------------------- fleet side
+
+def merge_progress(words: List[Optional[dict]]) -> Optional[dict]:
+    """Merge per-host progress words into one fleet view.  Pod processes
+    run the same program in lockstep, so the merged word is the most
+    recently updated ACTIVE word (falling back to the freshest inactive
+    one); ``hosts_active`` counts processes still inside a dispatch."""
+    live = [w for w in words if w]
+    if not live:
+        return None
+    active = [w for w in live if w.get("active")]
+    pick = max(active or live,
+               key=lambda w: w.get("updated_unix", 0.0))
+    merged = dict(pick)
+    merged["hosts_active"] = len(active)
+    merged["hosts_reporting"] = len(live)
+    return merged

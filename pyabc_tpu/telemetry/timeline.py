@@ -49,7 +49,8 @@ class GenerationTimeline:
                stages: Optional[dict] = None, eps: Optional[float] = None,
                accepted: Optional[int] = None, total: Optional[int] = None,
                overlap_s: float = 0.0, compile_s: float = 0.0,
-               n_compiles: int = 0, engine: Optional[str] = None):
+               n_compiles: int = 0, engine: Optional[str] = None,
+               phases: Optional[dict] = None):
         """Add one generation's row.  ``stages`` maps a subset of
         :data:`STAGES` to seconds; unknown keys raise so a typo can't
         silently vanish from the table.  ``compile_s``/``n_compiles``
@@ -59,11 +60,22 @@ class GenerationTimeline:
         would break stage-sum == wall.  ``engine`` records the
         probe-based fused-vs-sequential selection in force when the
         generation ran (``ABCSMC._decide_engine``); None below the probe
-        population or before the probe decides."""
+        population or before the probe decides.  ``phases`` maps a
+        subset of ``telemetry.lanes.PHASES`` (simulate / distance /
+        eps_solve / refit / resample) to seconds from the in-dispatch
+        telemetry lanes — stored as ``ph_<name>_s`` attribution columns
+        alongside the stage columns, never folded into the stage sum
+        (they re-slice ``compute``/``wall``, they don't add to it)."""
         stages = dict(stages or {})
         unknown = set(stages) - set(STAGES)
         if unknown:
             raise KeyError(f"unknown timeline stages: {sorted(unknown)}")
+        if phases:
+            from .lanes import PHASES
+            unknown = set(phases) - set(PHASES)
+            if unknown:
+                raise KeyError(
+                    f"unknown timeline phases: {sorted(unknown)}")
         row = {"gen": int(t), "path": path, "wall_s": round(wall_s, 6)}
         named = 0.0
         for s in STAGES:
@@ -80,6 +92,9 @@ class GenerationTimeline:
         row["accepted"] = None if accepted is None else int(accepted)
         row["total"] = None if total is None else int(total)
         row["engine"] = engine
+        if phases:
+            for name, v in phases.items():
+                row["ph_" + name + "_s"] = round(float(v), 6)
         with self._lock:
             if len(self._rows) < self._max_rows:
                 self._rows.append(row)
@@ -115,7 +130,7 @@ class GenerationTimeline:
         for r in rows:
             if r.get("engine") is not None:
                 engine = r["engine"]
-        return {
+        out = {
             "generations": len(rows),
             "wall_s_med": med("wall_s"),
             "compute_s_med": med("compute_s"),
@@ -128,6 +143,17 @@ class GenerationTimeline:
             "history_mode": self.history_mode,
             "stop_reason": self.stop_reason,
         }
+        # per-phase medians over the rows that carry lane attribution
+        # (onedispatch runs with telemetry lanes on); absent otherwise
+        ph_keys = sorted({k for r in rows for k in r
+                          if k.startswith("ph_") and k.endswith("_s")})
+        for key in ph_keys:
+            vals = sorted(r[key] for r in rows if key in r)
+            n = len(vals)
+            mid = vals[n // 2] if n % 2 else (vals[n // 2 - 1]
+                                              + vals[n // 2]) / 2
+            out[key + "_med"] = round(mid, 6)
+        return out
 
     def render_ascii(self) -> str:
         """Fixed-width table for logs; one line per generation."""

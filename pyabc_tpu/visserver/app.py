@@ -126,7 +126,9 @@ async function pollFleet(){
  let d;try{d=await j('/api/fleet')}catch(e){return}
  if(!d.enabled)return;
  $('livecard').style.display='';
- $('liveinfo').textContent=`engine=${d.engine||'-'} | ${d.hosts.length} host(s)`+(d.pod_hosts>1?` | pod=${d.pod_hosts}`:'');
+ let live='';const p=d.run_progress;
+ if(p&&p.active)live=` | in-dispatch: gen=${p.gen} done=${p.gens_done}/${p.t_limit}`+(p.eps==null?'':` eps=${(+p.eps).toPrecision(4)}`)+` rounds=${p.rounds||0}`;
+ $('liveinfo').textContent=`engine=${d.engine||'-'} | ${d.hosts.length} host(s)`+(d.pod_hosts>1?` | pod=${d.pod_hosts}`:'')+live;
  let html='<table><tr><th>host</th><th>state</th><th>shard</th><th>gens</th><th>evals</th><th>acc</th><th>acc_n</th><th>coll s</th><th>d2h MB/s</th><th>compiles</th><th>retries</th><th>degrades</th><th>ckpts</th><th>flights</th></tr>';
  for(const h of d.hosts)html+=`<tr><td>${h.host}:${h.pid}</td><td>${h.alive==null?'?':h.alive?'alive':'STALE'}</td><td>${h.process_index==null?'-':'h'+h.process_index}</td><td>${h.generations}</td><td>${h.evaluations}</td><td>${(+h.acceptance_rate).toFixed(4)}</td><td>${h.accepted||0}</td><td>${(+(h.collective_s||0)).toFixed(2)}</td><td>${(+h.d2h_mb_per_s).toFixed(2)}</td><td>${h.n_compiles}</td><td>${h.retries}</td><td>${h.degrades}</td><td>${h.checkpoints}</td><td>${h.flight_dumps}</td></tr>`;
  $('livehosts').innerHTML=html+'</table>';

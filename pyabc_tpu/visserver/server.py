@@ -212,6 +212,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "flight_dumps": int(m.get("flight_dumps_total", 0)),
                 "egress": s.get("egress") or {},
                 "written_unix": s.get("written_unix"),
+                "run_progress": s.get("run_progress"),
             })
             for r in s.get("trajectory") or []:
                 row = dict(r)
@@ -220,9 +221,15 @@ class _Handler(BaseHTTPRequestHandler):
                 if r.get("engine") is not None:
                     engine = r["engine"]
         trajectory.sort(key=lambda r: (r.get("gen", -1), r["host"]))
+        from ..telemetry.lanes import merge_progress
         return {"enabled": True, "hosts": hosts,
                 "pod_hosts": pod_hosts,
-                "trajectory": trajectory, "engine": engine}
+                "trajectory": trajectory, "engine": engine,
+                # the fleet-merged in-dispatch progress word: lets the
+                # live card advance while every host is still blocked
+                # inside a one-dispatch call (telemetry/lanes.py)
+                "run_progress": merge_progress(
+                    [s.get("run_progress") for s in snaps])}
 
     def _index(self):
         h = History(self.db_path, abc_id=1)

@@ -234,10 +234,11 @@ def maybe_summary_grid(dp: dict) -> Optional[dict]:
 # ---------------------------------------------------------------- decode
 
 def _narrow_wire(entry: dict) -> dict:
-    """The entry's decodable wire lanes (summary ``sm_*`` lanes carry
-    no population bytes and are excluded from fetch/digest/journal)."""
+    """The entry's decodable wire lanes (summary ``sm_*`` and telemetry
+    ``tl_*`` lanes carry no population bytes and are excluded from
+    fetch/digest/journal)."""
     return {key: v for key, v in entry["wire"].items()
-            if not key.startswith("sm_")}
+            if not key.startswith(("sm_", "tl_"))}
 
 
 def entry_host_wire(entry: dict) -> dict:

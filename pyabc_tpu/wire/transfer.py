@@ -96,9 +96,11 @@ _lock = REGISTRY._lock
 #: inverting the dataflow).  ``population`` is the thread-default
 #: because the ingest worker threads only ever fetch population wires;
 #: the other callers label themselves inline with :func:`egress`.
-#: ``history`` is reserved for device-resident History lazy fetches.
+#: ``history`` is reserved for device-resident History lazy fetches;
+#: ``telemetry`` books the in-dispatch lane drain (telemetry/lanes.py)
+#: so observability's own bytes never masquerade as population traffic.
 EGRESS_SUBSYSTEMS = ("population", "history", "checkpoint", "summary",
-                     "control", "other")
+                     "control", "telemetry", "other")
 
 _EGRESS_DEFAULT = "population"
 _egress_tls = threading.local()

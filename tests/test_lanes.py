@@ -1,0 +1,356 @@
+"""Device telemetry lanes + the in-dispatch progress word
+(telemetry/lanes.py): the ``tl_*`` wire lanes riding the one-dispatch
+egress buffers, the per-phase attribution they hydrate into the
+generation timeline, the live progress word advanced by the in-dispatch
+host callback, the poller that publishes it, the pod-side merge, and
+the two hard contracts that let the lanes stay on by default —
+bit-identical populations with lanes on or off, and a <2 % disabled
+overhead budget (the PR-2 gate, extended to this subsystem).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_two_gaussians_problem
+from pyabc_tpu.parallel import health
+from pyabc_tpu.resilience import checkpoint as ckpt
+from pyabc_tpu.resilience import faults
+from pyabc_tpu.telemetry import (GenerationTimeline, REGISTRY, aggregate,
+                                 flight, lanes, spans)
+from pyabc_tpu.wire import transfer
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    """The progress word, tracer sink, flight ring and fault plan are
+    process-global; every test starts and ends clean, with no run dir,
+    host override or lanes switch leaking in from the environment."""
+    monkeypatch.delenv(health.RUN_DIR_ENV, raising=False)
+    monkeypatch.delenv(aggregate.HOST_ENV, raising=False)
+    monkeypatch.delenv(spans.TRACE_ENV, raising=False)
+    monkeypatch.delenv(lanes.LANES_ENV, raising=False)
+    monkeypatch.delenv(lanes.POLL_ENV, raising=False)
+    faults.uninstall()
+    ckpt.clear_preempt()
+    spans.TRACER.reset()
+    flight.RECORDER.reset()
+    lanes.PROGRESS.reset()
+    yield
+    faults.uninstall()
+    ckpt.clear_preempt()
+    spans.TRACER.reset()
+    flight.RECORDER.reset()
+    lanes.PROGRESS.reset()
+
+
+def _abc(run_mode="onedispatch", fuse=2, pop=1000, batch=4096,
+         eps_value=0.2, seed=0, **kwargs):
+    """Two-gaussians config with the sampler batch PINNED (min == max)
+    so _block_max_rounds is identical at every compile point — the
+    precondition for bit-identity across engines (test_stop_sampling)."""
+    models, priors, distance, observed, _ = make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    eps=pt.ConstantEpsilon(eps_value),
+                    sampler=pt.VectorizedSampler(min_batch_size=batch,
+                                                 max_batch_size=batch),
+                    fuse_generations=fuse, run_mode=run_mode,
+                    seed=seed, **kwargs)
+    abc.new("sqlite://", observed)
+    return abc
+
+
+def _counters(abc):
+    return [(r["gen"], r["eps"], r["accepted"], r["total"])
+            for r in abc.timeline.to_rows()]
+
+
+# ---------------------------------------------------------------------------
+# the zero-perturbation contract: lanes on/off, counters across engines
+# ---------------------------------------------------------------------------
+
+def test_lanes_bit_identical_populations_and_counters():
+    """Lanes are pure arithmetic over values the program already
+    computes (rounds is the only traced input), so the lanes-on
+    one-dispatch program, the lanes-off one, and the per-block fused
+    loop must produce BIT-identical populations and per-generation
+    counters at pop 1e3.  The sequential engine draws a different RNG
+    schedule (see test_stop_sampling), so only its generation/eps
+    schedule is compared.  Five populations on purpose: t=1..4 fills
+    two whole fused blocks — a partial block would drop its remainder
+    generation to the sequential path and forfeit bit-identity."""
+    a_on = _abc()
+    h_on = a_on.run(max_nr_populations=5)
+    a_off = _abc()
+    a_off.telemetry_lanes = False
+    h_off = a_off.run(max_nr_populations=5)
+    a_f = _abc(run_mode=None)
+    h_f = a_f.run(max_nr_populations=5)
+    a_s = _abc(run_mode=None, fuse=1)
+    a_s.run(max_nr_populations=5)
+
+    assert a_on.run_dispatches == 1
+    assert a_off.run_dispatches == 1
+    for t in range(5):
+        for m in range(2):
+            df_on, w_on = h_on.get_distribution(m=m, t=t)
+            for h2 in (h_off, h_f):
+                df2, w2 = h2.get_distribution(m=m, t=t)
+                assert len(df_on) == len(df2), (t, m)
+                if len(df_on) == 0:
+                    continue
+                np.testing.assert_array_equal(df_on["mu"].to_numpy(),
+                                              df2["mu"].to_numpy())
+                np.testing.assert_array_equal(w_on, w2)
+    # exact float equality on purpose: same program, same bits
+    assert _counters(a_on) == _counters(a_off) == _counters(a_f)
+    assert [(g, e) for g, e, _, _ in _counters(a_s)] == \
+        [(g, e) for g, e, _, _ in _counters(a_on)]
+
+    # lanes-on rows carry the per-phase attribution columns, summing to
+    # the generation wall; lanes-off rows carry none
+    rows_on = [r for r in a_on.timeline.to_rows()
+               if r["path"] == "onedispatch"]
+    assert len(rows_on) == 4
+    for r in rows_on:
+        ph = {p: r["ph_" + p + "_s"] for p in lanes.PHASES}
+        assert all(v >= 0.0 for v in ph.values())
+        assert sum(ph.values()) == pytest.approx(r["wall_s"], abs=1e-4)
+        # the rejection loop dominates the work model
+        assert ph["simulate"] > 0.0
+    summ = a_on.timeline.summary()
+    for p in lanes.PHASES:
+        assert "ph_" + p + "_s_med" in summ
+    assert all("ph_simulate_s" not in r for r in a_off.timeline.to_rows())
+
+
+def test_telemetry_egress_is_labeled_and_tiny():
+    """Satellite of the PR-2 egress invariant: the lane drain books its
+    bytes under the ``telemetry`` subsystem (24 B/generation — one i32
+    + five f32), and every d2h byte the ledger counts during the run is
+    still attributed to exactly one subsystem."""
+    base = transfer.egress_breakdown()
+    total0 = REGISTRY.to_dict().get("wire_d2h_bytes_total", 0)
+    abc = _abc(pop=200, batch=2048)
+    abc.run(max_nr_populations=4)
+    delta = {k: v - base.get(k, 0)
+             for k, v in transfer.egress_breakdown().items()}
+    total = REGISTRY.to_dict().get("wire_d2h_bytes_total", 0)
+    gens = len([r for r in abc.timeline.to_rows()
+                if r["path"] == "onedispatch"])
+    assert gens == 3
+    assert delta["telemetry"] == 24 * gens
+    assert delta["population"] > 0
+    assert total - total0 > 0
+    assert sum(delta.values()) == total - total0
+
+
+# ---------------------------------------------------------------------------
+# the progress word: in-run updates, fault path, poller, pod merge
+# ---------------------------------------------------------------------------
+
+def test_progress_word_monotone_and_finished_under_drain_fault(
+        monkeypatch):
+    """The in-dispatch callback advances the word monotonically, and an
+    injected ``run.drain`` fault — the drain loop dying mid-harvest —
+    still leaves the word finished (active=False) while the run
+    degrades to the per-block path and completes."""
+    calls = []
+    orig = lanes.PROGRESS.update
+
+    def spy(gens_done, eps, accepted, rounds):
+        calls.append((int(gens_done), int(rounds)))
+        orig(gens_done, eps, accepted, rounds)
+
+    monkeypatch.setattr(lanes.PROGRESS, "update", spy)
+    faults.install(faults.FaultPlan.parse(
+        "run.drain@2:raise=ConnectionResetError"))
+    abc = _abc(pop=200, batch=2048)
+    h = abc.run(max_nr_populations=5)
+
+    # the dispatch itself completed: every written generation reported
+    # in through the callback, in monotone order despite being unordered
+    assert len(calls) >= 3
+    gens_done = [c[0] for c in calls]
+    assert gens_done == sorted(gens_done)
+    rounds = [c[1] for c in calls]
+    assert rounds == sorted(rounds)  # cumulative round counter
+    # the drain fault tripped the degrade path, not the run
+    assert abc._fault_onedispatch_off is True
+    assert h.max_t == 4
+    word = lanes.PROGRESS.read()
+    assert word is not None
+    assert word["active"] is False  # _progress_done ran in the finally
+    assert word["gens_done"] == gens_done[-1]
+
+
+def test_progress_poller_publishes_only_fresh_active_words():
+    """The poller force-publishes when the word advanced, stays quiet
+    while it is static, and its publish failures never escape."""
+    pubs = []
+    lanes.PROGRESS.begin(t0=1, t_limit=6)
+    poller = lanes.ProgressPoller(lambda: pubs.append(1),
+                                  interval_s=0.05).start()
+    try:
+        lanes.PROGRESS.update(1, 0.5, 100, 1)
+        deadline = time.time() + 2.0
+        while not pubs and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(pubs) >= 1
+        n = len(pubs)
+        time.sleep(0.3)  # several poll ticks over a static word
+        assert len(pubs) == n
+        lanes.PROGRESS.update(2, 0.4, 120, 2)
+        deadline = time.time() + 2.0
+        while len(pubs) == n and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(pubs) == n + 1
+    finally:
+        poller.stop()
+    lanes.PROGRESS.finish()
+    assert lanes.PROGRESS.read()["active"] is False
+
+
+def test_progress_word_update_is_monotone_and_gated():
+    lanes.PROGRESS.update(1, 0.5, 10, 1)  # before begin: ignored
+    assert lanes.PROGRESS.read() is None
+    lanes.PROGRESS.begin(t0=2, t_limit=9, run_id=7)
+    lanes.PROGRESS.update(3, 0.5, 10, 3)
+    lanes.PROGRESS.update(1, 0.9, 5, 1)  # stale delivery: ignored
+    word = lanes.PROGRESS.read()
+    assert word["gens_done"] == 3
+    assert word["gen"] == 4  # t0 + gens_done - 1
+    assert word["eps"] == 0.5
+    assert word["run_id"] == "7"
+    # the callback target gates on the device's written flag and must
+    # never raise, whatever arrives
+    lanes.device_progress_update(9, 0.1, 1, 9, False)
+    assert lanes.PROGRESS.read()["gens_done"] == 3
+    lanes.device_progress_update(float("nan"), None, None, None, True)
+    assert lanes.PROGRESS.read()["gens_done"] == 3
+
+
+def test_merge_progress_prefers_active_then_freshest():
+    assert lanes.merge_progress([]) is None
+    assert lanes.merge_progress([None, None]) is None
+    a = {"active": True, "gens_done": 2, "updated_unix": 10.0}
+    b = {"active": False, "gens_done": 5, "updated_unix": 20.0}
+    merged = lanes.merge_progress([a, b, None])
+    assert merged["gens_done"] == 2  # active beats fresher-but-done
+    assert merged["hosts_active"] == 1
+    assert merged["hosts_reporting"] == 2
+    done = lanes.merge_progress(
+        [{"active": False, "gens_done": 3, "updated_unix": 5.0}, b])
+    assert done["gens_done"] == 5  # all done: freshest word wins
+    assert done["hosts_active"] == 0
+
+
+def test_pod_two_host_progress_rollup(tmp_path, monkeypatch):
+    """Two hosts publishing into one run directory — the pod mount
+    contract — roll up to a single merged progress word on the
+    ``abc-top`` / ``/api/fleet`` / Prometheus read path."""
+    rd = str(tmp_path)
+    monkeypatch.setenv(aggregate.HOST_ENV, "host-a")
+    lanes.PROGRESS.begin(t0=1, t_limit=8, run_id="r1")
+    lanes.PROGRESS.update(2, 0.5, 900, 2)
+    aggregate.TelemetryPublisher(rd, min_interval_s=0.0).publish(
+        force=True)
+    monkeypatch.setenv(aggregate.HOST_ENV, "host-b")
+    time.sleep(0.01)  # host-b's word must stamp strictly fresher
+    lanes.PROGRESS.update(3, 0.4, 950, 3)
+    aggregate.TelemetryPublisher(rd, min_interval_s=0.0).publish(
+        force=True)
+
+    snaps = aggregate.read_snapshots(rd)
+    assert len(snaps) == 2
+    assert all(s.get("run_progress") for s in snaps)
+    roll = aggregate.fleet_rollup(rd)
+    assert {h["host"] for h in roll["hosts"]} == {"host-a", "host-b"}
+    assert all(h["run_progress"] for h in roll["hosts"])
+    merged = roll["run_progress"]
+    assert merged["gens_done"] == 3  # the freshest active word
+    assert merged["gen"] == 3
+    assert merged["hosts_active"] == 2
+    assert merged["hosts_reporting"] == 2
+    prom = aggregate.render_prometheus(rd)
+    assert "pyabc_tpu_fleet_run_progress_active 1" in prom
+    assert "pyabc_tpu_fleet_run_progress_gens_done 3" in prom
+
+
+def test_flight_dump_embeds_progress_word(tmp_path):
+    """A ``kill -9`` post-mortem names the generation that died: the
+    flight dump embeds the last progress word."""
+    lanes.PROGRESS.begin(t0=0, t_limit=6, run_id="crashing")
+    lanes.PROGRESS.update(2, 0.3, 50, 4)
+    rec = flight.FlightRecorder()
+    rec.note("retry", site="device.dispatch")
+    path = rec.dump(reason="test", directory=str(tmp_path))
+    assert path is not None
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["run_progress"]["gens_done"] == 2
+    assert payload["run_progress"]["run_id"] == "crashing"
+
+
+# ---------------------------------------------------------------------------
+# attribution units + the disabled-path overhead budget (PR-2 contract)
+# ---------------------------------------------------------------------------
+
+def test_attribute_phases_normalizes_onto_wall():
+    out = lanes.attribute_phases(
+        np.array([1.0, 1.0, 0.0, 0.0, 2.0], dtype=np.float32), 4.0)
+    assert out == {"simulate": 1.0, "distance": 1.0, "eps_solve": 0.0,
+                   "refit": 0.0, "resample": 2.0}
+    zero = lanes.attribute_phases(np.zeros(5, dtype=np.float32), 2.0)
+    assert zero["simulate"] == 2.0
+    assert sum(zero.values()) == 2.0
+
+
+def test_timeline_rejects_unknown_phase():
+    tl = GenerationTimeline()
+    with pytest.raises(KeyError):
+        tl.record(0, path="onedispatch", wall_s=1.0,
+                  phases={"not_a_phase": 1.0})
+
+
+def test_lanes_disabled_overhead_budget(monkeypatch):
+    """With ``PYABC_TPU_TELEMETRY_LANES=0`` the compiled program is the
+    exact pre-lanes program, so the residual host cost is the enabled()
+    probe at build time, the publisher's word read per snapshot, and a
+    gated no-op callback.  Measured arithmetically (robust on shared
+    CI): worst-case per-generation counts x per-call cost must stay
+    under 2 % of even a 5 ms generation — the PR-2 budget."""
+    monkeypatch.setenv(lanes.LANES_ENV, "0")
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        lanes.lanes_enabled()
+    env_s = (time.perf_counter() - t0) / n
+
+    lanes.PROGRESS.reset()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        lanes.PROGRESS.read()
+    read_s = (time.perf_counter() - t0) / n
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        lanes.device_progress_update(1, 0.5, 10, 1, False)
+    callback_s = (time.perf_counter() - t0) / n
+
+    enabled = False
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if enabled:
+            raise AssertionError
+    check_s = (time.perf_counter() - t0) / n
+
+    # a generous per-generation bill: one enabled() probe + one flag
+    # check + two word reads (publisher, flight) + four gated callbacks
+    per_gen = env_s + check_s + 2 * read_s + 4 * callback_s
+    assert per_gen < 0.02 * 0.005, (
+        f"disabled lanes path costs {per_gen * 1e6:.1f}us/gen against "
+        f"a {0.02 * 0.005 * 1e6:.0f}us budget")

@@ -65,6 +65,18 @@ WATCHED = (
     # carries + bf16 lanes): ZERO slack — this row may only ever get
     # faster; _SECONDS_FLOOR still absorbs timer noise near zero
     ("onedispatch_pop1e6_s_per_gen", "lower", 0.0),
+    # in-dispatch telemetry lanes (bench_lanes, telemetry/lanes.py):
+    # the tl_* drain is O(24 B)/generation by contract — this row
+    # fails high (with the _MB_SLACK absolute floor) if the lanes
+    # stop being scalar and start billing real egress
+    ("onedispatch_pop1e6_telemetry_egress_mb", "lower", 0.25),
+    # ... and the lanes-on vs lanes-off steady-state s/gen gap: the
+    # lanes are a handful of scalar ops + one five-scalar callback per
+    # generation, so the true overhead sits in measurement noise; the
+    # wide relative slack is on a near-zero reference, and a real
+    # per-round or per-particle cost sneaking into the lanes blows
+    # straight through it
+    ("onedispatch_pop1e6_lanes_overhead_pct", "lower", 1.00),
     # pod-scale one-dispatch (bench_podstar, 2-process jax.distributed
     # pod): EVERY host's whole post-calibration run must stay one SPMD
     # dispatch — the row reports the max across hosts, so any host

@@ -53,7 +53,7 @@ _ASARRAY_DEVICE = re.compile(
 #: literal so the lint runs without importing (and thus initializing)
 #: jax; drift is caught by the wrapper test comparing the two tuples
 EGRESS_SUBSYSTEMS = ("population", "history", "checkpoint", "summary",
-                     "control", "other")
+                     "control", "telemetry", "other")
 # literal-label egress attribution: egress("...") / egress('...')
 _EGRESS_CALL = re.compile(r"\begress\(\s*([\"'])([^\"']*)\1")
 
