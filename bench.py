@@ -711,10 +711,89 @@ def _bench_problem(make_problem, pop, prefix):
             **{f"{prefix}_{k}": v for k, v in transfer.items()}}
 
 
+SERVE_GENS = 3
+
+
+def _serve_model(key, theta):
+    """Quickstart-shaped simulator for bench_serve — module-level
+    because queue submissions pickle the spec (serve/queue.py), exactly
+    like a real tenant's importable model."""
+    import jax
+    noise = 0.1 * jax.random.normal(key, (theta.shape[0], 1))
+    return {"y": theta[:, :1] + noise}
+
+
+def bench_serve():
+    """Serving-tier row: a multi-tenant study mix (pop 1e2–1e4, with
+    duplicate submissions) through ONE warm ``ServeWorker``.
+
+    The mix exercises every serving path: small same-shape studies
+    fuse onto the vmapped study axis, the pop-1e4 studies take the
+    warm solo one-dispatch engine (study 2 riding the renewed kernel
+    with zero new compiles), and the duplicates must come back from
+    the content-addressed cache without any dispatch.  Headline:
+    ``serve_studies_per_s`` (sentinel-watched, fail-low) plus the
+    p50/p99 study latency and the cache + CompiledLadder counters."""
+    import tempfile
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.serve import ServeWorker, StudyQueue, StudySpec
+
+    def spec(pop, seed, tenant, y=0.4):
+        return StudySpec(
+            model=_serve_model,
+            prior=pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+            observed={"y": float(y)}, population_size=pop,
+            seed=seed, tenant=tenant, max_generations=SERVE_GENS)
+
+    root = tempfile.mkdtemp(prefix="bench_serve_")
+    worker = ServeWorker(root=root)
+    # warm the solo engine outside the timed window (first study pays
+    # the one-off compile bill the whole serving tier exists to avoid)
+    worker.serve_spec(spec(10_000, 0, "t_large"))
+
+    t0 = time.perf_counter()
+    served0 = worker.served
+    # warm solo repeat: zero new compiles by the renew() contract
+    worker.serve_spec(spec(10_000, 1, "t_large"))
+    queue = StudyQueue(root=root)
+    mix = ([spec(100, s, "t_small", y=y)
+            for s, y in enumerate((0.2, 0.3, 0.4, 0.5))]
+           + [spec(1_000, s, "t_mid") for s in range(3)])
+    dups = [spec(100, 1, "t_small", y=0.3),
+            spec(1_000, 1, "t_mid"), spec(1_000, 2, "t_mid")]
+    for s in mix + dups:
+        queue.submit(s)
+    worker.run_forever(queue, once=True)
+    wall = time.perf_counter() - t0
+    n_served = worker.served - served0
+
+    walls = sorted(worker.walls_ms[-n_served:])
+    cache = worker.cache.stats()
+    ladder = {"hits": 0, "misses": 0, "evictions": 0}
+    for abc in worker._engines.values():
+        for k, v in abc.sampler._ladder.summary().items():
+            if k in ladder:
+                ladder[k] += int(v)
+    return {
+        "serve_studies_per_s": round(n_served / wall, 3),
+        "serve_p50_ms": round(walls[len(walls) // 2], 1),
+        "serve_p99_ms": round(
+            walls[min(len(walls) - 1,
+                      int(round(0.99 * (len(walls) - 1))))], 1),
+        "serve_studies": n_served,
+        "serve_cache_hit_ratio": round(cache["hit_ratio"], 3),
+        "serve_duplicates_from_cache": cache["hits"],
+        "serve_ladder_hits": ladder["hits"],
+        "serve_ladder_misses": ladder["misses"],
+        "serve_ladder_evictions": ladder["evictions"],
+    }
+
+
 SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
-               "kernel", "lanes", "posterior_gate", "lotka_volterra",
-               "sir", "petab_ode", "sharded_mesh1", "ab_vec_sharded",
-               "sharded_cpu8", "podstar")
+               "kernel", "lanes", "serve", "posterior_gate",
+               "lotka_volterra", "sir", "petab_ode", "sharded_mesh1",
+               "ab_vec_sharded", "sharded_cpu8", "podstar")
 
 
 def bench_ab_vec_vs_sharded():
@@ -980,6 +1059,8 @@ def _run_sub(name: str) -> dict:
         return bench_kernel()
     if name == "lanes":
         return bench_lanes()
+    if name == "serve":
+        return bench_serve()
     if name == "posterior_gate":
         # the 1e6 adaptive posterior-exactness gate (BASELINE.md
         # "Correctness at scale", now repeatable): perf work cannot
@@ -1097,7 +1178,7 @@ def main():
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
                                 "onedispatch_", "kernel_", "lanes_",
-                                "podstar_",
+                                "podstar_", "serve_",
                                 "posterior_gate_",
                                 "telemetry_", "resilience_",
                                 "checkpoint_", "store_", "lint_"))

@@ -139,11 +139,14 @@ def compile_delta(before: dict, after: Optional[dict] = None) -> dict:
 # ---------------------------------------------------------------------------
 
 def aval_of(x):
-    """ShapeDtypeStruct mirroring a concrete array (weak_type
-    preserved — an AOT executable signature is exact about it)."""
+    """ShapeDtypeStruct mirroring a concrete array (weak_type and
+    committed sharding preserved — an AOT executable signature is
+    exact about both, and a sharding-less lowering would pin the
+    executable to single-device placement)."""
     import jax
 
     return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                sharding=getattr(x, "sharding", None),
                                 weak_type=getattr(x, "weak_type", False))
 
 
@@ -166,11 +169,12 @@ class AotGuard:
     wrapper — a synchronous compile, the pre-autotune behavior — when
     the signature no longer matches."""
 
-    __slots__ = ("_compiled", "_fallback")
+    __slots__ = ("_compiled", "_fallback", "_avals")
 
-    def __init__(self, compiled, fallback):
+    def __init__(self, compiled, fallback, avals=None):
         self._compiled = compiled
         self._fallback = fallback
+        self._avals = avals
 
     def __call__(self, *args):
         try:
@@ -181,13 +185,50 @@ class AotGuard:
                 "AOT executables bypassed by aval drift").inc()
             return self._fallback(*args)
 
+    def _sharding_drifted(self, args) -> bool:
+        """Leaf-wise sharding comparison of ``args`` against the avals
+        this guard was lowered from.  Leaves whose lowering aval carried
+        no sharding are skipped (the executable placed them itself, and
+        the compiled object's own ``input_shardings`` can't be compared
+        positionally — XLA prunes unused args from it)."""
+        import jax
+
+        if self._avals is None:
+            return False
+        stored = jax.tree_util.tree_leaves(self._avals)
+        live = jax.tree_util.tree_leaves(args)
+        if len(stored) != len(live):
+            return False  # different pytree: not a sharding question
+        for a, x in zip(stored, live):
+            ash = getattr(a, "sharding", None)
+            xsh = getattr(x, "sharding", None)
+            if ash is None or xsh is None:
+                continue
+            if not ash.is_equivalent_to(xsh, getattr(x, "ndim", 0)):
+                return True
+        return False
+
+    def specialize(self, *args):
+        """Re-AOT for these concrete args when their shardings drifted
+        from the lowering avals (the stateful loop's ``reset`` is
+        lowered before any concrete state exists; if a mesh program
+        lays the live carry out differently, every later call would
+        miss to the lazy-jit fallback).  No-op — in particular on
+        single-device runs — unless a recorded sharding mismatches."""
+        if not self._sharding_drifted(args):
+            return
+        avals = avals_like(args)
+        self._compiled = self._fallback.lower(*avals).compile()
+        self._avals = avals
+
 
 def aot_compile(jit_fn, *arg_avals):
     """AOT-compile a jitted function for exact avals; returns a
     callable :class:`AotGuard`.  Calling the *wrapper* after lowering
     would compile again (the AOT path does not populate the jit call
     cache), so the ladder must store and call this object."""
-    return AotGuard(jit_fn.lower(*arg_avals).compile(), jit_fn)
+    return AotGuard(jit_fn.lower(*arg_avals).compile(), jit_fn,
+                    avals=arg_avals)
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +256,9 @@ class CompiledLadder:
         "_cache": "_lock",
         "_inflight": "_lock",
         "_worker": "_lock",
+        "_hits": "_lock",
+        "_misses": "_lock",
+        "_evictions": "_lock",
     }
 
     def __init__(self, capacity: int = 16):
@@ -226,6 +270,9 @@ class CompiledLadder:
         self._inflight: dict = {}        # key -> threading.Event
         self._queue: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
         install_compile_listener()
 
     # ---- introspection ---------------------------------------------------
@@ -248,12 +295,28 @@ class CompiledLadder:
 
     # ---- core ------------------------------------------------------------
 
+    def summary(self) -> dict:
+        """This ladder's reuse ledger: hits (a warm program served
+        without any build), misses (synchronous builds on the calling
+        thread), evictions, current occupancy and capacity — the
+        warm-worker observability scalars the serve bench and the
+        compact bench line report."""
+        with self._lock:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "size": len(self._cache),
+                "capacity": self.capacity,
+            }
+
     def _insert(self, key, value):
         with self._lock:
             self._cache[key] = value
             self._cache.move_to_end(key)
             while len(self._cache) > self.capacity:
                 evicted, _ = self._cache.popitem(last=False)
+                self._evictions += 1
                 REGISTRY.counter(
                     "autotune_ladder_evictions_total",
                     "compiled programs dropped by the ladder LRU").inc()
@@ -268,6 +331,11 @@ class CompiledLadder:
             with self._lock:
                 if key in self._cache:
                     self._cache.move_to_end(key)
+                    self._hits += 1
+                    REGISTRY.counter(
+                        "autotune_ladder_hits_total",
+                        "warm compiled programs served by the "
+                        "ladder").inc()
                     return self._cache[key]
                 ev = self._inflight.get(key)
                 if ev is None:
@@ -281,6 +349,8 @@ class CompiledLadder:
             try:
                 with _spans.span("compile.miss", key=str(key)):
                     value = build()
+                with self._lock:
+                    self._misses += 1
                 REGISTRY.counter(
                     "autotune_compile_misses_total",
                     "synchronous ladder builds").inc()

@@ -226,6 +226,40 @@ def _render_top(run_dir) -> str:
         f"resilience: retries={tot['retries']} "
         f"degrades={tot['degrades']} checkpoints={tot['checkpoints']} "
         f"faults={tot['faults']} flight_dumps={tot['flights']}")
+    # the serving tier (serve/): studies totals from the same snapshots
+    # (counters summed across workers, point-in-time gauges maxed) plus
+    # the per-tenant attribution table
+    serve_vals = {}
+    for s in snaps:
+        for k, v in (s.get("metrics") or {}).items():
+            if (k.startswith("serve_") and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                serve_vals.setdefault(k, []).append(float(v))
+    if serve_vals:
+        from ..telemetry.aggregate import _SERVE_GAUGES
+
+        def sv(key):
+            vals = serve_vals.get(key, [0.0])
+            return max(vals) if key in _SERVE_GAUGES else sum(vals)
+
+        looked = sv("serve_cache_hits_total") + sv(
+            "serve_cache_misses_total")
+        lines.append(
+            f"serve: studies={int(sv('serve_studies_total'))} "
+            f"multiplexed="
+            f"{int(sv('serve_multiplexed_studies_total'))} "
+            f"queue={int(sv('serve_queue_depth'))} "
+            f"engines={int(sv('serve_engines_warm'))} "
+            f"cache_hit_ratio="
+            f"{sv('serve_cache_hits_total') / looked if looked else 0.0:.2f}")
+        tenants = sorted(
+            (k[len("serve_tenant_"):-len("_studies_total")], sv(k))
+            for k in serve_vals
+            if k.startswith("serve_tenant_")
+            and k.endswith("_studies_total"))
+        if tenants:
+            lines.append("  tenants: " + " ".join(
+                f"{t}={int(n)}" for t, n in tenants))
     lines.extend(rows or ["  (no telemetry snapshots yet)"])
     # recent generations across the fleet, newest last
     tail = []

@@ -807,6 +807,10 @@ def build_onedispatch_run(
         budget_rounds = jnp.asarray(ctl["budget_rounds"], jnp.int32)
         t_limit = jnp.asarray(ctl["t_limit"], jnp.int32)
         final_rel = jnp.asarray(ctl["final_rel"], jnp.int32)
+        # which progress word the in-flight callbacks advance: a traced
+        # operand, so one compiled program serves every run (and a serve
+        # worker's interleaved studies never clobber each other)
+        run_tag = jnp.asarray(ctl.get("run_tag", 0), jnp.int32)
 
         def _wire_of(c, k):
             ff = jnp.bool_(False) if stoch else None
@@ -890,7 +894,7 @@ def build_onedispatch_run(
                 # observation: nothing here feeds back into the trace.
                 jax.debug.callback(device_progress_update, t1, eps_t,
                                    count1, rounds_tot1, written,
-                                   ordered=False)
+                                   run_tag, ordered=False)
             return (pop1, t1, new_code, stop_t1, stop_count1,
                     rounds_tot1, bufs1), None
 

@@ -48,6 +48,12 @@ class ShardedSampler(VectorizedSampler):
         # every round's batch must split evenly over devices
         self.min_batch_size = max(self.min_batch_size, self.n_devices)
 
+    def _state_out_sharding(self):
+        # pin the stateful-loop carry to the mesh-replicated layout XLA
+        # converges to anyway, so the first generation on a rung
+        # compiles the same signature a reset-renewed carry presents
+        return jax.sharding.NamedSharding(self.mesh, P())
+
     def _round_to_valid_batch(self, b: float) -> int:
         nd = self.n_devices
         # power-of-two ladder + pow-of-two device counts always divide

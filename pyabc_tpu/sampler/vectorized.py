@@ -32,8 +32,8 @@ from typing import Callable, Dict, Tuple
 import jax
 import numpy as np
 
-from ..autotune import (BatchAutotuner, CompiledLadder, aot_compile,
-                        avals_like, jit_compile)
+from ..autotune import (AotGuard, BatchAutotuner, CompiledLadder,
+                        aot_compile, avals_like, jit_compile)
 from ..resilience import faults as _faults
 from ..resilience import retry as _retry
 from ..wire.transfer import egress as _egress
@@ -110,6 +110,15 @@ class VectorizedSampler(Sampler):
         raw = self._raw_round(round_fn, B, **static_kwargs)
         return jit_compile(raw) if self._jit else raw
 
+    def _state_out_sharding(self):
+        """Canonical sharding for the stateful-loop carry, or None to
+        let XLA place it.  Mesh samplers pin the carry so the FIRST
+        generation's programs compile with the steady-state signature
+        (``start``'s unpinned output would be single-device while every
+        ``reset``-renewed carry is mesh-replicated — one avoidable
+        retrace per loop fn on the second run)."""
+        return None
+
     def _build_stateful(self, round_fn: Callable, B: int, n_target: int,
                         record_cap: int, d: int, s: int,
                         defer: bool = False, wire_stats: bool = True,
@@ -128,8 +137,10 @@ class VectorizedSampler(Sampler):
             wire_m_bits=wire_m_bits)
         start, step, finalize, harvest, reset, step_finalize = fns
         if self._jit:
+            sh = self._state_out_sharding()
+            start_kw = {} if sh is None else {"out_shardings": sh}
             # donate the carry so the cap-sized buffers update in place
-            return (jit_compile(start),
+            return (jit_compile(start, **start_kw),
                     jit_compile(step, donate_argnums=(2,)),
                     jit_compile(finalize), jit_compile(harvest),
                     jit_compile(reset, donate_argnums=(0,)),
@@ -175,7 +186,17 @@ class VectorizedSampler(Sampler):
                 # generation on this rung; reset() waits for the NEXT
                 # one — AOT it now so steady state stays compile-free
                 start, step, finalize, harvest, reset, step_finalize = fns
-                reset = aot_compile(reset, jax.eval_shape(start))
+                state_aval = jax.eval_shape(start)
+                sh = self._state_out_sharding()
+                if sh is not None:
+                    # eval_shape drops out_shardings; re-pin the carry
+                    # avals so reset's AOT signature matches the state
+                    # it will actually receive
+                    state_aval = jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            a.shape, a.dtype, sharding=sh,
+                            weak_type=a.weak_type), state_aval)
+                reset = aot_compile(reset, state_aval)
                 return (start, step, finalize, harvest, reset,
                         step_finalize)
         return self._ladder.get(cache_key, build)
@@ -499,6 +520,11 @@ class VectorizedSampler(Sampler):
         # bound the cache so states orphaned by a batch-ladder change
         # don't pin device memory
         self._states[loop_key] = state
+        if isinstance(reset, AotGuard):
+            # reset was AOT'd from eval_shape avals before any concrete
+            # state existed; re-pin it to the live carry's shardings
+            # (no-op unless they drifted, e.g. under a device mesh)
+            reset.specialize(state)
         while len(self._states) > 4:
             self._states.pop(next(iter(self._states)))
         if pending is not None:

@@ -1,0 +1,44 @@
+"""ABC-as-a-service: multi-tenant study serving on warm workers.
+
+The serving tier turns "a fast run" (one :class:`~pyabc_tpu.ABCSMC`
+driving one study) into "a service": many small studies from many
+tenants multiplexed onto a persistent worker that keeps its compiled
+programs warm across studies.  Five pieces:
+
+- :mod:`pyabc_tpu.serve.spec` — the study spec (prior + model +
+  distance + eps config + observed data) and its canonical
+  content-address digest;
+- :mod:`pyabc_tpu.serve.queue` — the admission queue over the
+  ``parallel/`` mount contract, with per-tenant quotas, backpressure
+  and priority aging;
+- :mod:`pyabc_tpu.serve.cache` — the content-addressed study cache
+  (digest → posterior summary) serving duplicate submissions without a
+  dispatch;
+- :mod:`pyabc_tpu.serve.multiplex` — the study axis: N small studies
+  vmapped into ONE fused program with per-study live-sentinel masking;
+- :mod:`pyabc_tpu.serve.worker` — the persistent warm worker
+  (``abc-serve``) pinning the AOT :class:`CompiledLadder` across
+  studies and routing eligible ones through ``run_mode="onedispatch"``.
+
+All serving knobs are serve-prefixed environment variables,
+documented in ``docs/serving.md``.
+"""
+
+from .cache import StudyCache
+from .multiplex import StudyBatch, multiplex_eligible
+from .queue import QueueFull, StudyQueue, TenantQuotaExceeded
+from .spec import StudySpec, problem_key, study_digest
+from .worker import ServeWorker
+
+__all__ = [
+    "QueueFull",
+    "ServeWorker",
+    "StudyBatch",
+    "StudyCache",
+    "StudyQueue",
+    "StudySpec",
+    "TenantQuotaExceeded",
+    "multiplex_eligible",
+    "problem_key",
+    "study_digest",
+]

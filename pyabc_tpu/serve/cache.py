@@ -1,0 +1,149 @@
+"""Content-addressed study cache: digest → posterior summary.
+
+Duplicate submissions are the cheapest studies to serve: the digest
+(:func:`pyabc_tpu.serve.spec.study_digest`) covers everything that can
+move the posterior, so a digest hit IS the result — no queue slot, no
+dispatch, no device time.  The cache is a bounded in-memory LRU with
+optional directory persistence (one JSON file per digest under
+``<serve dir>/cache/``) so a restarted worker re-serves its history;
+hit/miss/eviction counters land in the ``serve_*`` telemetry namespace
+(fleet snapshots, ``abc-top``, ``/api/serve``, Prometheus
+``pyabc_tpu_serve_*``).
+
+Capacity knob: ``PYABC_TPU_SERVE_CACHE_SIZE`` (entries, default 64).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from ..telemetry.metrics import REGISTRY
+
+#: cache capacity env knob (entries)
+CACHE_SIZE_ENV = "PYABC_TPU_SERVE_CACHE_SIZE"
+
+_DEFAULT_CAPACITY = 64
+
+
+def cache_capacity() -> int:
+    try:
+        return max(int(os.environ.get(CACHE_SIZE_ENV,
+                                      str(_DEFAULT_CAPACITY))), 1)
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+class StudyCache:
+    """Bounded LRU of study results keyed by content digest.
+
+    ``get`` counts a hit or a miss (instance ledger + the ``serve_*``
+    registry counters); ``put`` inserts and optionally persists.  A
+    memory miss falls through to the persistence directory before
+    counting as a miss — a warm DISK is still a served duplicate.
+    """
+
+    #: lock-discipline contract, enforced by `abc-lint`
+    _GUARDED_BY = {"_entries": "_lock", "_hits": "_lock",
+                   "_misses": "_lock", "_evictions": "_lock"}
+
+    def __init__(self, capacity: Optional[int] = None,
+                 root: Optional[str] = None):
+        self.capacity = (cache_capacity() if capacity is None
+                         else max(int(capacity), 1))
+        self.root = root
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        if root:
+            os.makedirs(os.path.join(root), exist_ok=True)
+
+    # ---- persistence -----------------------------------------------------
+
+    def _path(self, digest: str) -> Optional[str]:
+        return None if not self.root else os.path.join(
+            self.root, f"{digest}.json")
+
+    def _load_persisted(self, digest: str) -> Optional[dict]:
+        path = self._path(digest)
+        if path is None or not os.path.exists(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _persist(self, digest: str, summary: dict):
+        path = self._path(digest)
+        if path is None:
+            return
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(summary, f)
+            os.replace(tmp, path)  # atomic on POSIX
+        except OSError:
+            pass  # persistence is an optimization, never a failure
+
+    # ---- core ------------------------------------------------------------
+
+    def get(self, digest: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                self._entries.move_to_end(digest)
+                self._hits += 1
+                REGISTRY.counter(
+                    "serve_cache_hits_total",
+                    "duplicate studies served from the content-"
+                    "addressed cache").inc()
+                return dict(entry)
+        persisted = self._load_persisted(digest)
+        with self._lock:
+            if persisted is not None:
+                self._insert_locked(digest, persisted)
+                self._hits += 1
+                REGISTRY.counter(
+                    "serve_cache_hits_total",
+                    "duplicate studies served from the content-"
+                    "addressed cache").inc()
+                return dict(persisted)
+            self._misses += 1
+            REGISTRY.counter(
+                "serve_cache_misses_total",
+                "study digests not found in the cache").inc()
+            return None
+
+    def put(self, digest: str, summary: dict):
+        with self._lock:
+            self._insert_locked(digest, dict(summary))
+        self._persist(digest, summary)
+
+    def _insert_locked(self, digest: str, summary: dict):
+        self._entries[digest] = summary
+        self._entries.move_to_end(digest)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            self._evictions += 1
+            REGISTRY.counter(
+                "serve_cache_evictions_total",
+                "study results dropped by the cache LRU").inc()
+
+    def stats(self) -> dict:
+        with self._lock:
+            looked = self._hits + self._misses
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "size": len(self._entries),
+                "capacity": self.capacity,
+                "hit_ratio": (self._hits / looked) if looked else 0.0,
+            }

@@ -1,0 +1,317 @@
+"""The study axis: N small studies fused into ONE vmapped program.
+
+A serving fleet's traffic is dominated by *small* studies — the same
+simulator applied to many tenants' observed datasets, each with its own
+seed and stop budget.  Running them one-by-one pays a full dispatch
+(and its host↔device round-trips) per study; the multiplexer instead
+stacks eligible studies along a leading *study axis* and ``vmap``\\ s a
+self-contained ABC-SMC engine over it: one compiled program, one
+dispatch, ``S`` posteriors.
+
+Eligibility (:func:`batch_key`) is what the compiled program shapes
+depend on: same model code, same prior config, same population size,
+same flattened stat width, same distance ``p`` and quantile ``alpha``.
+Observed data, seed, ``minimum_epsilon`` and ``max_generations`` ride
+as per-study operands — tenants with different datasets DO batch.  The
+study count is padded to a power-of-two rung (dead slots carry
+``live=False`` from step 0) so batch sizes 3, 5, 7 share one program.
+
+Determinism contract — the acceptance bar pinned by
+``tests/test_serve.py``: every lane is **bit-identical** to the same
+study served through a batch of one.  Everything in the engine is
+study-local (``fold_in`` RNG chains, row-wise sort / cumsum /
+searchsorted / logsumexp, no cross-study reductions), the generation
+loop is a fixed-trip ``fori_loop`` with explicit ``live`` masking, and
+stopping never changes shapes — so the batched lanes and the solo lane
+trace to the same per-element op sequence.
+
+Knob: ``PYABC_TPU_SERVE_MULTIPLEX`` — max studies per batch
+(default 8; ``1`` disables multiplexing).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .spec import (StudySpec, _callable_fingerprint, _digest_of,
+                   _prior_config)
+
+#: max studies fused per batch (1 disables the study axis)
+MULTIPLEX_ENV = "PYABC_TPU_SERVE_MULTIPLEX"
+
+_DEFAULT_MULTIPLEX = 8
+
+#: rejection rounds per generation before a lane declares undershoot
+_MAX_ROUNDS = 16
+
+#: stop codes, mirrored in result dicts
+STOP_RUNNING = 0
+STOP_MIN_EPS = 1
+STOP_BUDGET = 2
+STOP_UNDERSHOOT = 3
+
+
+def multiplex_width() -> int:
+    try:
+        return max(int(os.environ.get(MULTIPLEX_ENV,
+                                      str(_DEFAULT_MULTIPLEX))), 1)
+    except ValueError:
+        return _DEFAULT_MULTIPLEX
+
+
+def _pow2_ceil(x: int) -> int:
+    r = 1
+    while r < x:
+        r *= 2
+    return r
+
+
+def _stat_layout(observed: Dict) -> Tuple[Tuple[str, int], ...]:
+    """Flattened stat layout in canonical (sorted-key) order."""
+    return tuple(
+        (k, int(np.asarray(observed[k]).size)) for k in sorted(observed))
+
+
+def batch_key(spec: StudySpec) -> str:
+    """What the compiled batched program depends on — the grouping key
+    for :func:`multiplex_eligible`.  Observed VALUES are per-study
+    operands; only their flattened layout is shape."""
+    return _digest_of({
+        "model": _callable_fingerprint(spec.model),
+        "prior": _prior_config(spec.prior),
+        "layout": list(_stat_layout(spec.observed)),
+        "population_size": int(spec.population_size),
+        "distance_p": float(spec.distance_p),
+        "alpha": float(spec.alpha),
+        "min_acceptance_rate": float(spec.min_acceptance_rate),
+    })
+
+
+def multiplex_eligible(specs: Sequence[StudySpec],
+                       max_batch: Optional[int] = None
+                       ) -> List[List[StudySpec]]:
+    """Group studies into batches that can share one program.  Order
+    within a group follows submission order; groups are capped at the
+    multiplex width.  Singleton groups are returned too — the worker
+    decides whether a batch of one goes solo (it does)."""
+    cap = multiplex_width() if max_batch is None else max(int(max_batch), 1)
+    groups: "Dict[str, List[StudySpec]]" = {}
+    order: List[str] = []
+    for s in specs:
+        k = batch_key(s)
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(s)
+    out: List[List[StudySpec]] = []
+    for k in order:
+        g = groups[k]
+        for i in range(0, len(g), cap):
+            out.append(g[i:i + cap])
+    return out
+
+
+def _flatten_stats(stats: Dict, layout, n: int):
+    cols = [jnp.reshape(stats[k], (n, -1)) for k, _w in layout]
+    return jnp.concatenate(cols, axis=-1).astype(jnp.float32)
+
+
+def _flatten_observed(observed: Dict, layout) -> np.ndarray:
+    cols = [np.asarray(observed[k], dtype=np.float32).reshape(-1)
+            for k, _w in layout]
+    return np.concatenate(cols) if cols else np.zeros((0,), np.float32)
+
+
+class StudyBatch:
+    """One batch of eligible studies compiled into a single vmapped
+    SMC program (see module docstring for the engine and determinism
+    contract).  Instances own their compiled function — serve-tier
+    state lives on objects, never at module level (the
+    ``study-isolation`` lint rule enforces this for the package)."""
+
+    def __init__(self, specs: Sequence[StudySpec],
+                 max_rounds: int = _MAX_ROUNDS):
+        if not specs:
+            raise ValueError("empty study batch")
+        keys = {batch_key(s) for s in specs}
+        if len(keys) > 1:
+            raise ValueError("studies are not batch-eligible together")
+        self.specs = list(specs)
+        spec = self.specs[0]
+        self.model = spec.model
+        self.prior = spec.prior
+        self.n = int(spec.population_size)
+        self.d = int(spec.prior.dim)
+        self.layout = _stat_layout(spec.observed)
+        self.k = sum(w for _k, w in self.layout)
+        self.p = float(spec.distance_p)
+        self.alpha = float(spec.alpha)
+        self.max_rounds = int(max_rounds)
+        self.rung = _pow2_ceil(len(self.specs))
+        # static generation budget: pow2 rung over the batch's largest
+        # ask, so nearby budgets share one program
+        self.max_t = _pow2_ceil(
+            max(max(int(s.max_generations), 1) for s in self.specs))
+        self._fn = jax.jit(jax.vmap(self._one_study))
+
+    # ---- per-study engine (runs under vmap over the study axis) ---------
+
+    def _distance(self, x, y_obs):
+        diff = jnp.abs(x - y_obs)
+        if self.p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        return jnp.sum(diff ** self.p, axis=-1) ** (1.0 / self.p)
+
+    def _weighted_quantile(self, dist, w):
+        order = jnp.argsort(dist)
+        cw = jnp.cumsum(w[order])
+        idx = jnp.searchsorted(cw, self.alpha * cw[-1])
+        return dist[order[jnp.minimum(idx, self.n - 1)]]
+
+    def _gen_step(self, key, theta, w, dist, y_obs, t):
+        """One SMC generation: shrink eps to the weighted alpha-
+        quantile of the previous distances, then fill n slots by
+        importance resampling + Gaussian perturbation over at most
+        ``max_rounds`` rounds of n candidates."""
+        n, d = self.n, self.d
+        eps_t = self._weighted_quantile(dist, w)
+        mu = jnp.sum(w[:, None] * theta, axis=0)
+        var = jnp.sum(w[:, None] * (theta - mu) ** 2, axis=0)
+        sigma = jnp.sqrt(jnp.maximum(2.0 * var, 1e-12))
+        cw = jnp.cumsum(w)
+        gen_key = jax.random.fold_in(key, t)
+
+        def round_body(carry, r):
+            filled, o_theta, o_dist = carry
+            active = filled < n
+            kr = jax.random.fold_in(gen_key, r)
+            k1, k2, k3 = jax.random.split(kr, 3)
+            u = jax.random.uniform(k1, (n,))
+            anc = jnp.minimum(
+                jnp.searchsorted(cw, u * cw[-1], side="right"), n - 1)
+            step = jax.random.normal(k2, (n, d)) * sigma
+            theta_star = theta[anc] + step
+            ok_prior = self.prior.log_pdf_array(theta_star) > -jnp.inf
+            x = _flatten_stats(self.model(k3, theta_star),
+                               self.layout, n)
+            dist_star = self._distance(x, y_obs)
+            acc = active & ok_prior & (dist_star <= eps_t)
+            pos = filled + jnp.cumsum(acc.astype(jnp.int32)) - 1
+            slot = jnp.where(acc & (pos < n), pos, n)  # n == dropped
+            o_theta = o_theta.at[slot].set(theta_star, mode="drop")
+            o_dist = o_dist.at[slot].set(dist_star, mode="drop")
+            filled = jnp.minimum(
+                filled + jnp.sum(acc.astype(jnp.int32)), n)
+            return ((filled, o_theta, o_dist),
+                    active.astype(jnp.int32))
+
+        init = (jnp.int32(0), jnp.zeros_like(theta),
+                jnp.zeros_like(dist))
+        (filled, new_theta, new_dist), active_rounds = jax.lax.scan(
+            round_body, init, jnp.arange(self.max_rounds))
+        success = filled >= n
+
+        # importance weights: prior / kernel mixture, in log space
+        log_prior = self.prior.log_pdf_array(new_theta)
+        diff = new_theta[:, None, :] - theta[None, :, :]
+        log_kern = -0.5 * jnp.sum(
+            diff * diff / sigma ** 2
+            + jnp.log(2.0 * jnp.pi * sigma ** 2), axis=-1)
+        log_den = jax.scipy.special.logsumexp(
+            log_kern + jnp.log(w)[None, :], axis=1)
+        log_w = log_prior - log_den
+        new_w = jnp.exp(log_w - jax.scipy.special.logsumexp(log_w))
+        return (success, eps_t, new_theta, new_w, new_dist,
+                jnp.sum(active_rounds))
+
+    def _one_study(self, key, y_obs, min_eps, t_limit, alive):
+        """Whole-study program for ONE lane.  Everything here is
+        study-local; ``vmap`` lifts it onto the study axis without
+        cross-lane math — the bit-identity contract."""
+        n = self.n
+        # generation 0: straight prior draw, uniform weights
+        k0 = jax.random.fold_in(key, 0)
+        k_prior, k_model = jax.random.split(k0)
+        theta = self.prior.rvs_array(k_prior, n)
+        x0 = _flatten_stats(self.model(k_model, theta), self.layout, n)
+        dist = self._distance(x0, y_obs)
+        w = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
+        eps0 = jnp.asarray(jnp.inf, jnp.float32)
+
+        live0 = alive & (t_limit > 1)
+        code0 = jnp.where(alive,
+                          jnp.where(live0, STOP_RUNNING, STOP_BUDGET),
+                          STOP_BUDGET)
+        carry0 = (theta, w, dist, eps0, jnp.int32(1), live0,
+                  code0.astype(jnp.int32), jnp.int32(n), jnp.int32(0))
+
+        def body(i, carry):
+            (theta, w, dist, eps, gens, live, code, acc_tot,
+             rounds_tot) = carry
+            success, eps_t, n_theta, n_w, n_dist, rounds = \
+                self._gen_step(key, theta, w, dist, y_obs, gens)
+            adv = live & success
+            theta = jnp.where(adv, n_theta, theta)
+            w = jnp.where(adv, n_w, w)
+            dist = jnp.where(adv, n_dist, dist)
+            eps = jnp.where(adv, eps_t, eps)
+            gens = jnp.where(adv, gens + 1, gens)
+            acc_tot = jnp.where(adv, acc_tot + n, acc_tot)
+            rounds_tot = jnp.where(live, rounds_tot + rounds,
+                                   rounds_tot)
+            hit_eps = adv & (eps_t <= min_eps)
+            hit_budget = adv & (gens >= t_limit)
+            undershoot = live & ~success
+            code = jnp.where(
+                live, jnp.where(
+                    undershoot, STOP_UNDERSHOOT, jnp.where(
+                        hit_eps, STOP_MIN_EPS, jnp.where(
+                            hit_budget, STOP_BUDGET, STOP_RUNNING))),
+                code)
+            live = live & success & ~hit_eps & ~hit_budget
+            return (theta, w, dist, eps, gens, live,
+                    code.astype(jnp.int32), acc_tot, rounds_tot)
+
+        (theta, w, dist, eps, gens, live, code, acc_tot,
+         rounds_tot) = jax.lax.fori_loop(0, self.max_t, body, carry0)
+        code = jnp.where(live, STOP_BUDGET, code)
+        return {
+            "theta": theta, "w": w, "dist": dist, "eps": eps,
+            "gens": gens, "stop_code": code, "accepted": acc_tot,
+            "rounds": rounds_tot,
+        }
+
+    # ---- batch driver ----------------------------------------------------
+
+    def _operands(self):
+        S, k = self.rung, self.k
+        keys = np.zeros((S,) + np.asarray(
+            jax.random.PRNGKey(0)).shape, np.uint32)
+        y_obs = np.zeros((S, k), np.float32)
+        min_eps = np.zeros((S,), np.float32)
+        t_limit = np.zeros((S,), np.int32)
+        alive = np.zeros((S,), bool)
+        for i, s in enumerate(self.specs):
+            keys[i] = np.asarray(jax.random.PRNGKey(int(s.seed)))
+            y_obs[i] = _flatten_observed(s.observed, self.layout)
+            min_eps[i] = float(s.minimum_epsilon)
+            t_limit[i] = max(int(s.max_generations), 1)
+            alive[i] = True
+        return (jnp.asarray(keys), jnp.asarray(y_obs),
+                jnp.asarray(min_eps), jnp.asarray(t_limit),
+                jnp.asarray(alive))
+
+    def run(self) -> List[dict]:
+        """Dispatch the batch; returns one result dict per submitted
+        study (dead padding lanes are dropped)."""
+        out = self._fn(*self._operands())
+        out = jax.tree_util.tree_map(np.asarray, out)
+        results = []
+        for i, _s in enumerate(self.specs):
+            results.append({k: v[i] for k, v in out.items()})
+        return results

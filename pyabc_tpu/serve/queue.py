@@ -1,0 +1,346 @@
+"""Admission queue over the ``parallel/`` mount contract.
+
+The reference pyABC farms studies through a redis broker
+(``abc-redis-manager`` + workers); the TPU-native serving tier keeps
+the same manager/worker split but rides the existing run-dir mount
+contract (``parallel/health.py``): the queue IS a directory any
+shared filesystem all hosts mount, studies are single JSON files, and
+every state transition is one atomic ``rename`` — no broker process,
+no connection state, crash-safe by construction.
+
+Layout under the serve root (``$PYABC_TPU_SERVE_DIR``, defaulting to
+``$PYABC_TPU_RUN_DIR/serve``)::
+
+    queue/pending/<id>.json            submitted, unclaimed
+    queue/claimed/<worker>/<id>.json   claimed by one worker (rename)
+    queue/done/<id>.json               served (result in the cache)
+    queue/failed/<id>.json             exhausted its attempts
+
+Admission enforces *backpressure* (``PYABC_TPU_SERVE_MAX_DEPTH``
+pending studies total → :class:`QueueFull`) and *per-tenant quotas*
+(``PYABC_TPU_SERVE_TENANT_QUOTA`` pending per tenant →
+:class:`TenantQuotaExceeded`) so one tenant cannot starve the fleet.
+Claiming orders by *aged priority*: ``priority + age_s /
+PYABC_TPU_SERVE_AGING_S`` — a low-priority study waiting long enough
+eventually outranks fresh high-priority traffic, so nothing starves.
+A SIGTERM-draining worker :meth:`~StudyQueue.requeue`\\ s its claimed
+studies back to pending (``requeues`` is incremented — the poison-pill
+ledger).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import pickle
+import socket
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..telemetry.metrics import REGISTRY
+from .spec import StudySpec, study_digest
+
+#: serve root (queue + cache persistence); default <run dir>/serve
+SERVE_DIR_ENV = "PYABC_TPU_SERVE_DIR"
+
+#: global backpressure: max pending studies before submit rejects
+MAX_DEPTH_ENV = "PYABC_TPU_SERVE_MAX_DEPTH"
+
+#: per-tenant admission quota (pending studies per tenant)
+TENANT_QUOTA_ENV = "PYABC_TPU_SERVE_TENANT_QUOTA"
+
+#: priority aging: seconds of queue age worth +1 effective priority
+AGING_S_ENV = "PYABC_TPU_SERVE_AGING_S"
+
+_DEFAULT_MAX_DEPTH = 256
+_DEFAULT_TENANT_QUOTA = 32
+_DEFAULT_AGING_S = 30.0
+
+
+class QueueFull(RuntimeError):
+    """Global backpressure: the pending queue is at max depth."""
+
+
+class TenantQuotaExceeded(QueueFull):
+    """This tenant's pending share is at its admission quota."""
+
+
+def serve_root(root: Optional[str] = None) -> str:
+    """Resolve the serve directory: explicit arg >
+    ``$PYABC_TPU_SERVE_DIR`` > ``$PYABC_TPU_RUN_DIR/serve`` >
+    ``./abc-serve``."""
+    if root:
+        return root
+    env = os.environ.get(SERVE_DIR_ENV)
+    if env:
+        return env
+    from ..parallel import health
+    run_dir = os.environ.get(health.RUN_DIR_ENV)
+    if run_dir:
+        return os.path.join(run_dir, "serve")
+    return os.path.abspath("abc-serve")
+
+
+def default_worker_id() -> str:
+    return f"{socket.gethostname()}_{os.getpid()}"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(int(os.environ.get(name, str(default))), 1)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return max(float(os.environ.get(name, str(default))), 1e-3)
+    except ValueError:
+        return default
+
+
+@dataclass
+class Ticket:
+    """One study's queue entry: admission metadata in the clear, the
+    spec itself pickled (the redis sampler's cloudpickle analog) so a
+    different worker process can reconstruct the callables."""
+
+    id: str
+    digest: str
+    tenant: str
+    priority: int
+    submitted_unix: float
+    requeues: int = 0
+    path: Optional[str] = None
+    _payload: Optional[dict] = field(default=None, repr=False)
+
+    def load_spec(self) -> StudySpec:
+        return pickle.loads(
+            base64.b64decode(self._payload["spec_b64"]))
+
+    def effective_priority(self, aging_s: float,
+                           now: Optional[float] = None) -> float:
+        age = (time.time() if now is None else now) - self.submitted_unix
+        return self.priority + max(age, 0.0) / aging_s
+
+
+def _ticket_from_file(path: str) -> Optional[Ticket]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        return Ticket(
+            id=payload["id"], digest=payload["digest"],
+            tenant=payload.get("tenant", "default"),
+            priority=int(payload.get("priority", 0)),
+            submitted_unix=float(payload.get("submitted_unix", 0.0)),
+            requeues=int(payload.get("requeues", 0)),
+            path=path, _payload=payload)
+    except (OSError, ValueError, KeyError):
+        return None  # torn read during a concurrent rename: skip
+
+
+class StudyQueue:
+    """Directory-backed admission queue (see module docstring)."""
+
+    def __init__(self, root: Optional[str] = None,
+                 max_depth: Optional[int] = None,
+                 tenant_quota: Optional[int] = None,
+                 aging_s: Optional[float] = None):
+        self.root = os.path.join(serve_root(root), "queue")
+        self.max_depth = (_env_int(MAX_DEPTH_ENV, _DEFAULT_MAX_DEPTH)
+                          if max_depth is None else int(max_depth))
+        self.tenant_quota = (
+            _env_int(TENANT_QUOTA_ENV, _DEFAULT_TENANT_QUOTA)
+            if tenant_quota is None else int(tenant_quota))
+        self.aging_s = (_env_float(AGING_S_ENV, _DEFAULT_AGING_S)
+                        if aging_s is None else float(aging_s))
+        for state in ("pending", "claimed", "done", "failed"):
+            os.makedirs(os.path.join(self.root, state), exist_ok=True)
+
+    # ---- introspection ---------------------------------------------------
+
+    def _dir(self, state: str) -> str:
+        return os.path.join(self.root, state)
+
+    def _list(self, state: str) -> List[Ticket]:
+        out = []
+        base = self._dir(state)
+        walk = ([(base, None, sorted(os.listdir(base)))] if state
+                != "claimed" else list(os.walk(base)))
+        for dirpath, _dirs, names in walk:
+            for name in sorted(names):
+                if not name.endswith(".json"):
+                    continue
+                t = _ticket_from_file(os.path.join(dirpath, name))
+                if t is not None:
+                    out.append(t)
+        return out
+
+    def pending(self) -> List[Ticket]:
+        return self._list("pending")
+
+    def claimed(self) -> List[Ticket]:
+        return self._list("claimed")
+
+    def depth(self) -> int:
+        return sum(1 for n in os.listdir(self._dir("pending"))
+                   if n.endswith(".json"))
+
+    def stats(self) -> dict:
+        per_tenant: dict = {}
+        pending = self.pending()
+        for t in pending:
+            per_tenant[t.tenant] = per_tenant.get(t.tenant, 0) + 1
+        return {
+            "pending": len(pending),
+            "claimed": len(self.claimed()),
+            "done": len([n for n in os.listdir(self._dir("done"))
+                         if n.endswith(".json")]),
+            "failed": len([n for n in os.listdir(self._dir("failed"))
+                           if n.endswith(".json")]),
+            "max_depth": self.max_depth,
+            "tenant_quota": self.tenant_quota,
+            "aging_s": self.aging_s,
+            "pending_by_tenant": per_tenant,
+        }
+
+    # ---- producer side ---------------------------------------------------
+
+    def submit(self, spec: StudySpec) -> Ticket:
+        """Admit one study; raises :class:`QueueFull` /
+        :class:`TenantQuotaExceeded` instead of queueing unboundedly —
+        backpressure the submitter can see and retry against."""
+        pending = self.pending()
+        if len(pending) >= self.max_depth:
+            REGISTRY.counter(
+                "serve_queue_rejected_total",
+                "study submissions rejected by admission control").inc()
+            raise QueueFull(
+                f"queue at max depth {self.max_depth}")
+        tenant = spec.tenant or "default"
+        mine = sum(1 for t in pending if t.tenant == tenant)
+        if mine >= self.tenant_quota:
+            REGISTRY.counter(
+                "serve_queue_rejected_total",
+                "study submissions rejected by admission control").inc()
+            raise TenantQuotaExceeded(
+                f"tenant {tenant!r} at quota {self.tenant_quota}")
+        digest = study_digest(spec)
+        sid = f"{time.time_ns():019d}-{digest[:12]}-{uuid.uuid4().hex[:8]}"
+        payload = {
+            "id": sid,
+            "digest": digest,
+            "tenant": tenant,
+            "priority": int(spec.priority),
+            "submitted_unix": time.time(),
+            "requeues": 0,
+            "spec_b64": base64.b64encode(
+                pickle.dumps(spec)).decode("ascii"),
+        }
+        path = os.path.join(self._dir("pending"), f"{sid}.json")
+        self._write_atomic(path, payload)
+        REGISTRY.counter(
+            "serve_queue_submitted_total",
+            "studies admitted into the serve queue").inc()
+        return Ticket(id=sid, digest=digest, tenant=tenant,
+                      priority=int(spec.priority),
+                      submitted_unix=payload["submitted_unix"],
+                      path=path, _payload=payload)
+
+    def _write_atomic(self, path: str, payload: dict):
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   suffix=".tmp")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    # ---- worker side -----------------------------------------------------
+
+    def claim(self, worker_id: Optional[str] = None) -> Optional[Ticket]:
+        """Claim the highest aged-priority pending study (atomic
+        rename; a lost race just moves on to the next candidate)."""
+        worker_id = worker_id or default_worker_id()
+        wdir = os.path.join(self._dir("claimed"), worker_id)
+        os.makedirs(wdir, exist_ok=True)
+        now = time.time()
+        candidates = sorted(
+            self.pending(),
+            key=lambda t: (-t.effective_priority(self.aging_s, now),
+                           t.submitted_unix, t.id))
+        for t in candidates:
+            dest = os.path.join(wdir, os.path.basename(t.path))
+            try:
+                os.rename(t.path, dest)
+            except OSError:
+                continue  # another worker won this one
+            t.path = dest
+            return t
+        return None
+
+    def _move(self, ticket: Ticket, state: str, extra: dict) -> str:
+        payload = dict(ticket._payload or {})
+        payload.update(extra)
+        dest = os.path.join(self._dir(state), f"{ticket.id}.json")
+        self._write_atomic(dest, payload)
+        if ticket.path and os.path.exists(ticket.path):
+            try:
+                os.unlink(ticket.path)
+            except OSError:
+                pass
+        ticket.path = dest
+        ticket._payload = payload
+        return dest
+
+    def complete(self, ticket: Ticket, wall_s: float = 0.0,
+                 engine: str = "solo"):
+        self._move(ticket, "done", {
+            "completed_unix": time.time(),
+            "wall_s": float(wall_s),
+            "engine": engine,
+        })
+
+    def fail(self, ticket: Ticket, error: str):
+        self._move(ticket, "failed", {
+            "failed_unix": time.time(),
+            "error": str(error)[:2000],
+        })
+
+    def requeue(self, ticket: Ticket):
+        """Return a claimed study to pending (SIGTERM drain, crashed
+        attempt) with its original submission time — its accumulated
+        age, and therefore its aged priority, survives the bounce."""
+        payload = dict(ticket._payload or {})
+        payload["requeues"] = int(payload.get("requeues", 0)) + 1
+        dest = os.path.join(self._dir("pending"), f"{ticket.id}.json")
+        self._write_atomic(dest, payload)
+        if ticket.path and os.path.exists(ticket.path):
+            try:
+                os.unlink(ticket.path)
+            except OSError:
+                pass
+        ticket.path = dest
+        ticket._payload = payload
+        ticket.requeues = payload["requeues"]
+        REGISTRY.counter(
+            "serve_queue_requeues_total",
+            "claimed studies returned to pending (drain/crash)").inc()
+
+    def requeue_worker(self, worker_id: str) -> int:
+        """Requeue EVERY study a worker still holds — the drain path's
+        bulk form, also the janitor's recovery for a crashed worker."""
+        wdir = os.path.join(self._dir("claimed"), worker_id)
+        if not os.path.isdir(wdir):
+            return 0
+        n = 0
+        for name in sorted(os.listdir(wdir)):
+            if not name.endswith(".json"):
+                continue
+            t = _ticket_from_file(os.path.join(wdir, name))
+            if t is not None:
+                self.requeue(t)
+                n += 1
+        return n

@@ -1,0 +1,391 @@
+"""The persistent warm worker (``abc-serve``).
+
+One worker process owns one accelerator and serves studies for as long
+as it lives.  The thing it is protecting is *warmth*: the AOT
+:class:`~pyabc_tpu.autotune.CompiledLadder` programs an
+:class:`~pyabc_tpu.ABCSMC` engine builds for its first study are the
+expensive part of a small study's wall clock, so the worker keeps a
+bounded pool of engines keyed by :func:`~pyabc_tpu.serve.spec
+.problem_key` and re-arms them with :meth:`ABCSMC.renew` — studies
+differing only in seed / ``minimum_epsilon`` / ``max_generations`` ride
+traced operands through the pinned one-dispatch program with **zero new
+XLA compiles** (the contract ``tests/test_serve.py`` pins with
+``compile_counters()``).
+
+Serving order per claimed batch:
+
+1. content-addressed cache (:mod:`~pyabc_tpu.serve.cache`) — a digest
+   hit is returned without any dispatch;
+2. the study axis (:mod:`~pyabc_tpu.serve.multiplex`) — ≥2 eligible
+   misses fuse into one vmapped dispatch;
+3. warm solo ``run_mode="onedispatch"`` on a pooled engine.
+
+SIGTERM starts a *drain*: the in-flight study finishes, every study
+still claimed is requeued (``StudyQueue.requeue_worker``), and the
+process exits — the mount-contract analog of the redis worker's
+graceful stop.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import threading
+import time
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..telemetry.metrics import REGISTRY
+from .cache import StudyCache
+from .multiplex import StudyBatch, multiplex_eligible, multiplex_width
+from .queue import StudyQueue, Ticket, default_worker_id, serve_root
+from .spec import StudySpec, problem_key, study_digest
+
+#: warm engines held per worker (LRU beyond this)
+_MAX_ENGINES = 4
+
+_TENANT_SAFE = re.compile(r"[^A-Za-z0-9_]")
+
+
+def _tenant_counter(tenant: str):
+    safe = _TENANT_SAFE.sub("_", tenant or "default")[:40]
+    return REGISTRY.counter(
+        f"serve_tenant_{safe}_studies_total",
+        "studies served, attributed per tenant")
+
+
+class ServeWorker:
+    """Multi-tenant study server on one warm accelerator process."""
+
+    def __init__(self, root: Optional[str] = None,
+                 worker_id: Optional[str] = None,
+                 cache: Optional[StudyCache] = None,
+                 max_engines: int = _MAX_ENGINES,
+                 run_mode: str = "onedispatch"):
+        self.root = serve_root(root)
+        self.worker_id = worker_id or default_worker_id()
+        self.cache = cache if cache is not None else StudyCache(
+            root=os.path.join(self.root, "cache"))
+        self.max_engines = max(int(max_engines), 1)
+        self.run_mode = run_mode
+        self._engines: "OrderedDict[str, object]" = OrderedDict()
+        self._draining = threading.Event()
+        self.served = 0
+        self.walls_ms: List[float] = []
+
+    # ---- engine pool -----------------------------------------------------
+
+    def _engine_for(self, spec: StudySpec):
+        """Warm :class:`ABCSMC` for this spec's problem, renewed for
+        this study.  A pool hit re-arms the SAME kernel and ladder —
+        zero new compiles for eligible repeats."""
+        import pyabc_tpu as pt
+        pk = problem_key(spec)
+        abc = self._engines.get(pk)
+        if abc is not None:
+            self._engines.move_to_end(pk)
+            REGISTRY.counter(
+                "serve_engine_hits_total",
+                "studies served on an already-warm engine").inc()
+            abc.renew("sqlite://", dict(spec.observed), seed=spec.seed)
+            return abc
+        REGISTRY.counter(
+            "serve_engine_builds_total",
+            "warm engines built (first study of a problem)").inc()
+        abc = pt.ABCSMC(
+            pt.SimpleModel(spec.model),
+            spec.prior,
+            pt.PNormDistance(p=spec.distance_p),
+            population_size=int(spec.population_size),
+            eps=pt.QuantileEpsilon(alpha=spec.alpha),
+            run_mode=self.run_mode,
+            # one-dispatch eligibility needs fused blocks; 4 matches
+            # the bench one-dispatch rows
+            fuse_generations=4,
+            seed=int(spec.seed))
+        abc.new("sqlite://", dict(spec.observed))
+        self._engines[pk] = abc
+        while len(self._engines) > self.max_engines:
+            self._engines.popitem(last=False)
+            REGISTRY.counter(
+                "serve_engine_evictions_total",
+                "warm engines dropped by the pool LRU").inc()
+        return abc
+
+    # ---- serving ---------------------------------------------------------
+
+    def _finish(self, spec: StudySpec, summary: dict, wall_s: float,
+                served_from: str) -> dict:
+        summary = dict(summary)
+        summary["served_from"] = served_from
+        summary["tenant"] = spec.tenant
+        summary["wall_ms"] = round(wall_s * 1e3, 3)
+        if spec.name:
+            summary["name"] = spec.name
+        self.served += 1
+        self.walls_ms.append(wall_s * 1e3)
+        del self.walls_ms[:-512]
+        REGISTRY.counter("serve_studies_total",
+                         "studies served (cache + device)").inc()
+        _tenant_counter(spec.tenant).inc()
+        REGISTRY.gauge("serve_last_study_ms",
+                       "wall clock of the last served study"
+                       ).set(round(wall_s * 1e3, 3))
+        return summary
+
+    def serve_spec(self, spec: StudySpec) -> dict:
+        """Serve one study: cache, else warm solo one-dispatch run."""
+        t0 = time.perf_counter()
+        digest = study_digest(spec)
+        hit = self.cache.get(digest)
+        if hit is not None:
+            return self._finish(spec, hit, time.perf_counter() - t0,
+                                "cache")
+        summary = self._solo_summary(spec, digest)
+        self.cache.put(digest, summary)
+        return self._finish(spec, summary, time.perf_counter() - t0,
+                            "solo")
+
+    def _solo_summary(self, spec: StudySpec, digest: str) -> dict:
+        abc = self._engine_for(spec)
+        history = abc.run(
+            minimum_epsilon=float(spec.minimum_epsilon),
+            max_nr_populations=int(spec.max_generations),
+            min_acceptance_rate=float(spec.min_acceptance_rate))
+        df, w = history.get_distribution()
+        pops = history.get_all_populations()
+        names = list(df.columns)
+        wn = np.asarray(w, dtype=np.float64)
+        mean = {c: float(np.sum(df[c].to_numpy() * wn)) for c in names}
+        std = {c: float(np.sqrt(max(np.sum(
+            wn * (df[c].to_numpy() - mean[c]) ** 2), 0.0)))
+            for c in names}
+        return {
+            "digest": digest,
+            "engine": "solo_onedispatch",
+            "gens": int(len(pops)),
+            "eps": float(pops["epsilon"].iloc[-1]) if len(pops) else None,
+            "n_sims": int(pops["samples"].sum()) if len(pops) else 0,
+            "population_size": int(spec.population_size),
+            "posterior_mean": mean,
+            "posterior_std": std,
+        }
+
+    def _batch_summary(self, spec: StudySpec, res: dict,
+                       digest: str) -> dict:
+        names = spec.prior.get_parameter_names()
+        theta = np.asarray(res["theta"], dtype=np.float64)
+        w = np.asarray(res["w"], dtype=np.float64)
+        mean = {c: float(np.sum(theta[:, i] * w))
+                for i, c in enumerate(names)}
+        std = {c: float(np.sqrt(max(np.sum(
+            w * (theta[:, i] - mean[c]) ** 2), 0.0)))
+            for i, c in enumerate(names)}
+        return {
+            "digest": digest,
+            "engine": "multiplex",
+            "gens": int(res["gens"]),
+            "eps": float(res["eps"]),
+            "n_sims": int(res["rounds"]) * int(spec.population_size)
+            + int(spec.population_size),
+            "stop_code": int(res["stop_code"]),
+            "population_size": int(spec.population_size),
+            "posterior_mean": mean,
+            "posterior_std": std,
+        }
+
+    def serve_many(self, specs: Sequence[StudySpec]) -> List[dict]:
+        """Serve a claimed batch: cache hits first, then fuse the
+        remaining eligible studies onto the study axis, then warm solo
+        runs for whatever is left."""
+        out: List[Optional[dict]] = [None] * len(specs)
+        misses: List[Tuple[int, StudySpec, str]] = []
+        waiters: List[Tuple[int, StudySpec, str]] = []
+        seen_digests = set()
+        for i, spec in enumerate(specs):
+            t0 = time.perf_counter()
+            digest = study_digest(spec)
+            if digest in seen_digests:
+                # in-batch duplicate: its original is being served in
+                # THIS call — fill it from the cache afterwards rather
+                # than dispatching the same study twice
+                waiters.append((i, spec, digest))
+                continue
+            hit = self.cache.get(digest)
+            if hit is not None:
+                out[i] = self._finish(
+                    spec, hit, time.perf_counter() - t0, "cache")
+            else:
+                seen_digests.add(digest)
+                misses.append((i, spec, digest))
+        if misses:
+            groups = multiplex_eligible([s for _i, s, _d in misses])
+            by_id = {id(s): (i, d) for i, s, d in misses}
+            for group in groups:
+                if len(group) >= 2 and multiplex_width() > 1:
+                    t0 = time.perf_counter()
+                    results = StudyBatch(group).run()
+                    wall = time.perf_counter() - t0
+                    REGISTRY.counter(
+                        "serve_multiplexed_studies_total",
+                        "studies served fused on the study axis"
+                    ).inc(len(group))
+                    for spec, res in zip(group, results):
+                        i, digest = by_id[id(spec)]
+                        summary = self._batch_summary(spec, res, digest)
+                        self.cache.put(digest, summary)
+                        out[i] = self._finish(
+                            spec, summary, wall / len(group),
+                            "multiplex")
+                else:
+                    for spec in group:
+                        i, digest = by_id[id(spec)]
+                        t0 = time.perf_counter()
+                        summary = self._solo_summary(spec, digest)
+                        self.cache.put(digest, summary)
+                        out[i] = self._finish(
+                            spec, summary, time.perf_counter() - t0,
+                            "solo")
+        for i, spec, digest in waiters:
+            t0 = time.perf_counter()
+            hit = self.cache.get(digest)
+            if hit is not None:
+                out[i] = self._finish(
+                    spec, hit, time.perf_counter() - t0, "cache")
+            else:  # original evicted between put and here: serve it
+                summary = self._solo_summary(spec, digest)
+                self.cache.put(digest, summary)
+                out[i] = self._finish(
+                    spec, summary, time.perf_counter() - t0, "solo")
+        return [s for s in out if s is not None]
+
+    # ---- queue loop ------------------------------------------------------
+
+    def drain(self):
+        """Start a graceful drain (idempotent; signal-safe)."""
+        self._draining.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def install_signal_handlers(self):
+        signal.signal(signal.SIGTERM, lambda _s, _f: self.drain())
+        signal.signal(signal.SIGINT, lambda _s, _f: self.drain())
+
+    def _snapshot_gauges(self, queue: StudyQueue):
+        REGISTRY.gauge("serve_queue_depth",
+                       "pending studies in the serve queue"
+                       ).set(queue.depth())
+        REGISTRY.gauge("serve_engines_warm",
+                       "warm engines held by this worker"
+                       ).set(len(self._engines))
+        stats = self.cache.stats()
+        REGISTRY.gauge("serve_cache_hit_ratio",
+                       "study cache hit ratio since worker start"
+                       ).set(round(stats["hit_ratio"], 4))
+
+    def run_forever(self, queue: Optional[StudyQueue] = None,
+                    poll_s: float = 0.5,
+                    max_studies: Optional[int] = None,
+                    once: bool = False) -> int:
+        """Claim/serve until drained (or ``max_studies`` / one empty
+        poll with ``once``).  Returns the number of studies served by
+        this call.  On drain, every still-claimed study is requeued."""
+        queue = queue or StudyQueue(root=self.root)
+        served0 = self.served
+        # ride the fleet telemetry mount when a run dir is advertised:
+        # serve_* counters land in snapshots for abc-top / /api/serve /
+        # the Prometheus exporter
+        from ..telemetry import aggregate
+        publisher = aggregate.publisher_from_env()
+        try:
+            while not self.draining:
+                if (max_studies is not None
+                        and self.served - served0 >= max_studies):
+                    break
+                tickets: List[Ticket] = []
+                head = queue.claim(self.worker_id)
+                if head is None:
+                    self._snapshot_gauges(queue)
+                    if once:
+                        break
+                    time.sleep(poll_s)
+                    continue
+                tickets.append(head)
+                while len(tickets) < multiplex_width():
+                    more = queue.claim(self.worker_id)
+                    if more is None:
+                        break
+                    tickets.append(more)
+                if self.draining:
+                    break  # finally-block requeues the claims
+                loaded = []
+                for tk in tickets:
+                    try:
+                        loaded.append((tk, tk.load_spec()))
+                    except Exception as exc:  # poison ticket
+                        queue.fail(tk, f"unpicklable spec: {exc!r}")
+                if not loaded:
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    summaries = self.serve_many(
+                        [s for _tk, s in loaded])
+                except Exception as exc:
+                    for tk, _s in loaded:
+                        queue.fail(tk, repr(exc))
+                    continue
+                wall = time.perf_counter() - t0
+                for (tk, _s), summary in zip(loaded, summaries):
+                    queue.complete(tk, wall_s=wall,
+                                   engine=summary.get("served_from",
+                                                      "solo"))
+                self._snapshot_gauges(queue)
+                if publisher is not None:
+                    publisher.publish()
+        finally:
+            requeued = queue.requeue_worker(self.worker_id)
+            if requeued:
+                REGISTRY.gauge(
+                    "serve_drain_requeued",
+                    "studies requeued by the last drain").set(requeued)
+            self._snapshot_gauges(queue)
+            if publisher is not None:
+                publisher.publish(force=True)
+        return self.served - served0
+
+
+def main():  # pragma: no cover - thin CLI shell over ServeWorker
+    import click
+
+    @click.command(name="abc-serve")
+    @click.option("--serve-dir", default=None,
+                  help="Serve root (default $PYABC_TPU_SERVE_DIR, "
+                       "else $PYABC_TPU_RUN_DIR/serve).")
+    @click.option("--worker-id", default=None,
+                  help="Stable worker identity (default host_pid).")
+    @click.option("--poll-s", default=0.5, show_default=True,
+                  help="Idle poll interval.")
+    @click.option("--max-studies", default=None, type=int,
+                  help="Exit after serving this many studies.")
+    @click.option("--once", is_flag=True,
+                  help="Drain the current queue once and exit.")
+    def cli(serve_dir, worker_id, poll_s, max_studies, once):
+        """Persistent warm study server on this accelerator."""
+        worker = ServeWorker(root=serve_dir, worker_id=worker_id)
+        worker.install_signal_handlers()
+        queue = StudyQueue(root=worker.root)
+        n = worker.run_forever(queue, poll_s=poll_s,
+                               max_studies=max_studies, once=once)
+        click.echo(f"served {n} studies "
+                   f"({'drained' if worker.draining else 'done'})")
+
+    cli()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

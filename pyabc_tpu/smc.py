@@ -107,6 +107,17 @@ def _device_supports(m, theta, log_weight, count, specs):
     return tuple(outs)
 
 
+def _obs_equal(a: Dict, b: Dict) -> bool:
+    """Bit-exact equality of two coerced observed-stat dicts — the
+    warm-rebind gate (:meth:`ABCSMC.renew`): the kernel bakes the
+    observed stats into the compiled program, so anything short of
+    bitwise identity must take the cold ``new()`` path."""
+    if a is None or b is None or set(a) != set(b):
+        return False
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+               for k in a)
+
+
 class ABCSMC:
     """ABC-SMC with on-device populations (reference smc.py:46-1079)."""
 
@@ -409,6 +420,67 @@ class ABCSMC:
             self.population_strategy.to_json())
         self._bind()
         return self.history
+
+    def renew(self, db: str, observed_sum_stat: Dict,
+              gt_model: Optional[int] = None,
+              gt_par: Optional[dict] = None,
+              meta_info: Optional[dict] = None,
+              eps: Optional[object] = None,
+              seed: Optional[int] = None) -> History:
+        """Register a NEW study on a WARM binding (serve/worker.py).
+
+        ``new()`` unconditionally rebinds: a fresh :class:`RoundKernel`
+        (new ``_uid``) re-bakes the observed stats as a closure constant
+        and invalidates every ladder-cached program, so serving study 2
+        through it recompiles even when nothing about the program
+        changed.  ``renew`` is the warm path: when the incoming observed
+        stats are bit-identical to the bound ``x_0`` it keeps the kernel
+        (and therefore every compiled program keyed by its ``_uid``),
+        creates the fresh History, optionally swaps in a clean epsilon
+        schedule and reseeds the key stream, and resets only the
+        run-scoped carries.  Different observed data falls back to the
+        full ``new()`` bind — correctness first, warmth second.
+        """
+        if self._kernel is None or self.x_0 is None or \
+                not _obs_equal(self._coerce_stats(
+                    observed_sum_stat
+                    if self.summary_statistics is None
+                    else self.summary_statistics(observed_sum_stat)),
+                    self.x_0):
+            hist = self.new(db, observed_sum_stat, gt_model=gt_model,
+                            gt_par=gt_par, meta_info=meta_info)
+        else:
+            self.history = History(
+                db, stores_sum_stats=self.stores_sum_stats)
+            self.history.store_initial_data(
+                gt_model, meta_info or {}, observed_sum_stat, gt_par,
+                [m.name for m in self.models],
+                self.distance_function.to_json(), self.eps.to_json(),
+                self.population_strategy.to_json())
+            # run-scoped resets only — the kernel, the ladder cache and
+            # the engine-probe decision all survive (same problem, same
+            # programs); the carry must not leak the previous study's
+            # population into this study's first block
+            self._fused_carry = None
+            if self.history_mode == "lazy":
+                self._store = _wire_store.DeviceRunStore()
+                self.history.attach_store(self._store)
+            hist = self.history
+        if eps is not None:
+            self.eps = eps
+        elif hasattr(self.eps, "_look_up"):
+            # a reused quantile schedule must not replay study 1's
+            # thresholds into study 2's calibration
+            self.eps._look_up = {}
+        if seed is not None:
+            self.key = jax.random.PRNGKey(int(seed))
+        # the sampler's acceptance autotuner is study state, and its
+        # rate estimate feeds _block_max_rounds — a fresh tuner puts the
+        # program cache key back at study 1's first-block value
+        # (zero-recompile contract)
+        if hasattr(self.sampler, "_tuner"):
+            self.sampler._tuner = type(self.sampler._tuner)()
+        return hist
 
     def load(self, db: str, abc_id: int = 1) -> History:
         """Resume a stored run (reference smc.py:355-389): observed stats
@@ -1455,7 +1527,7 @@ class ABCSMC:
             pdf_norm = float(norms.get(t, norms[max(norms)]
                                        if norms else 0.0))
         lanes_on = bool(self.telemetry_lanes)
-        cache_key = ("onedispatch3", self._kernel._uid, samp._uid, B,
+        cache_key = ("onedispatch4", self._kernel._uid, samp._uid, B,
                      n, K, max_T, d, s_width, eps_mode, alpha, mult,
                      weighted, eps_sketch, wire_stats, wire_m_bits,
                      max_rounds, sup_cap, mode["adaptive"],
@@ -1645,12 +1717,35 @@ class ABCSMC:
             budget_rounds = i32max
         final_rel = (max(int(t_max) - 1 - t, 0)
                      if np.isfinite(t_max) else i32max)
+        # arm the in-dispatch progress word BEFORE building the control
+        # operand: the tag it returns rides the dispatch as a traced
+        # scalar, so the compiled program's debug callbacks advance THIS
+        # run's word even when a serve worker interleaves several runs
+        lanes_on = bool(self.telemetry_lanes)
+        run_tag = 0
+        poller = None
+        if lanes_on:
+            run_tag = _lanes.PROGRESS.begin(
+                t0=t, t_limit=t_limit,
+                run_id=getattr(self.history, "id", None))
+            if self._fleet is not None:
+                poller = _lanes.ProgressPoller(
+                    lambda: self._fleet.publish(
+                        self.timeline, force=True)).start()
+
+        def _progress_done():
+            if poller is not None:
+                poller.stop()
+            if lanes_on:
+                _lanes.PROGRESS.finish(run_tag)
+
         ctl_in = {
             "min_eps": jnp.float32(self.minimum_epsilon),
             "min_rate": jnp.float32(self.min_acceptance_rate),
             "budget_rounds": jnp.int32(budget_rounds),
             "t_limit": jnp.int32(t_limit),
             "final_rel": jnp.int32(final_rel),
+            "run_tag": jnp.int32(run_tag),
         }
         # the orchestrator key goes down UN-split: the device replays
         # the host block protocol (one split per K-block), so the
@@ -1665,27 +1760,6 @@ class ABCSMC:
         fn = self._get_run_fn(t, n, B, K, max_T, summary=lazy,
                               aot_args=None if self._pod_active
                               else args)
-        # arm the in-dispatch progress word BEFORE the dispatch: the
-        # compiled program's debug callbacks advance it while the run
-        # is in flight, and the poller thread publishes fleet snapshots
-        # the main thread (blocked in the first egress fetch) cannot
-        lanes_on = bool(self.telemetry_lanes)
-        poller = None
-        if lanes_on:
-            _lanes.PROGRESS.begin(
-                t0=t, t_limit=t_limit,
-                run_id=getattr(self.history, "id", None))
-            if self._fleet is not None:
-                poller = _lanes.ProgressPoller(
-                    lambda: self._fleet.publish(
-                        self.timeline, force=True)).start()
-
-        def _progress_done():
-            if poller is not None:
-                poller.stop()
-            if lanes_on:
-                _lanes.PROGRESS.finish()
-
         dispatch_mark = _time.perf_counter()
         try:
             with profile_generation(t), \

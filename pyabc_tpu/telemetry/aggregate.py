@@ -366,7 +366,35 @@ def fleet_rollup(run_dir: str) -> Dict:
             # pod processes run in lockstep, so one word speaks for all
             "run_progress": merge_progress(
                 [s.get("run_progress") for s in snaps]),
+            "serve": _serve_rollup(rollup),
             "metrics": rollup}
+
+
+#: serve_* keys that are point-in-time gauges — fleet view reads their
+#: max; everything else under serve_* is a counter and rolls up as sum
+_SERVE_GAUGES = frozenset({
+    "serve_queue_depth", "serve_engines_warm", "serve_cache_hit_ratio",
+    "serve_last_study_ms", "serve_drain_requeued",
+})
+
+
+def _serve_rollup(metrics_rollup: Dict) -> Dict:
+    """The serving tier's slice of the fleet rollup: every ``serve_*``
+    metric collapsed to one number (counters summed across workers,
+    gauges maxed), plus the per-tenant attribution table."""
+    out: Dict = {}
+    tenants: Dict[str, float] = {}
+    for key, aggs in metrics_rollup.items():
+        if not key.startswith("serve_"):
+            continue
+        val = aggs["max" if key in _SERVE_GAUGES else "sum"]
+        out[key] = val
+        if key.startswith("serve_tenant_") and key.endswith(
+                "_studies_total"):
+            tenants[key[len("serve_tenant_"):-len("_studies_total")]] \
+                = val
+    out["tenants"] = tenants
+    return out
 
 
 def render_prometheus(run_dir: str) -> str:
@@ -390,6 +418,13 @@ def render_prometheus(run_dir: str) -> str:
             "pyabc_tpu_fleet_run_progress_rounds "
             f"{prog.get('rounds', 0)}",
         ]
+    # the serving tier's first-class scrape surface: flat
+    # ``pyabc_tpu_serve_*`` gauges (tenant counters already carry the
+    # tenant in the key), alongside the generic fleet aggregates below
+    for key, val in sorted((roll.get("serve") or {}).items()):
+        if key == "tenants":
+            continue
+        lines.append(f"pyabc_tpu_{key} {val}")
     for key, aggs in roll["metrics"].items():
         for agg in ("sum", "max", "p50", "p99"):
             lines.append(

@@ -34,9 +34,11 @@ out of an in-flight dispatch is a host callback: any device buffer read
 blocks until the whole while-loop completes, so the one-dispatch driver
 plants a ``jax.debug.callback`` at each generation boundary that calls
 :func:`device_progress_update` with the generation index, epsilon,
-accepted count and cumulative rounds.  The callback writes this
-process-global word (a lock-guarded dict — the callback must stay
-microseconds-cheap); nothing blocks on the run future.  A
+accepted count, cumulative rounds and the run's *tag* (a traced
+``ctl["run_tag"]`` scalar), which routes the update to that run's own
+word in the process-global registry (lock-guarded dicts — the callback
+must stay microseconds-cheap; a serve worker interleaving studies keeps
+one word per run).  Nothing blocks on the run future.  A
 :class:`ProgressPoller` daemon thread samples the word every
 ``$PYABC_TPU_PROGRESS_POLL_S`` seconds (default 0.5) and force-writes
 the fleet snapshot, so ``abc-top --watch``, ``/api/fleet`` and the
@@ -164,27 +166,50 @@ def attribute_phases(tl_phase, wall_s: float) -> Dict[str, float]:
 # ----------------------------------------------------------- progress word
 
 class RunProgress:
-    """Process-global in-dispatch progress word.
+    """Per-run in-dispatch progress words, keyed by a run tag.
 
-    ``begin()`` arms it at dispatch time with the absolute generation
-    origin; :func:`device_progress_update` (the jax.debug.callback
-    target) advances it from inside the running program; ``finish()``
-    marks the dispatch returned.  ``read()`` returns a JSON-safe dict
-    (or None when no one-dispatch run ever armed it) — the shape that
-    lands in fleet snapshots, flight dumps and ``/api/fleet``.
+    One process may have SEVERAL one-dispatch runs in flight at once —
+    a serve worker (``serve/worker.py``) interleaves studies, and two
+    ``ABCSMC`` instances on threads share this module.  A single global
+    word would let run B's callbacks clobber run A's progress, so
+    ``begin()`` allocates a fresh integer *tag*, returns it, and the
+    orchestrator threads it through the compiled program as a traced
+    ``ctl["run_tag"]`` operand; the device callbacks hand it back to
+    :meth:`update` so every run advances only its own word.
+
+    ``read()`` with no tag keeps the legacy single-word shape (the
+    freshest ACTIVE word, falling back to the freshest finished one) —
+    the shape that lands in fleet snapshots, flight dumps and
+    ``/api/fleet``; ``read(tag)`` isolates one run and ``read_all()``
+    feeds the serve studies view.  Finished words are kept for a short
+    tail (:data:`RunProgress._KEEP_FINISHED`) so post-run snapshots
+    still see the terminal state, then evicted oldest-first.
     """
 
     #: lock-discipline contract, enforced by `abc-lint`
-    _GUARDED_BY = {"_state": "_lock"}
+    _GUARDED_BY = {"_words": "_lock", "_current": "_lock",
+                   "_next_tag": "_lock"}
+
+    #: finished words retained for post-run reads before eviction
+    _KEEP_FINISHED = 8
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._state: Optional[dict] = None
+        self._words: Dict[int, dict] = {}
+        self._current: Optional[int] = None
+        self._next_tag = 1
 
-    def begin(self, *, t0: int, t_limit: int, run_id=None):
+    def begin(self, *, t0: int, t_limit: int, run_id=None) -> int:
+        """Arm a new word; returns its tag (a small positive int the
+        dispatch carries as a traced operand — 0 is reserved for
+        \"untagged\", which routes to the most recently armed word)."""
         with self._lock:
-            self._state = {
+            tag = self._next_tag
+            self._next_tag += 1
+            now = time.time()
+            self._words[tag] = {
                 "active": True,
+                "tag": tag,
                 "t0": int(t0),
                 "t_limit": int(t_limit),
                 "gen": int(t0),
@@ -193,18 +218,30 @@ class RunProgress:
                 "accepted": None,
                 "rounds": 0,
                 "run_id": None if run_id is None else str(run_id),
-                "started_unix": time.time(),
-                "updated_unix": time.time(),
+                "started_unix": now,
+                "updated_unix": now,
             }
+            self._current = tag
+            self._evict_locked()
+            return tag
+
+    def _evict_locked(self):
+        finished = sorted(
+            (t for t, w in self._words.items() if not w["active"]),
+            key=lambda t: self._words[t]["updated_unix"])
+        for t in finished[:max(len(finished) - self._KEEP_FINISHED, 0)]:
+            del self._words[t]
 
     def update(self, gens_done: int, eps: float, accepted: int,
-               rounds: int):
-        """Advance the word; called from the debug-callback thread while
+               rounds: int, tag: Optional[int] = None):
+        """Advance one word; called from the debug-callback thread while
         the dispatch is in flight, so it must stay O(dict write).
         ``gens_done`` counts completed generations; ``gen`` is the
-        absolute index of the last completed one."""
+        absolute index of the last completed one.  ``tag`` 0/None means
+        the most recently armed run (legacy untagged callbacks)."""
         with self._lock:
-            st = self._state
+            key = self._current if not tag else int(tag)
+            st = None if key is None else self._words.get(key)
             if st is None:
                 return
             # keep the word monotone regardless of delivery order
@@ -219,39 +256,64 @@ class RunProgress:
             st["rounds"] = max(int(rounds), st["rounds"])
             st["updated_unix"] = time.time()
 
-    def finish(self):
+    def finish(self, tag: Optional[int] = None):
         with self._lock:
-            if self._state is not None:
-                self._state["active"] = False
-                self._state["updated_unix"] = time.time()
+            key = self._current if not tag else int(tag)
+            st = None if key is None else self._words.get(key)
+            if st is not None:
+                st["active"] = False
+                st["updated_unix"] = time.time()
 
     def reset(self):
-        """Test isolation: forget any previous run's word."""
+        """Test isolation: forget every run's word."""
         with self._lock:
-            self._state = None
+            self._words = {}
+            self._current = None
+            self._next_tag = 1
 
-    def read(self) -> Optional[dict]:
+    def read(self, tag: Optional[int] = None) -> Optional[dict]:
+        """``read(tag)`` → that run's word (or None).  ``read()`` → the
+        legacy single-word view: freshest active word, else freshest
+        finished one, else None."""
         with self._lock:
-            return None if self._state is None else dict(self._state)
+            if tag:
+                st = self._words.get(int(tag))
+                return None if st is None else dict(st)
+            if not self._words:
+                return None
+            active = [w for w in self._words.values() if w["active"]]
+            pick = max(active or list(self._words.values()),
+                       key=lambda w: w["updated_unix"])
+            return dict(pick)
+
+    def read_all(self) -> List[dict]:
+        """Every retained word, oldest tag first — the serve studies
+        view's source."""
+        with self._lock:
+            return [dict(self._words[t]) for t in sorted(self._words)]
 
 
-#: the process-global progress word (one in-flight one-dispatch run per
-#: process — the orchestrator is single-run by construction)
+#: the process-global progress registry (one word per in-flight
+#: one-dispatch run; a plain run keeps exactly one active)
 PROGRESS = RunProgress()
 
 
-def device_progress_update(gens_done, eps, accepted, rounds, written):
+def device_progress_update(gens_done, eps, accepted, rounds, written,
+                           run_tag=None):
     """``jax.debug.callback`` target planted at each generation boundary
     of the one-dispatch while-loop (sampler/fused.py:gen_step).  Arrives
     with device scalars; must never raise — an observability callback
     that kills the dispatch it observes is worse than no callback.
     ``written`` gates out dead post-stop iterations (their repeated
-    frontier values carry zeroed counters, not progress)."""
+    frontier values carry zeroed counters, not progress); ``run_tag``
+    is the traced ``ctl["run_tag"]`` routing the update to its own
+    run's word (0/None = most recently armed)."""
     try:
         if not bool(written):
             return
         PROGRESS.update(int(gens_done), float(eps), int(accepted),
-                        int(rounds))
+                        int(rounds),
+                        tag=None if run_tag is None else int(run_tag))
     except Exception:
         pass
 

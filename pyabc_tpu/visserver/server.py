@@ -117,6 +117,8 @@ class _Handler(BaseHTTPRequestHandler):
         live fleet state."""
         if parts == ["fleet"]:
             return self._json(self._fleet_state())
+        if parts == ["serve"]:
+            return self._json(self._serve_state())
         if parts == ["runs"]:
             h = History(self.db_path, abc_id=1)
             runs = h.all_runs()
@@ -230,6 +232,25 @@ class _Handler(BaseHTTPRequestHandler):
                 # inside a one-dispatch call (telemetry/lanes.py)
                 "run_progress": merge_progress(
                     [s.get("run_progress") for s in snaps])}
+
+    def _serve_state(self) -> dict:
+        """Live serving-tier view (needs --run-dir): the ``serve_*``
+        rollup (studies served, cache hit/miss/eviction, warm engines,
+        per-tenant attribution) from the worker snapshots plus the
+        admission queue's directory state under ``<run_dir>/serve``."""
+        if not self.run_dir:
+            return {"enabled": False}
+        import os
+
+        from ..telemetry import aggregate
+
+        roll = aggregate.fleet_rollup(self.run_dir)
+        out = {"enabled": True, "serve": roll.get("serve") or {}}
+        serve_dir = os.path.join(self.run_dir, "serve")
+        if os.path.isdir(os.path.join(serve_dir, "queue")):
+            from ..serve.queue import StudyQueue
+            out["queue"] = StudyQueue(root=serve_dir).stats()
+        return out
 
     def _index(self):
         h = History(self.db_path, abc_id=1)

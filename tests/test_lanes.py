@@ -161,9 +161,9 @@ def test_progress_word_monotone_and_finished_under_drain_fault(
     calls = []
     orig = lanes.PROGRESS.update
 
-    def spy(gens_done, eps, accepted, rounds):
+    def spy(gens_done, eps, accepted, rounds, tag=None):
         calls.append((int(gens_done), int(rounds)))
-        orig(gens_done, eps, accepted, rounds)
+        orig(gens_done, eps, accepted, rounds, tag=tag)
 
     monkeypatch.setattr(lanes.PROGRESS, "update", spy)
     faults.install(faults.FaultPlan.parse(
@@ -231,6 +231,52 @@ def test_progress_word_update_is_monotone_and_gated():
     assert lanes.PROGRESS.read()["gens_done"] == 3
     lanes.device_progress_update(float("nan"), None, None, None, True)
     assert lanes.PROGRESS.read()["gens_done"] == 3
+
+
+def test_progress_words_for_two_interleaved_runs_stay_isolated():
+    """Regression for the single-global-word bug the serve worker
+    exposed: two runs in flight on one worker each get their own tagged
+    word, interleaved updates land on their own run only, and finishing
+    one run leaves the other live."""
+    tag_a = lanes.PROGRESS.begin(t0=0, t_limit=10, run_id="study-a")
+    tag_b = lanes.PROGRESS.begin(t0=3, t_limit=10, run_id="study-b")
+    assert tag_a != tag_b
+    # interleaved device callbacks, tagged like ctl["run_tag"] routes
+    lanes.device_progress_update(1, 0.9, 50, 1, True, tag_a)
+    lanes.device_progress_update(2, 0.7, 40, 2, True, tag_b)
+    lanes.device_progress_update(2, 0.8, 60, 2, True, tag_a)
+    lanes.device_progress_update(5, 0.3, 45, 6, True, tag_b)
+    a = lanes.PROGRESS.read(tag_a)
+    b = lanes.PROGRESS.read(tag_b)
+    assert (a["gens_done"], a["eps"], a["run_id"]) == (2, 0.8, "study-a")
+    assert (b["gens_done"], b["eps"], b["run_id"]) == (5, 0.3, "study-b")
+    assert a["gen"] == 1 and b["gen"] == 7  # each from its own t0
+    # finishing A must not touch B
+    lanes.PROGRESS.finish(tag_a)
+    assert lanes.PROGRESS.read(tag_a)["active"] is False
+    assert lanes.PROGRESS.read(tag_b)["active"] is True
+    # the legacy no-tag read picks the remaining ACTIVE word
+    assert lanes.PROGRESS.read()["run_id"] == "study-b"
+    # untagged update (legacy callers) routes to the latest-armed run
+    lanes.PROGRESS.update(6, 0.2, 30, 7)
+    assert lanes.PROGRESS.read(tag_b)["gens_done"] == 6
+    assert lanes.PROGRESS.read(tag_a)["gens_done"] == 2
+    both = lanes.PROGRESS.read_all()
+    assert [w["tag"] for w in both] == [tag_a, tag_b]
+
+
+def test_progress_registry_evicts_old_finished_words():
+    tags = []
+    for i in range(lanes.RunProgress._KEEP_FINISHED + 5):
+        tag = lanes.PROGRESS.begin(t0=0, t_limit=4, run_id=f"s{i}")
+        lanes.PROGRESS.finish(tag)
+        tags.append(tag)
+    live = lanes.PROGRESS.begin(t0=0, t_limit=4, run_id="live")
+    words = lanes.PROGRESS.read_all()
+    # the finished tail is bounded; the active word always survives
+    assert len(words) <= lanes.RunProgress._KEEP_FINISHED + 1
+    assert any(w["tag"] == live for w in words)
+    assert not any(w["tag"] == tags[0] for w in words)  # oldest evicted
 
 
 def test_merge_progress_prefers_active_then_freshest():

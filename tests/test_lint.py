@@ -52,12 +52,13 @@ def test_repo_tree_is_clean():
 
 
 def test_ten_rules_registered():
-    assert len(ALL_RULES) == 13
+    assert len(ALL_RULES) == 14
     assert set(ALL_RULES) == {
         "wire-chokepoint", "no-inline-jit", "retry-sites",
         "fused-eligibility", "span-pairs", "fault-sites",
         "host-sync", "lock-discipline", "prng-keys", "env-drift",
-        "sort-discipline", "precision-policy", "collective-discipline"}
+        "sort-discipline", "precision-policy", "collective-discipline",
+        "study-isolation"}
 
 
 # ---------------------------------------------------------------------------
@@ -489,6 +490,37 @@ def test_sort_discipline_scope_and_suppress(tmp_path):
     assert [(path, lineno) for path, lineno, _ in got] == [
         ("ops/hot.py", 2), ("ops/hot.py", 3), ("ops/hot.py", 6),
         ("weighted_statistics.py", 1)]
+
+
+def test_study_isolation_scope_and_semantics(tmp_path):
+    """Module-level mutables flag only under serve/; immutable
+    constants, function locals, instance state and class-body metadata
+    never flag; the inline suppression works."""
+    from tools.lint.rules import study_isolation as mod
+    pkg = tmp_path / "pkg"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "parallel").mkdir()
+    (pkg / "serve" / "state.py").write_text(
+        "import collections\n"
+        "_ENGINES = {}\n"
+        "_RESULTS: list = []\n"
+        "_BY_TENANT = collections.defaultdict(list)\n"
+        "_OK_PROCESS_WIDE = {}  # study-state-ok\n"
+        "MAX_DEPTH = 256\n"
+        "_CODES = (0, 1, 2)\n"
+        "class Worker:\n"
+        "    _GUARDED_BY = {'_engines': '_lock'}\n"
+        "    def __init__(self):\n"
+        "        self._engines = {}\n"
+        "def claim():\n"
+        "    staged = []\n"
+        "    return staged\n")
+    # other subsystems are out of scope for this rule
+    (pkg / "parallel" / "host.py").write_text("_CACHE = {}\n")
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [
+        ("serve/state.py", 2), ("serve/state.py", 3),
+        ("serve/state.py", 4)]
 
 
 def test_precision_policy_ast_semantics(tmp_path):

@@ -1,0 +1,305 @@
+"""Tier-1 gate for the serving tier (``pyabc_tpu/serve/``).
+
+Pins the four contracts docs/serving.md advertises:
+
+- admission control: backpressure at max depth, per-tenant quotas,
+  aged-priority claim order, requeue keeps age + counts bounces;
+- the study axis: a study served in a batch of N is BITWISE equal to
+  the same study served in a batch of 1 (pop 1e3);
+- content addressing: a duplicate digest is served from the cache
+  without any dispatch; any config perturbation is a different digest;
+- warmth: after the first study on a problem shape, sequential studies
+  through the warm worker trigger ZERO new XLA compiles, and a SIGTERM
+  drain requeues everything still claimed.
+"""
+
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     os.pardir))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import pyabc_tpu as pt  # noqa: E402
+from pyabc_tpu.serve import (QueueFull, ServeWorker, StudyBatch,  # noqa: E402
+                             StudyCache, StudyQueue, StudySpec,
+                             TenantQuotaExceeded, study_digest)
+from pyabc_tpu.serve.queue import serve_root  # noqa: E402
+
+
+def _model(key, theta):
+    """Quickstart-shaped simulator; module-level because queue
+    submissions pickle the spec, exactly like a real tenant's
+    importable model."""
+    import jax
+    noise = 0.1 * jax.random.normal(key, (theta.shape[0], 1))
+    return {"y": theta[:, :1] + noise}
+
+
+def _spec(pop=100, seed=0, tenant="default", y=0.4, **kw):
+    return StudySpec(
+        model=_model,
+        prior=pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+        observed={"y": float(y)}, population_size=pop,
+        seed=seed, tenant=tenant,
+        max_generations=kw.pop("max_generations", 3), **kw)
+
+
+# ---------------------------------------------------------------------------
+# admission queue
+# ---------------------------------------------------------------------------
+
+def test_queue_backpressure(tmp_path):
+    q = StudyQueue(root=str(tmp_path), max_depth=3, tenant_quota=10)
+    for seed in range(3):
+        q.submit(_spec(seed=seed))
+    with pytest.raises(QueueFull):
+        q.submit(_spec(seed=99))
+    assert q.depth() == 3
+
+
+def test_tenant_quota_isolates_tenants(tmp_path):
+    q = StudyQueue(root=str(tmp_path), max_depth=100, tenant_quota=2)
+    q.submit(_spec(seed=0, tenant="noisy"))
+    q.submit(_spec(seed=1, tenant="noisy"))
+    with pytest.raises(TenantQuotaExceeded):
+        q.submit(_spec(seed=2, tenant="noisy"))
+    # the quota is per tenant — another tenant is still admitted
+    q.submit(_spec(seed=0, tenant="quiet"))
+    assert q.stats()["pending_by_tenant"] == {"noisy": 2, "quiet": 1}
+
+
+def test_claim_orders_by_aged_priority(tmp_path):
+    # aging so slow it cannot matter: raw priority decides
+    q = StudyQueue(root=str(tmp_path), aging_s=1e9)
+    low = q.submit(_spec(seed=0, priority=0))
+    high = q.submit(_spec(seed=1, priority=5))
+    assert q.claim("w1").id == high.id
+    assert q.claim("w1").id == low.id
+    assert q.claim("w1") is None
+
+
+def test_aging_lets_old_low_priority_win(tmp_path):
+    q = StudyQueue(root=str(tmp_path), aging_s=30.0)
+    old = q.submit(_spec(seed=0, priority=0))
+    q.submit(_spec(seed=1, priority=5))
+    # age the low-priority ticket by 10 aging intervals on disk —
+    # effective priority 0 + 300/30 = 10 beats a fresh 5
+    with open(old.path, encoding="utf-8") as f:
+        payload = json.load(f)
+    payload["submitted_unix"] -= 300.0
+    with open(old.path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    assert q.claim("w1").id == old.id
+
+
+def test_requeue_keeps_age_and_counts_bounces(tmp_path):
+    q = StudyQueue(root=str(tmp_path))
+    t = q.submit(_spec(seed=0))
+    submitted = t.submitted_unix
+    claimed = q.claim("w1")
+    assert claimed.id == t.id
+    assert q.depth() == 0
+    q.requeue(claimed)
+    (back,) = q.pending()
+    assert back.requeues == 1
+    assert back.submitted_unix == pytest.approx(submitted)
+
+
+def test_requeue_worker_sweeps_all_claims(tmp_path):
+    q = StudyQueue(root=str(tmp_path))
+    for seed in range(2):
+        q.submit(_spec(seed=seed))
+    assert q.claim("w1") is not None
+    assert q.claim("w1") is not None
+    assert q.depth() == 0
+    assert q.requeue_worker("w1") == 2
+    assert q.depth() == 2
+    assert q.requeue_worker("w1") == 0
+
+
+def test_serve_root_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv("PYABC_TPU_SERVE_DIR", raising=False)
+    monkeypatch.delenv("PYABC_TPU_RUN_DIR", raising=False)
+    assert serve_root("/explicit") == "/explicit"
+    monkeypatch.setenv("PYABC_TPU_RUN_DIR", str(tmp_path / "run"))
+    assert serve_root() == str(tmp_path / "run" / "serve")
+    monkeypatch.setenv("PYABC_TPU_SERVE_DIR", str(tmp_path / "srv"))
+    assert serve_root() == str(tmp_path / "srv")
+
+
+# ---------------------------------------------------------------------------
+# content addressing
+# ---------------------------------------------------------------------------
+
+def test_digest_moves_with_every_posterior_knob():
+    base = _spec(pop=100, seed=0, y=0.4)
+    d0 = study_digest(base)
+    assert d0 == study_digest(_spec(pop=100, seed=0, y=0.4))
+    # tenant/priority/name are routing, not inference
+    assert d0 == study_digest(_spec(pop=100, seed=0, y=0.4,
+                                    tenant="other", priority=7,
+                                    name="x"))
+    perturbed = [
+        _spec(pop=101, seed=0, y=0.4),
+        _spec(pop=100, seed=1, y=0.4),
+        _spec(pop=100, seed=0, y=0.41),
+        _spec(pop=100, seed=0, y=0.4, alpha=0.4),
+        _spec(pop=100, seed=0, y=0.4, minimum_epsilon=0.01),
+        _spec(pop=100, seed=0, y=0.4, max_generations=4),
+    ]
+    digests = [study_digest(s) for s in perturbed]
+    assert d0 not in digests
+    assert len(set(digests)) == len(digests)
+
+
+def test_cache_hit_miss_eviction_and_disk_spill(tmp_path):
+    cache = StudyCache(capacity=2, root=str(tmp_path))
+    assert cache.get("a" * 64) is None  # miss
+    cache.put("a" * 64, {"x": 1})
+    cache.put("b" * 64, {"x": 2})
+    assert cache.get("a" * 64) == {"x": 1}  # hit
+    cache.put("c" * 64, {"x": 3})  # evicts lru ("b")
+    stats = cache.stats()
+    assert (stats["hits"], stats["misses"], stats["evictions"]) \
+        == (1, 1, 1)
+    # a fresh cache over the same root re-hits from the JSON spill
+    again = StudyCache(capacity=2, root=str(tmp_path))
+    assert again.get("b" * 64) == {"x": 2}
+
+
+# ---------------------------------------------------------------------------
+# the study axis: bit identity
+# ---------------------------------------------------------------------------
+
+def test_multiplex_lane_is_isolated_from_co_tenants():
+    """The isolation contract: a lane's result is bitwise identical no
+    matter WHAT shares the batch — same compiled program, different
+    co-tenant operands, zero cross-study math."""
+    probe = _spec(pop=1000, seed=0, y=0.2)
+    a = StudyBatch([probe, _spec(pop=1000, seed=1, y=-0.1),
+                    _spec(pop=1000, seed=2, y=0.5)]).run()[0]
+    b = StudyBatch([probe, _spec(pop=1000, seed=7, y=0.9),
+                    _spec(pop=1000, seed=8, y=-0.6)]).run()[0]
+    assert set(a) == set(b)
+    for k in sorted(a):
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+def test_multiplex_batch_matches_solo():
+    """A lane of a batch-of-3 reproduces the same study run as a
+    batch-of-1: populations (particles, weights), eps trajectory and
+    stop state are BITWISE equal.  The per-particle distance
+    diagnostic is compared to 1 float32 ULP instead — XLA's
+    elementwise codegen may fuse differently for different leading
+    extents (observed only under the 8-virtual-device test mesh), but
+    that is compiler instruction selection, not cross-study math."""
+    specs = [_spec(pop=1000, seed=s, y=y)
+             for s, y in ((0, 0.2), (1, -0.1), (2, 0.5))]
+    batched = StudyBatch(specs).run()
+    for spec, got in zip(specs, batched):
+        solo = StudyBatch([spec]).run()[0]
+        assert set(got) == set(solo)
+        for k in sorted(got):
+            a, b = np.asarray(got[k]), np.asarray(solo[k])
+            if k == "dist":
+                assert np.all(np.abs(a - b)
+                              <= np.spacing(np.float32(0.5))), k
+            else:
+                assert np.array_equal(a, b), k
+    # and the lanes actually inferred: posterior mean tracks observed
+    for spec, got in zip(specs, batched):
+        w = np.asarray(got["w"], dtype=np.float64)
+        mean = float(np.sum(np.asarray(got["theta"])[:, 0] * w))
+        assert abs(mean - spec.observed["y"]) < 0.15
+
+
+# ---------------------------------------------------------------------------
+# the warm worker
+# ---------------------------------------------------------------------------
+
+def test_duplicate_served_from_cache_without_dispatch(tmp_path):
+    worker = ServeWorker(root=str(tmp_path))
+    first = worker.serve_spec(_spec(pop=100, seed=0))
+    assert first["served_from"] == "solo"
+    # any dispatch path would now blow up — the duplicate must not
+    # touch an engine at all
+    def _boom(*_a, **_k):
+        raise AssertionError("duplicate digest dispatched")
+    worker._solo_summary = _boom
+    again = worker.serve_spec(_spec(pop=100, seed=0))
+    assert again["served_from"] == "cache"
+    assert again["posterior_mean"] == first["posterior_mean"]
+    assert worker.cache.stats()["hits"] >= 1
+
+
+def test_warm_worker_zero_recompiles_after_first(tmp_path):
+    """Studies 2 and 3 on the same problem shape (different seeds) ride
+    the renewed engine's pinned programs: compile delta 0.  Seeds are
+    chosen so the adaptive batch ladder stays on rungs the first study
+    already compiled — a study whose acceptance path visits a NEW rung
+    legitimately pays one compile, which the ladder then caches for
+    every later study."""
+    from pyabc_tpu.autotune import compile_counters
+    worker = ServeWorker(root=str(tmp_path))
+    worker.serve_spec(_spec(pop=200, seed=0))
+    n0 = compile_counters()["n_compiles"]
+    for seed in (2, 3):
+        summary = worker.serve_spec(_spec(pop=200, seed=seed))
+        assert summary["served_from"] == "solo"
+    assert compile_counters()["n_compiles"] == n0
+    assert len(worker._engines) == 1  # one problem shape, one engine
+
+
+def test_queue_to_worker_end_to_end_with_multiplex(tmp_path):
+    """Three same-shape misses fuse onto the study axis; the in-batch
+    duplicate comes back from the cache; all tickets land in done/
+    with their serving path stamped."""
+    queue = StudyQueue(root=str(tmp_path))
+    for s, y in ((0, 0.2), (1, 0.3), (2, 0.5)):
+        queue.submit(_spec(pop=100, seed=s, y=y))
+    queue.submit(_spec(pop=100, seed=1, y=0.3))  # duplicate digest
+    worker = ServeWorker(root=str(tmp_path))
+    served = worker.run_forever(queue, once=True)
+    assert served == 4
+    stats = queue.stats()
+    assert (stats["pending"], stats["claimed"], stats["done"],
+            stats["failed"]) == (0, 0, 4, 0)
+    engines = sorted(
+        json.load(open(os.path.join(queue.root, "done", n),
+                       encoding="utf-8"))["engine"]
+        for n in os.listdir(os.path.join(queue.root, "done"))
+        if n.endswith(".json"))
+    assert engines.count("cache") == 1
+    assert engines.count("multiplex") == 3
+
+
+def test_sigterm_drain_requeues_in_flight(tmp_path):
+    queue = StudyQueue(root=str(tmp_path))
+    for seed in range(3):
+        queue.submit(_spec(seed=seed))
+    worker = ServeWorker(root=str(tmp_path))
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    try:
+        worker.install_signal_handlers()
+        # two studies already claimed when the drain signal lands
+        assert queue.claim(worker.worker_id) is not None
+        assert queue.claim(worker.worker_id) is not None
+        signal.raise_signal(signal.SIGTERM)
+        assert worker.draining
+        served = worker.run_forever(queue, once=True)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+    assert served == 0  # drained before dispatching anything
+    pending = queue.pending()
+    assert len(pending) == 3  # both claims bounced back, nothing lost
+    assert sorted(t.requeues for t in pending) == [0, 1, 1]
+    assert queue.stats()["claimed"] == 0

@@ -89,6 +89,15 @@ WATCHED = (
     # host sync crept back in.  50 % slack absorbs scheduler jitter on
     # the small setup/teardown constant it prices.
     ("podstar_pop1e7_collective_s_per_gen", "lower", 0.50),
+    # serving-tier throughput (bench_serve, serve/worker.py): the
+    # multi-tenant study mix through one warm worker — fails low when
+    # warm-engine reuse, the study axis or the content cache stops
+    # carrying the serving path (e.g. a recompile per study sneaks in)
+    ("serve_studies_per_s", "higher", 0.18),
+    # duplicate submissions MUST come back from the content-addressed
+    # cache; the ratio is pinned by the bench's fixed mix, so a drop
+    # means digests stopped matching (cache.py / spec.py drift)
+    ("serve_cache_hit_ratio", "higher", 0.10),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

@@ -1,0 +1,24 @@
+"""Planted violations: module-level mutable state shared across every
+study this worker process ever serves."""
+
+import collections
+
+_ENGINES = {}
+_RESULTS = []
+_SEEN_DIGESTS = set()
+_BY_TENANT = collections.defaultdict(list)
+_RECENT = collections.deque(maxlen=32)
+_LANES = [lane for lane in range(8)]
+
+# immutable module constants are fine — must NOT fire
+MAX_DEPTH = 256
+_ROOT_ENV = "PYABC_TPU_SERVE_DIR"
+_STOP_CODES = (0, 1, 2, 3)
+_NAMES = frozenset({"a", "b"})
+
+
+def submit(digest, result):
+    # per-call locals are fine — must NOT fire
+    staged = {}
+    staged[digest] = result
+    _RESULTS.append(staged)

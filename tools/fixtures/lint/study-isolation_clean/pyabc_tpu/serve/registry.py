@@ -1,0 +1,29 @@
+"""Same shapes as the bad fixture, either suppressed or moved onto an
+instance — must be silent."""
+
+import collections
+
+_ENGINES = {}  # graftlint: allow(study-isolation)
+_RESULTS = []  # graftlint: allow(study-isolation)
+_BY_TENANT = collections.defaultdict(list)  # graftlint: allow(study-isolation)
+_PROCESS_WIDE = set()  # study-state-ok
+
+# immutable module constants never fire
+MAX_DEPTH = 256
+_STOP_CODES = (0, 1, 2, 3)
+
+
+class Registry:
+    # class-body literals are declarative metadata, not shared state
+    _GUARDED_BY = {"_engines": "_lock"}
+
+    def __init__(self):
+        # instance state is the sanctioned home for mutables
+        self._engines = {}
+        self._results = []
+        self._by_tenant = collections.defaultdict(list)
+
+    def submit(self, digest, result):
+        staged = {}
+        staged[digest] = result
+        self._results.append(staged)

@@ -1,0 +1,151 @@
+"""Rule ``study-isolation``: no module-level mutable state in serve/.
+
+The serving subsystem (PR 14) runs MANY tenants' studies through one
+long-lived worker process.  Anything mutable at module scope — a
+registry dict, a results list, a memo cache — is shared by every study
+that process ever serves: state leaks across tenants, the
+multiplexed-vs-solo bit-identity contract silently breaks, and a
+drained worker can't be reasoned about as "queue + instances".  All
+serving state therefore lives on instances (``StudyQueue``,
+``StudyCache``, ``ServeWorker``, ``StudyBatch``), created per object
+and torn down with it.
+
+Scope: ``serve/`` under the package root.  The rule flags module-level
+assignments (plain, annotated, or augmented) whose value is a mutable
+container — a dict/list/set literal or comprehension, or a call to a
+known-mutable constructor (``dict``/``list``/``set``/``bytearray``/
+``collections.OrderedDict``/``defaultdict``/``deque``/``Counter``).
+Immutable module constants (strings, numbers, tuples, frozensets,
+compiled regexes) are fine, as is any state bound inside a function or
+held on a class instance.  Class-body attribute literals (e.g. the
+``_GUARDED_BY`` lock map) are declarative metadata, not shared state —
+out of scope.
+
+Suppression: ``# study-state-ok`` on the line;
+``# graftlint: allow(study-isolation)`` also works.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from ..core import Finding, Rule, default_package_root, register
+
+#: serving surface (package-root-relative, forward slashes)
+SCAN_PREFIXES = ("serve/",)
+
+SUPPRESS = "# study-state-ok"
+
+#: constructor names whose result is a shared mutable container
+MUTABLE_CALLS = frozenset({
+    "dict", "list", "set", "bytearray",
+    "OrderedDict", "defaultdict", "deque", "Counter",
+})
+
+_MUTABLE_LITERALS = (ast.Dict, ast.List, ast.Set,
+                     ast.ListComp, ast.SetComp, ast.DictComp)
+
+
+def _call_name(node: ast.Call) -> str:
+    """Trailing identifier of the callee: ``collections.OrderedDict``
+    and plain ``OrderedDict`` both resolve to ``OrderedDict``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_mutable_value(node) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call):
+        return _call_name(node) in MUTABLE_CALLS
+    return False
+
+
+def _module_level_mutables(tree: ast.Module):
+    """Yield (lineno, ) for module-scope statements binding a mutable
+    container.  Only the module body is walked — function bodies are
+    per-call state and class bodies are declarative metadata."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            targets = [stmt.target]
+        else:
+            continue
+        # dunder metadata (__all__ and friends) is interpreter-facing
+        # declaration, not study state
+        if all(isinstance(t, ast.Name)
+               and t.id.startswith("__") and t.id.endswith("__")
+               for t in targets):
+            continue
+        if value is not None and _is_mutable_value(value):
+            yield stmt.lineno
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def check(root: str = None) -> list:
+    """Scan serve/; returns ``[(relpath, lineno, line), ...]``
+    violations (empty = clean)."""
+    root = _package_root(root)
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if not rel.startswith(SCAN_PREFIXES):
+                continue
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue  # other tooling owns parse errors
+            lines = source.splitlines()
+            for lineno in _module_level_mutables(tree):
+                line = lines[lineno - 1] if lineno <= len(lines) else ""
+                if SUPPRESS in line:
+                    continue
+                violations.append((rel, lineno, line.rstrip()))
+    violations.sort(key=lambda v: (v[0], v[1]))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("study isolation: clean (serve/ keeps all mutable state "
+              "on instances)")
+        return 0
+    print("module-level mutable state in serve/ (shared across every "
+          "study the worker ever serves — move it onto an instance, or "
+          f"justify with '{SUPPRESS}'):")
+    for rel, lineno, line in violations:
+        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
+    return 1
+
+
+@register
+class StudyIsolationRule(Rule):
+    id = "study-isolation"
+    description = ("serve/ keeps all mutable state on instances — no "
+                   "module-level containers shared across studies")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, line.strip())
+                for rel, lineno, line in check(tree.package_root)]
