@@ -25,19 +25,22 @@ documented in ``docs/serving.md``.
 """
 
 from .cache import StudyCache
-from .multiplex import StudyBatch, multiplex_eligible
-from .queue import QueueFull, StudyQueue, TenantQuotaExceeded
+from .multiplex import StudyBatch, lane_eligible, multiplex_eligible
+from .queue import (QueueFull, SpecAuthError, StudyQueue,
+                    TenantQuotaExceeded)
 from .spec import StudySpec, problem_key, study_digest
 from .worker import ServeWorker
 
 __all__ = [
     "QueueFull",
     "ServeWorker",
+    "SpecAuthError",
     "StudyBatch",
     "StudyCache",
     "StudyQueue",
     "StudySpec",
     "TenantQuotaExceeded",
+    "lane_eligible",
     "multiplex_eligible",
     "problem_key",
     "study_digest",

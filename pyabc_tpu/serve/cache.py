@@ -3,7 +3,10 @@
 Duplicate submissions are the cheapest studies to serve: the digest
 (:func:`pyabc_tpu.serve.spec.study_digest`) covers everything that can
 move the posterior, so a digest hit IS the result — no queue slot, no
-dispatch, no device time.  The cache is a bounded in-memory LRU with
+dispatch, no device time.  The worker keys entries by
+``<digest>.<engine>`` (the two serving engines are statistically but
+not bitwise equivalent, so entries never alias across them); this
+class is agnostic to the key's composition.  The cache is a bounded in-memory LRU with
 optional directory persistence (one JSON file per digest under
 ``<serve dir>/cache/``) so a restarted worker re-serves its history;
 hit/miss/eviction counters land in the ``serve_*`` telemetry namespace

@@ -25,14 +25,21 @@ loop is a fixed-trip ``fori_loop`` with explicit ``live`` masking, and
 stopping never changes shapes — so the batched lanes and the solo lane
 trace to the same per-element op sequence.
 
-Knob: ``PYABC_TPU_SERVE_MULTIPLEX`` — max studies per batch
-(default 8; ``1`` disables multiplexing).
+Knobs: ``PYABC_TPU_SERVE_MULTIPLEX`` — max studies per batch
+(default 8; ``1`` disables multiplexing) and
+``PYABC_TPU_SERVE_MULTIPLEX_MAX_POP`` — the largest population the
+study-axis engine accepts (default 4096).  The importance-weight
+kernel is O(pop²) per lane, so big studies belong on the warm solo
+one-dispatch engine; :func:`lane_eligible` is the routing predicate
+the worker applies to EVERY miss, batched or alone — the engine a
+study runs on is a function of the spec and the worker config, never
+of what else happened to be in the queue.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, MutableMapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +51,11 @@ from .spec import (StudySpec, _callable_fingerprint, _digest_of,
 #: max studies fused per batch (1 disables the study axis)
 MULTIPLEX_ENV = "PYABC_TPU_SERVE_MULTIPLEX"
 
+#: largest population_size routed onto the study axis
+MULTIPLEX_MAX_POP_ENV = "PYABC_TPU_SERVE_MULTIPLEX_MAX_POP"
+
 _DEFAULT_MULTIPLEX = 8
+_DEFAULT_MAX_POP = 4096
 
 #: rejection rounds per generation before a lane declares undershoot
 _MAX_ROUNDS = 16
@@ -55,6 +66,9 @@ STOP_MIN_EPS = 1
 STOP_BUDGET = 2
 STOP_UNDERSHOOT = 3
 
+#: stop-code → reason string (summary schema parity with solo runs)
+STOP_NAMES = ("running", "min_eps", "budget", "undershoot")
+
 
 def multiplex_width() -> int:
     try:
@@ -62,6 +76,24 @@ def multiplex_width() -> int:
                                       str(_DEFAULT_MULTIPLEX))), 1)
     except ValueError:
         return _DEFAULT_MULTIPLEX
+
+
+def multiplex_max_pop() -> int:
+    try:
+        return max(int(os.environ.get(MULTIPLEX_MAX_POP_ENV,
+                                      str(_DEFAULT_MAX_POP))), 1)
+    except ValueError:
+        return _DEFAULT_MAX_POP
+
+
+def lane_eligible(spec: StudySpec) -> bool:
+    """Does this spec's content route it onto the study axis?  True
+    when multiplexing is enabled and the population fits the O(pop²)
+    lane kernel.  The predicate reads only the spec and the worker's
+    environment — co-traffic never changes the engine, so a digest's
+    result is reproducible run to run."""
+    return (multiplex_width() > 1
+            and int(spec.population_size) <= multiplex_max_pop())
 
 
 def _pow2_ceil(x: int) -> int:
@@ -132,10 +164,19 @@ class StudyBatch:
     SMC program (see module docstring for the engine and determinism
     contract).  Instances own their compiled function — serve-tier
     state lives on objects, never at module level (the
-    ``study-isolation`` lint rule enforces this for the package)."""
+    ``study-isolation`` lint rule enforces this for the package).
+
+    ``program_cache`` (optional, caller-owned — the worker passes its
+    LRU) maps :attr:`program_key` → the jitted batch function, so a
+    warm worker re-serves a previously seen (batch shape, rung,
+    budget) without tracing or compiling anything new.  Reuse is sound
+    because the key embeds :func:`batch_key`: any two batches sharing
+    it have fingerprint-identical models and config-identical priors,
+    so the cached closure computes the same program."""
 
     def __init__(self, specs: Sequence[StudySpec],
-                 max_rounds: int = _MAX_ROUNDS):
+                 max_rounds: int = _MAX_ROUNDS,
+                 program_cache: Optional[MutableMapping] = None):
         if not specs:
             raise ValueError("empty study batch")
         keys = {batch_key(s) for s in specs}
@@ -157,7 +198,18 @@ class StudyBatch:
         # ask, so nearby budgets share one program
         self.max_t = _pow2_ceil(
             max(max(int(s.max_generations), 1) for s in self.specs))
-        self._fn = jax.jit(jax.vmap(self._one_study))
+        self.program_key = (keys.pop(), self.rung, self.max_t,
+                            self.max_rounds)
+        self.program_cache_hit = False
+        fn = (None if program_cache is None
+              else program_cache.get(self.program_key))
+        if fn is None:
+            fn = jax.jit(jax.vmap(self._one_study))
+            if program_cache is not None:
+                program_cache[self.program_key] = fn
+        else:
+            self.program_cache_hit = True
+        self._fn = fn
 
     # ---- per-study engine (runs under vmap over the study axis) ---------
 

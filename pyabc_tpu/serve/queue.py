@@ -5,8 +5,8 @@ The reference pyABC farms studies through a redis broker
 the same manager/worker split but rides the existing run-dir mount
 contract (``parallel/health.py``): the queue IS a directory any
 shared filesystem all hosts mount, studies are single JSON files, and
-every state transition is one atomic ``rename`` — no broker process,
-no connection state, crash-safe by construction.
+state transitions are filesystem-atomic writes — no broker process,
+no connection state.
 
 Layout under the serve root (``$PYABC_TPU_SERVE_DIR``, defaulting to
 ``$PYABC_TPU_RUN_DIR/serve``)::
@@ -16,21 +16,57 @@ Layout under the serve root (``$PYABC_TPU_SERVE_DIR``, defaulting to
     queue/done/<id>.json               served (result in the cache)
     queue/failed/<id>.json             exhausted its attempts
 
+Crash-safety semantics, precisely:
+
+- ``submit`` and ``claim`` are each ONE atomic rename — a ticket is
+  never lost and never claimed twice.
+- ``complete`` / ``fail`` / ``requeue`` must mutate the payload, so
+  they are write-destination-then-unlink-source.  A crash between the
+  two steps leaves a *stale source copy* alongside the authoritative
+  destination.  Ticket ids make the duplicate detectable:
+  :meth:`~StudyQueue.requeue_worker` (the drain/janitor sweep) reaps a
+  claimed copy whose id already reached ``done``/``failed`` instead of
+  requeueing it, and a double requeue converges because the pending
+  destination is keyed by id.  Duplication is therefore at most
+  transient, never silent.
+- ``done``/``failed`` tickets are tombstones: the pickled spec (the
+  payload's bulk) is stripped on arrival, and
+  :meth:`~StudyQueue.sweep` (called from the worker's idle loop)
+  reaps tombstones older than ``PYABC_TPU_SERVE_RETAIN_S`` so a
+  long-lived serve root stays bounded.
+
 Admission enforces *backpressure* (``PYABC_TPU_SERVE_MAX_DEPTH``
 pending studies total → :class:`QueueFull`) and *per-tenant quotas*
 (``PYABC_TPU_SERVE_TENANT_QUOTA`` pending per tenant →
 :class:`TenantQuotaExceeded`) so one tenant cannot starve the fleet.
+Both checks are list-then-write and therefore **best-effort** across
+concurrent submitters: racing submissions can each pass the check and
+overshoot the bound by at most the number of in-flight racers.  The
+limits are operator guard rails, not hard capacity guarantees.
 Claiming orders by *aged priority*: ``priority + age_s /
 PYABC_TPU_SERVE_AGING_S`` — a low-priority study waiting long enough
 eventually outranks fresh high-priority traffic, so nothing starves.
 A SIGTERM-draining worker :meth:`~StudyQueue.requeue`\\ s its claimed
 studies back to pending (``requeues`` is incremented — the poison-pill
 ledger).
+
+Trust model: the spec payload is a pickle, and unpickling executes
+code.  By default submitters are *code-trusted* — anyone who can write
+``queue/pending/`` can run arbitrary code on every worker, exactly
+like the reference pyABC's cloudpickle-over-redis sampler — so the
+serve root must NOT be writable by untrusted tenants; route untrusted
+traffic through a front-end that constructs the specs itself.  Where
+the mount is shared more widely, set ``PYABC_TPU_SERVE_HMAC_KEY`` on
+submitters and workers: payloads are then HMAC-SHA256-signed at
+submit and verified *before* unpickling, so only key-holders can make
+a worker deserialize anything.
 """
 
 from __future__ import annotations
 
 import base64
+import hashlib
+import hmac
 import json
 import os
 import pickle
@@ -56,9 +92,17 @@ TENANT_QUOTA_ENV = "PYABC_TPU_SERVE_TENANT_QUOTA"
 #: priority aging: seconds of queue age worth +1 effective priority
 AGING_S_ENV = "PYABC_TPU_SERVE_AGING_S"
 
+#: optional shared secret: when set, spec payloads are HMAC-signed at
+#: submit and verified BEFORE unpickling (see the module trust model)
+HMAC_KEY_ENV = "PYABC_TPU_SERVE_HMAC_KEY"
+
+#: done/failed tombstone retention in seconds (0 disables the sweep)
+RETAIN_S_ENV = "PYABC_TPU_SERVE_RETAIN_S"
+
 _DEFAULT_MAX_DEPTH = 256
 _DEFAULT_TENANT_QUOTA = 32
 _DEFAULT_AGING_S = 30.0
+_DEFAULT_RETAIN_S = 3600.0
 
 
 class QueueFull(RuntimeError):
@@ -67,6 +111,21 @@ class QueueFull(RuntimeError):
 
 class TenantQuotaExceeded(QueueFull):
     """This tenant's pending share is at its admission quota."""
+
+
+class SpecAuthError(RuntimeError):
+    """A signing key is configured and the ticket's spec payload has a
+    missing or invalid HMAC — the worker refuses to unpickle it."""
+
+
+def _hmac_key() -> Optional[bytes]:
+    key = os.environ.get(HMAC_KEY_ENV)
+    return key.encode("utf-8") if key else None
+
+
+def _sign_spec(key: bytes, spec_b64: str) -> str:
+    return hmac.new(key, spec_b64.encode("ascii"),
+                    hashlib.sha256).hexdigest()
 
 
 def serve_root(root: Optional[str] = None) -> str:
@@ -119,8 +178,19 @@ class Ticket:
     _payload: Optional[dict] = field(default=None, repr=False)
 
     def load_spec(self) -> StudySpec:
-        return pickle.loads(
-            base64.b64decode(self._payload["spec_b64"]))
+        """Reconstruct the spec.  Unpickling EXECUTES code: with no
+        ``PYABC_TPU_SERVE_HMAC_KEY`` configured, submitters are
+        code-trusted (module trust model); with a key, the payload's
+        signature is verified first and a bad one raises
+        :class:`SpecAuthError` — the worker's poison-ticket path."""
+        spec_b64 = self._payload["spec_b64"]
+        key = _hmac_key()
+        if key is not None:
+            tag = str(self._payload.get("spec_hmac", ""))
+            if not hmac.compare_digest(_sign_spec(key, spec_b64), tag):
+                raise SpecAuthError(
+                    f"ticket {self.id}: spec HMAC missing or invalid")
+        return pickle.loads(base64.b64decode(spec_b64))
 
     def effective_priority(self, aging_s: float,
                            now: Optional[float] = None) -> float:
@@ -213,7 +283,10 @@ class StudyQueue:
     def submit(self, spec: StudySpec) -> Ticket:
         """Admit one study; raises :class:`QueueFull` /
         :class:`TenantQuotaExceeded` instead of queueing unboundedly —
-        backpressure the submitter can see and retry against."""
+        backpressure the submitter can see and retry against.  The
+        depth/quota checks are best-effort under concurrent submitters
+        (module docstring): racers can overshoot the bound by at most
+        the number of in-flight submissions."""
         pending = self.pending()
         if len(pending) >= self.max_depth:
             REGISTRY.counter(
@@ -241,6 +314,9 @@ class StudyQueue:
             "spec_b64": base64.b64encode(
                 pickle.dumps(spec)).decode("ascii"),
         }
+        key = _hmac_key()
+        if key is not None:
+            payload["spec_hmac"] = _sign_spec(key, payload["spec_b64"])
         path = os.path.join(self._dir("pending"), f"{sid}.json")
         self._write_atomic(path, payload)
         REGISTRY.counter(
@@ -282,8 +358,18 @@ class StudyQueue:
         return None
 
     def _move(self, ticket: Ticket, state: str, extra: dict) -> str:
+        """Write-destination-then-unlink-source (NOT one rename — the
+        payload mutates).  A crash between the steps leaves a stale
+        source copy that ``requeue_worker`` reaps by id; see the
+        module docstring's crash-safety semantics."""
         payload = dict(ticket._payload or {})
         payload.update(extra)
+        if state in ("done", "failed"):
+            # tombstones: the result lives in the cache, so the
+            # pickled spec (the payload's bulk) is dropped — done/
+            # failed stay small and sweepable
+            payload.pop("spec_b64", None)
+            payload.pop("spec_hmac", None)
         dest = os.path.join(self._dir(state), f"{ticket.id}.json")
         self._write_atomic(dest, payload)
         if ticket.path and os.path.exists(ticket.path):
@@ -309,10 +395,27 @@ class StudyQueue:
             "error": str(error)[:2000],
         })
 
-    def requeue(self, ticket: Ticket):
+    def requeue(self, ticket: Ticket) -> bool:
         """Return a claimed study to pending (SIGTERM drain, crashed
         attempt) with its original submission time — its accumulated
-        age, and therefore its aged priority, survives the bounce."""
+        age, and therefore its aged priority, survives the bounce.
+
+        If the ticket's id already reached ``done``/``failed`` the
+        claimed file is a stale copy from a crash between
+        :meth:`_move`'s write and unlink: it is reaped, not requeued
+        (returns ``False``) — the study is never served twice.  A
+        crash inside requeue itself converges the same way: the
+        pending destination is keyed by id, so a second requeue
+        overwrites rather than duplicates."""
+        for state in ("done", "failed"):
+            if os.path.exists(os.path.join(self._dir(state),
+                                           f"{ticket.id}.json")):
+                if ticket.path and os.path.exists(ticket.path):
+                    try:
+                        os.unlink(ticket.path)
+                    except OSError:
+                        pass
+                return False
         payload = dict(ticket._payload or {})
         payload["requeues"] = int(payload.get("requeues", 0)) + 1
         dest = os.path.join(self._dir("pending"), f"{ticket.id}.json")
@@ -328,10 +431,13 @@ class StudyQueue:
         REGISTRY.counter(
             "serve_queue_requeues_total",
             "claimed studies returned to pending (drain/crash)").inc()
+        return True
 
     def requeue_worker(self, worker_id: str) -> int:
         """Requeue EVERY study a worker still holds — the drain path's
-        bulk form, also the janitor's recovery for a crashed worker."""
+        bulk form, also the janitor's recovery for a crashed worker.
+        Stale claims whose id already completed are reaped instead of
+        requeued (see :meth:`requeue`); the count excludes them."""
         wdir = os.path.join(self._dir("claimed"), worker_id)
         if not os.path.isdir(wdir):
             return 0
@@ -340,7 +446,43 @@ class StudyQueue:
             if not name.endswith(".json"):
                 continue
             t = _ticket_from_file(os.path.join(wdir, name))
-            if t is not None:
-                self.requeue(t)
+            if t is not None and self.requeue(t):
                 n += 1
+        return n
+
+    # ---- housekeeping ----------------------------------------------------
+
+    def sweep(self, retain_s: Optional[float] = None,
+              now: Optional[float] = None) -> int:
+        """Reap ``done``/``failed`` tombstones older than the
+        retention window (``PYABC_TPU_SERVE_RETAIN_S``, default 1 h;
+        ``0`` disables) so a long-lived serve root stays bounded and
+        :meth:`stats` stays cheap.  Called from the worker's idle
+        loop; safe to run from any process on the mount."""
+        if retain_s is None:
+            try:
+                retain_s = float(os.environ.get(
+                    RETAIN_S_ENV, str(_DEFAULT_RETAIN_S)))
+            except ValueError:
+                retain_s = _DEFAULT_RETAIN_S
+        if retain_s <= 0:
+            return 0
+        now = time.time() if now is None else now
+        n = 0
+        for state in ("done", "failed"):
+            base = self._dir(state)
+            for name in os.listdir(base):
+                if not name.endswith(".json"):
+                    continue
+                path = os.path.join(base, name)
+                try:
+                    if now - os.path.getmtime(path) > retain_s:
+                        os.unlink(path)
+                        n += 1
+                except OSError:
+                    continue  # another sweeper won the race
+        if n:
+            REGISTRY.counter(
+                "serve_queue_swept_total",
+                "expired done/failed tombstones reaped").inc(n)
         return n

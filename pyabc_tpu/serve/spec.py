@@ -13,9 +13,16 @@ study axis (:mod:`pyabc_tpu.serve.multiplex`).
 Two digests matter, and they are deliberately different sets:
 
 - :func:`study_digest` hashes EVERYTHING that can change the posterior
-  (model, prior, distance, eps config, observed data, budgets, seed) —
-  the content address of the result, the study cache's key.  Any
-  config perturbation is a different study.
+  (model, prior, distance, eps config, observed data, budgets, seed).
+  Any config perturbation is a different study.  The digest is the
+  content address of the result *per serving engine*: the warm solo
+  one-dispatch engine and the study-axis engine are statistically but
+  not bitwise equivalent (different perturbation kernels and RNG fold
+  structure), so the worker scopes its cache key by
+  ``(study_digest, engine)`` and routes each spec to one engine as a
+  pure function of its content (``serve/multiplex.lane_eligible``) —
+  equal digests served under the same worker config return identical
+  bits, and never alias across engines.
 - :func:`problem_key` hashes only what the COMPILED PROGRAM depends on
   (model, prior, distance, eps mode, observed data, population size) —
   the warm-engine pool's key.  Studies that differ only in seed,
@@ -107,7 +114,9 @@ def _digest_of(parts: dict) -> str:
 
 def study_digest(spec: StudySpec) -> str:
     """Content address of the study RESULT: every field that can move
-    the posterior participates; tenant/priority/name do not."""
+    the posterior participates; tenant/priority/name do not.  Bitwise
+    reproducibility is per engine — the worker pairs this digest with
+    the engine the spec content routes to (module docstring)."""
     return _digest_of({
         "v": DIGEST_VERSION,
         "model": _callable_fingerprint(spec.model),

@@ -14,11 +14,25 @@ XLA compiles** (the contract ``tests/test_serve.py`` pins with
 
 Serving order per claimed batch:
 
-1. content-addressed cache (:mod:`~pyabc_tpu.serve.cache`) — a digest
-   hit is returned without any dispatch;
-2. the study axis (:mod:`~pyabc_tpu.serve.multiplex`) — ≥2 eligible
-   misses fuse into one vmapped dispatch;
-3. warm solo ``run_mode="onedispatch"`` on a pooled engine.
+1. content-addressed cache (:mod:`~pyabc_tpu.serve.cache`) — a hit on
+   the (digest, engine) key is returned without any dispatch;
+2. the study axis (:mod:`~pyabc_tpu.serve.multiplex`) — EVERY
+   lane-eligible miss, fused by ``batch_key`` (a group of one runs as
+   a ``StudyBatch`` of one);
+3. warm solo ``run_mode="onedispatch"`` on a pooled engine for
+   everything the study-axis kernel cannot take (large populations,
+   or multiplexing disabled).
+
+Which engine serves a study is :meth:`ServeWorker._engine_of` — a
+pure function of the spec content and the worker configuration, never
+of co-traffic.  Together with the study axis's batch-shape
+bit-identity contract this makes results reproducible: the same spec
+resubmitted to the same worker config returns the same bits,
+regardless of what else was in the queue.  The two engines are
+*statistically* equivalent but NOT bitwise (different perturbation
+kernels and RNG fold structure), which is why the result cache is
+keyed by digest **and** engine — a reconfigured worker sharing a
+serve root can never alias the other engine's entries.
 
 SIGTERM starts a *drain*: the in-flight study finishes, every study
 still claimed is requeued (``StudyQueue.requeue_worker``), and the
@@ -40,12 +54,16 @@ import numpy as np
 
 from ..telemetry.metrics import REGISTRY
 from .cache import StudyCache
-from .multiplex import StudyBatch, multiplex_eligible, multiplex_width
+from .multiplex import (STOP_NAMES, StudyBatch, lane_eligible,
+                        multiplex_eligible, multiplex_width)
 from .queue import StudyQueue, Ticket, default_worker_id, serve_root
 from .spec import StudySpec, problem_key, study_digest
 
 #: warm engines held per worker (LRU beyond this)
 _MAX_ENGINES = 4
+
+#: compiled study-axis programs held per worker (LRU beyond this)
+_MAX_BATCH_PROGRAMS = 8
 
 _TENANT_SAFE = re.compile(r"[^A-Za-z0-9_]")
 
@@ -72,9 +90,27 @@ class ServeWorker:
         self.max_engines = max(int(max_engines), 1)
         self.run_mode = run_mode
         self._engines: "OrderedDict[str, object]" = OrderedDict()
+        self._batch_programs: "OrderedDict[tuple, object]" = OrderedDict()
         self._draining = threading.Event()
         self.served = 0
         self.walls_ms: List[float] = []
+
+    # ---- engine routing --------------------------------------------------
+
+    @staticmethod
+    def _engine_of(spec: StudySpec) -> str:
+        """The engine that defines this spec's result — decided by the
+        spec content and worker config alone (``lane_eligible``), so a
+        digest always maps to one engine and one reproducible result."""
+        return "multiplex" if lane_eligible(spec) else "solo"
+
+    @staticmethod
+    def _cache_key(digest: str, engine: str) -> str:
+        """Result-cache key: the two engines are statistically but not
+        bitwise equivalent, so entries are engine-scoped — a worker
+        with different multiplex knobs sharing this serve root misses
+        rather than aliasing."""
+        return f"{digest}.{engine}"
 
     # ---- engine pool -----------------------------------------------------
 
@@ -137,17 +173,57 @@ class ServeWorker:
         return summary
 
     def serve_spec(self, spec: StudySpec) -> dict:
-        """Serve one study: cache, else warm solo one-dispatch run."""
+        """Serve one study: cache, else the engine its content routes
+        to — a ``StudyBatch`` of one for lane-eligible specs, the warm
+        solo one-dispatch engine otherwise."""
         t0 = time.perf_counter()
         digest = study_digest(spec)
-        hit = self.cache.get(digest)
+        engine = self._engine_of(spec)
+        hit = self.cache.get(self._cache_key(digest, engine))
         if hit is not None:
             return self._finish(spec, hit, time.perf_counter() - t0,
                                 "cache")
-        summary = self._solo_summary(spec, digest)
-        self.cache.put(digest, summary)
+        summary = self._dispatch_miss(spec, digest, engine)
         return self._finish(spec, summary, time.perf_counter() - t0,
-                            "solo")
+                            engine)
+
+    def _dispatch_miss(self, spec: StudySpec, digest: str,
+                       engine: str) -> dict:
+        """Run one miss on its content-routed engine and cache the
+        summary under the engine-scoped key."""
+        if engine == "multiplex":
+            res = self._run_batch([spec])[0]
+            summary = self._batch_summary(spec, res, digest)
+        else:
+            summary = self._solo_summary(spec, digest)
+        self.cache.put(self._cache_key(digest, engine), summary)
+        return summary
+
+    def _run_batch(self, group: Sequence[StudySpec]) -> List[dict]:
+        """Dispatch one study-axis batch through the worker's compiled
+        program pool — a repeat (batch shape, rung, budget) reuses the
+        jitted function, so sequential eligible studies after the
+        first compile nothing."""
+        from ..autotune import install_compile_listener
+        install_compile_listener()
+        batch = StudyBatch(group, program_cache=self._batch_programs)
+        if batch.program_cache_hit:
+            self._batch_programs.move_to_end(batch.program_key)
+            REGISTRY.counter(
+                "serve_batch_program_hits_total",
+                "study-axis dispatches on an already-built program"
+            ).inc()
+        else:
+            REGISTRY.counter(
+                "serve_batch_program_builds_total",
+                "study-axis programs built (first batch of a shape)"
+            ).inc()
+        while len(self._batch_programs) > _MAX_BATCH_PROGRAMS:
+            self._batch_programs.popitem(last=False)
+            REGISTRY.counter(
+                "serve_batch_program_evictions_total",
+                "study-axis programs dropped by the pool LRU").inc()
+        return batch.run()
 
     def _solo_summary(self, spec: StudySpec, digest: str) -> dict:
         abc = self._engine_for(spec)
@@ -165,10 +241,11 @@ class ServeWorker:
             for c in names}
         return {
             "digest": digest,
-            "engine": "solo_onedispatch",
+            "engine": "solo",
             "gens": int(len(pops)),
             "eps": float(pops["epsilon"].iloc[-1]) if len(pops) else None,
             "n_sims": int(pops["samples"].sum()) if len(pops) else 0,
+            "stop_reason": getattr(abc.timeline, "stop_reason", None),
             "population_size": int(spec.population_size),
             "posterior_mean": mean,
             "posterior_std": std,
@@ -189,18 +266,22 @@ class ServeWorker:
             "engine": "multiplex",
             "gens": int(res["gens"]),
             "eps": float(res["eps"]),
+            # exact for this engine: every active rejection round
+            # simulates pop candidates, plus the generation-0 draw
             "n_sims": int(res["rounds"]) * int(spec.population_size)
             + int(spec.population_size),
-            "stop_code": int(res["stop_code"]),
+            "stop_reason": STOP_NAMES[int(res["stop_code"])],
             "population_size": int(spec.population_size),
             "posterior_mean": mean,
             "posterior_std": std,
         }
 
     def serve_many(self, specs: Sequence[StudySpec]) -> List[dict]:
-        """Serve a claimed batch: cache hits first, then fuse the
-        remaining eligible studies onto the study axis, then warm solo
-        runs for whatever is left."""
+        """Serve a claimed batch: cache hits first, then every
+        lane-eligible miss through the study axis (grouped by
+        ``batch_key``; a group of one is a batch of one — the engine,
+        and therefore the result bits, never depend on co-traffic),
+        then warm solo runs for the rest."""
         out: List[Optional[dict]] = [None] * len(specs)
         misses: List[Tuple[int, StudySpec, str]] = []
         waiters: List[Tuple[int, StudySpec, str]] = []
@@ -214,52 +295,51 @@ class ServeWorker:
                 # than dispatching the same study twice
                 waiters.append((i, spec, digest))
                 continue
-            hit = self.cache.get(digest)
+            hit = self.cache.get(
+                self._cache_key(digest, self._engine_of(spec)))
             if hit is not None:
                 out[i] = self._finish(
                     spec, hit, time.perf_counter() - t0, "cache")
             else:
                 seen_digests.add(digest)
                 misses.append((i, spec, digest))
-        if misses:
-            groups = multiplex_eligible([s for _i, s, _d in misses])
-            by_id = {id(s): (i, d) for i, s, d in misses}
-            for group in groups:
-                if len(group) >= 2 and multiplex_width() > 1:
-                    t0 = time.perf_counter()
-                    results = StudyBatch(group).run()
-                    wall = time.perf_counter() - t0
-                    REGISTRY.counter(
-                        "serve_multiplexed_studies_total",
-                        "studies served fused on the study axis"
-                    ).inc(len(group))
-                    for spec, res in zip(group, results):
-                        i, digest = by_id[id(spec)]
-                        summary = self._batch_summary(spec, res, digest)
-                        self.cache.put(digest, summary)
-                        out[i] = self._finish(
-                            spec, summary, wall / len(group),
-                            "multiplex")
-                else:
-                    for spec in group:
-                        i, digest = by_id[id(spec)]
-                        t0 = time.perf_counter()
-                        summary = self._solo_summary(spec, digest)
-                        self.cache.put(digest, summary)
-                        out[i] = self._finish(
-                            spec, summary, time.perf_counter() - t0,
-                            "solo")
+        lanes = [(i, s, d) for i, s, d in misses if lane_eligible(s)]
+        solos = [(i, s, d) for i, s, d in misses
+                 if not lane_eligible(s)]
+        if lanes:
+            by_id = {id(s): (i, d) for i, s, d in lanes}
+            for group in multiplex_eligible([s for _i, s, _d in lanes]):
+                t0 = time.perf_counter()
+                results = self._run_batch(group)
+                wall = time.perf_counter() - t0
+                REGISTRY.counter(
+                    "serve_multiplexed_studies_total",
+                    "studies served fused on the study axis"
+                ).inc(len(group))
+                for spec, res in zip(group, results):
+                    i, digest = by_id[id(spec)]
+                    summary = self._batch_summary(spec, res, digest)
+                    self.cache.put(
+                        self._cache_key(digest, "multiplex"), summary)
+                    out[i] = self._finish(
+                        spec, summary, wall / len(group), "multiplex")
+        for i, spec, digest in solos:
+            t0 = time.perf_counter()
+            summary = self._solo_summary(spec, digest)
+            self.cache.put(self._cache_key(digest, "solo"), summary)
+            out[i] = self._finish(
+                spec, summary, time.perf_counter() - t0, "solo")
         for i, spec, digest in waiters:
             t0 = time.perf_counter()
-            hit = self.cache.get(digest)
+            engine = self._engine_of(spec)
+            hit = self.cache.get(self._cache_key(digest, engine))
             if hit is not None:
                 out[i] = self._finish(
                     spec, hit, time.perf_counter() - t0, "cache")
             else:  # original evicted between put and here: serve it
-                summary = self._solo_summary(spec, digest)
-                self.cache.put(digest, summary)
+                summary = self._dispatch_miss(spec, digest, engine)
                 out[i] = self._finish(
-                    spec, summary, time.perf_counter() - t0, "solo")
+                    spec, summary, time.perf_counter() - t0, engine)
         return [s for s in out if s is not None]
 
     # ---- queue loop ------------------------------------------------------
@@ -311,6 +391,7 @@ class ServeWorker:
                 head = queue.claim(self.worker_id)
                 if head is None:
                     self._snapshot_gauges(queue)
+                    queue.sweep()  # idle housekeeping: done/failed GC
                     if once:
                         break
                     time.sleep(poll_s)

@@ -85,10 +85,12 @@ CREATE TABLE IF NOT EXISTS summary_statistics (
 
 
 def _np_bytes(value) -> bytes:
-    # same .npy encoding as the native blobs (and the reference's
-    # numpy_bytes_storage.np_to_bytes)
-    from .history import _pack
-    return _pack(np.asarray(value))
+    # plain .npy, NOT History._pack: reference-schema DBs must stay
+    # readable by the reference's numpy_bytes_storage.np_from_bytes,
+    # which knows nothing of the wire codec
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(value), allow_pickle=False)
+    return buf.getvalue()
 
 
 def _sql_datetime(stamp) -> Optional[str]:
@@ -108,6 +110,7 @@ def to_reference_db(history, path: str,
     skips the per-particle summary-statistic rows (the by-far largest
     table) when only parameters/weights/distances are needed.
     """
+    from .history import _unpack
     src = history
     dst = sqlite3.connect(path)
     try:
@@ -156,11 +159,11 @@ def to_reference_db(history, path: str,
                     "VALUES (?,?,?,?)",
                     (population_id, int(m), name, float(p_model)))
                 model_id = cur.lastrowid
-                theta = np.load(io.BytesIO(theta_b), allow_pickle=False)
-                w = np.asarray(
-                    np.load(io.BytesIO(w_b), allow_pickle=False),
-                    dtype=np.float64)
-                d = np.load(io.BytesIO(d_b), allow_pickle=False)
+                # native blobs go through History._pack (wire codec by
+                # default), so decode with the codec-sniffing _unpack
+                theta = _unpack(theta_b)
+                w = np.asarray(_unpack(w_b), dtype=np.float64)
+                d = _unpack(d_b)
                 names = json.loads(names_json) if names_json else []
                 # within-model normalization (reference convention:
                 # global weight = particle.w * model.p_model)
@@ -316,8 +319,9 @@ def from_reference_db(path: str, db: str = "sqlite://",
             "WHERE populations.abc_smc_id=? AND populations.t=-1",
             (abc_id,)).fetchall()
         from .bytes_storage import to_bytes
+        from .history import _unpack
         for key, blob in obs_rows:
-            val = np.load(io.BytesIO(blob), allow_pickle=False)
+            val = _unpack(blob)
             tag, b = to_bytes(val)
             hist._conn.execute(
                 "INSERT OR REPLACE INTO observed_data VALUES (?,?,?,?)",
@@ -329,7 +333,9 @@ def from_reference_db(path: str, db: str = "sqlite://",
             (abc_id,)).fetchall()
         for pop_id, t, eps, nr_samples, end_time in pops:
             hist._conn.execute(
-                "INSERT OR REPLACE INTO populations VALUES (?,?,?,?,?)",
+                "INSERT OR REPLACE INTO populations (abc_smc_id, t, "
+                "epsilon, nr_samples, population_end_time) "
+                "VALUES (?,?,?,?,?)",
                 (hist.id, t, eps, nr_samples,
                  str(end_time) if end_time else None))
             model_rows = src.execute(
@@ -347,7 +353,7 @@ def from_reference_db(path: str, db: str = "sqlite://",
 
 def _import_model(src, hist, t: int, m: int, name, p_model: float,
                   model_id: int):
-    from .history import _pack
+    from .history import _pack, _unpack
 
     particles = src.execute(
         "SELECT id, w FROM particles WHERE model_id=? ORDER BY id",
@@ -397,9 +403,7 @@ def _import_model(src, hist, t: int, m: int, name, p_model: float,
         if ss_rows:
             by_sid: dict = {}
             for sid, nm, blob in ss_rows:
-                arr = np.asarray(
-                    np.load(io.BytesIO(blob), allow_pickle=False),
-                    dtype=np.float32)
+                arr = np.asarray(_unpack(blob), dtype=np.float32)
                 by_sid.setdefault(sid, {})[nm] = np.atleast_1d(arr)
             # column layout from the UNION of keys (shape from each
             # key's first occurrence); a key missing on some particle
@@ -430,8 +434,9 @@ def _import_model(src, hist, t: int, m: int, name, p_model: float,
                                offsets[k]:offsets[k] + size] = arr.ravel()
     w_global = (w_within * p_model).astype(np.float32)
     hist._conn.execute(
-        "INSERT OR REPLACE INTO model_populations VALUES "
-        "(?,?,?,?,?,?,?,?,?,?,?,?)",
+        "INSERT OR REPLACE INTO model_populations (abc_smc_id, t, m, "
+        "name, p_model, n_particles, theta, weight, distance, stats, "
+        "param_names, stat_spec) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
         (hist.id, t, m, name, p_model, len(pids),
          _pack(theta), _pack(w_global), _pack(d),
          _pack(stats_flat) if stats_flat is not None else None,

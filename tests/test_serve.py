@@ -8,15 +8,24 @@ Pins the four contracts docs/serving.md advertises:
   the same study served in a batch of 1 (pop 1e3);
 - content addressing: a duplicate digest is served from the cache
   without any dispatch; any config perturbation is a different digest;
+  cache entries are engine-scoped and the engine is routed from spec
+  content alone, so results never depend on co-traffic;
 - warmth: after the first study on a problem shape, sequential studies
-  through the warm worker trigger ZERO new XLA compiles, and a SIGTERM
-  drain requeues everything still claimed.
+  through the warm worker trigger ZERO new XLA compiles (both the solo
+  engine pool and the study-axis program pool), and a SIGTERM drain
+  requeues everything still claimed;
+- queue hygiene: HMAC-gated unpickling when a key is configured,
+  spec-stripped done/failed tombstones with a retention sweep, and
+  stale crash duplicates reaped by id instead of re-served.
 """
 
+import base64
 import json
 import os
+import pickle
 import signal
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -27,8 +36,9 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 import pyabc_tpu as pt  # noqa: E402
-from pyabc_tpu.serve import (QueueFull, ServeWorker, StudyBatch,  # noqa: E402
-                             StudyCache, StudyQueue, StudySpec,
+from pyabc_tpu.serve import (QueueFull, ServeWorker,  # noqa: E402
+                             SpecAuthError, StudyBatch, StudyCache,
+                             StudyQueue, StudySpec,
                              TenantQuotaExceeded, study_digest)
 from pyabc_tpu.serve.queue import serve_root  # noqa: E402
 
@@ -227,26 +237,29 @@ def test_multiplex_batch_matches_solo():
 def test_duplicate_served_from_cache_without_dispatch(tmp_path):
     worker = ServeWorker(root=str(tmp_path))
     first = worker.serve_spec(_spec(pop=100, seed=0))
-    assert first["served_from"] == "solo"
+    assert first["served_from"] == "multiplex"  # content-routed
     # any dispatch path would now blow up — the duplicate must not
     # touch an engine at all
     def _boom(*_a, **_k):
         raise AssertionError("duplicate digest dispatched")
     worker._solo_summary = _boom
+    worker._run_batch = _boom
     again = worker.serve_spec(_spec(pop=100, seed=0))
     assert again["served_from"] == "cache"
     assert again["posterior_mean"] == first["posterior_mean"]
     assert worker.cache.stats()["hits"] >= 1
 
 
-def test_warm_worker_zero_recompiles_after_first(tmp_path):
+def test_warm_worker_zero_recompiles_after_first(tmp_path, monkeypatch):
     """Studies 2 and 3 on the same problem shape (different seeds) ride
-    the renewed engine's pinned programs: compile delta 0.  Seeds are
+    the renewed engine's pinned programs: compile delta 0.  Multiplex
+    is disabled so the SOLO warm path is the one under test.  Seeds are
     chosen so the adaptive batch ladder stays on rungs the first study
     already compiled — a study whose acceptance path visits a NEW rung
     legitimately pays one compile, which the ladder then caches for
     every later study."""
     from pyabc_tpu.autotune import compile_counters
+    monkeypatch.setenv("PYABC_TPU_SERVE_MULTIPLEX", "1")
     worker = ServeWorker(root=str(tmp_path))
     worker.serve_spec(_spec(pop=200, seed=0))
     n0 = compile_counters()["n_compiles"]
@@ -255,6 +268,125 @@ def test_warm_worker_zero_recompiles_after_first(tmp_path):
         assert summary["served_from"] == "solo"
     assert compile_counters()["n_compiles"] == n0
     assert len(worker._engines) == 1  # one problem shape, one engine
+
+
+def test_warm_worker_zero_recompiles_on_study_axis(tmp_path):
+    """The same warmth contract on the multiplex engine: sequential
+    eligible studies (singleton claims, the everyday serving stream)
+    reuse the pooled compiled batch program — compile delta 0 after
+    the first."""
+    from pyabc_tpu.autotune import compile_counters
+    worker = ServeWorker(root=str(tmp_path))
+    first = worker.serve_spec(_spec(pop=100, seed=0))
+    assert first["served_from"] == "multiplex"
+    n0 = compile_counters()["n_compiles"]
+    for seed in (2, 3):
+        summary = worker.serve_spec(_spec(pop=100, seed=seed))
+        assert summary["served_from"] == "multiplex"
+    assert compile_counters()["n_compiles"] == n0
+    assert len(worker._batch_programs) == 1  # one shape, one program
+
+
+def test_engine_routing_is_content_deterministic(tmp_path):
+    """The review contract: the same spec returns the same BITS
+    whether it was claimed alone or alongside co-traffic.  Every
+    lane-eligible miss runs on the study-axis engine (a batch of one
+    when alone), and lanes are batch-shape invariant, so the digest →
+    result mapping never depends on what else was in the queue."""
+    alone = ServeWorker(root=str(tmp_path / "a")).serve_many(
+        [_spec(pop=300, seed=0, y=0.2)])[0]
+    crowded = ServeWorker(root=str(tmp_path / "b")).serve_many(
+        [_spec(pop=300, seed=0, y=0.2),
+         _spec(pop=300, seed=1, y=-0.3),
+         _spec(pop=300, seed=2, y=0.6)])[0]
+    assert alone["served_from"] == "multiplex"
+    assert crowded["served_from"] == "multiplex"
+    for k in ("posterior_mean", "posterior_std", "eps", "gens",
+              "n_sims", "stop_reason", "digest"):
+        assert alone[k] == crowded[k], k
+
+
+def test_cache_is_engine_scoped(tmp_path, monkeypatch):
+    """The two engines are statistically, not bitwise, equivalent — a
+    multiplex-engine entry must never be returned once the worker
+    config routes the same digest to the solo engine.  The cache key
+    carries the engine, so a knob change misses and recomputes
+    instead of aliasing."""
+    worker = ServeWorker(root=str(tmp_path))
+    first = worker.serve_spec(_spec(pop=100, seed=0))
+    assert first["served_from"] == "multiplex"
+    monkeypatch.setenv("PYABC_TPU_SERVE_MULTIPLEX", "1")
+    second = worker.serve_spec(_spec(pop=100, seed=0))
+    assert second["served_from"] == "solo"
+    assert second["engine"] == "solo"
+    assert second["digest"] == first["digest"]
+    # the summary schema is engine-independent (review: schema parity)
+    assert set(first) == set(second)
+
+
+def test_hmac_gates_spec_unpickling(tmp_path, monkeypatch):
+    """With PYABC_TPU_SERVE_HMAC_KEY set, a tampered or unsigned spec
+    payload raises before pickle.loads ever runs — the poison-ticket
+    path, not code execution."""
+    monkeypatch.setenv("PYABC_TPU_SERVE_HMAC_KEY", "s3cret")
+    q = StudyQueue(root=str(tmp_path))
+    t = q.submit(_spec(seed=0))
+    assert t.load_spec().seed == 0  # signed at submit: verifies
+    # tamper the pending file: swap in a different pickled spec
+    with open(t.path, encoding="utf-8") as f:
+        payload = json.load(f)
+    payload["spec_b64"] = base64.b64encode(
+        pickle.dumps(_spec(seed=9))).decode("ascii")
+    with open(t.path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    with pytest.raises(SpecAuthError):
+        q.claim("w1").load_spec()
+    # a ticket submitted WITHOUT the key (unsigned) is refused too
+    monkeypatch.delenv("PYABC_TPU_SERVE_HMAC_KEY")
+    q.submit(_spec(seed=1))
+    monkeypatch.setenv("PYABC_TPU_SERVE_HMAC_KEY", "s3cret")
+    with pytest.raises(SpecAuthError):
+        q.claim("w1").load_spec()
+
+
+def test_done_tickets_are_stripped_and_swept(tmp_path):
+    """done/ holds tombstones: no pickled spec, and the retention
+    sweep reaps them once they age out — the serve root is bounded."""
+    q = StudyQueue(root=str(tmp_path))
+    q.submit(_spec(seed=0))
+    t = q.claim("w1")
+    q.complete(t, wall_s=0.1, engine="solo")
+    with open(t.path, encoding="utf-8") as f:
+        tomb = json.load(f)
+    assert "spec_b64" not in tomb
+    assert "spec_hmac" not in tomb
+    assert tomb["engine"] == "solo"
+    assert q.sweep(retain_s=3600) == 0  # fresh tombstone: retained
+    old = time.time() - 7200
+    os.utime(t.path, (old, old))
+    assert q.sweep(retain_s=0) == 0  # 0 disables the sweep entirely
+    assert q.sweep(retain_s=3600) == 1
+    assert q.stats()["done"] == 0
+
+
+def test_requeue_worker_reaps_completed_stale_claims(tmp_path):
+    """A crash between complete()'s write and its unlink leaves the
+    claimed copy behind the done tombstone; the janitor sweep reaps it
+    by id instead of serving the study twice."""
+    q = StudyQueue(root=str(tmp_path))
+    q.submit(_spec(seed=0))
+    t = q.claim("w1")
+    stale = t.path
+    with open(stale, encoding="utf-8") as f:
+        claimed_payload = f.read()
+    q.complete(t, wall_s=0.1, engine="solo")
+    # resurrect the claimed copy — the simulated crash artifact
+    with open(stale, "w", encoding="utf-8") as f:
+        f.write(claimed_payload)
+    assert q.requeue_worker("w1") == 0
+    assert q.depth() == 0
+    assert not os.path.exists(stale)
+    assert q.stats()["claimed"] == 0
 
 
 def test_queue_to_worker_end_to_end_with_multiplex(tmp_path):
