@@ -790,8 +790,74 @@ def bench_serve():
     }
 
 
+def bench_sched():
+    """Scheduler control-plane row: the lease-lapse → requeue data
+    path that bounds how long a preempted study stays invisible.
+
+    Queue-only (no device work — the row prices the scheduler, not the
+    studies): K claimed studies have their leases deterministically
+    aged past the TTL each round, and one ``Scheduler.tick`` must
+    reap and requeue all of them.  Headline: the per-round tick wall
+    (``sched_reschedule_p50/p99_ms``, the time-to-reschedule bound)
+    and ``sched_lost_studies`` — the conservation count over every
+    bounce, sentinel-watched at ZERO tolerance: a scheduler that loses
+    or double-books even one study fails the bench outright."""
+    import tempfile
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.sched import Scheduler
+    from pyabc_tpu.serve import StudyQueue, StudySpec
+
+    K, ROUNDS = 8, 20
+    root = tempfile.mkdtemp(prefix="bench_sched_")
+    queue = StudyQueue(root=root, lease_s=30.0, max_depth=4096,
+                       tenant_quota=4096)
+    for i in range(K):
+        queue.submit(StudySpec(
+            model=_serve_model,
+            prior=pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+            observed={"y": 0.4}, population_size=100, seed=i,
+            tenant="sched_bench", max_generations=SERVE_GENS))
+    # bounce budget far above ROUNDS: the row prices the requeue path,
+    # not the quarantine path (tests pin that separately)
+    sched = Scheduler(run_dir=None, queue=queue,
+                      max_bounces=10 * ROUNDS)
+    claim_dir = os.path.join(queue.root, "claimed")
+    walls_ms = []
+    for r in range(ROUNDS):
+        worker = f"w_preempt_{r}"
+        while queue.claim(worker) is not None:
+            pass
+        # age every lease past the TTL (the preemption signal) instead
+        # of sleeping through it
+        old = time.time() - 3600
+        wdir = os.path.join(claim_dir, worker)
+        for name in os.listdir(wdir):
+            if name.endswith(".json"):
+                os.utime(os.path.join(wdir, name), (old, old))
+        t0 = time.perf_counter()
+        rep = sched.tick()
+        walls_ms.append((time.perf_counter() - t0) * 1e3)
+        if len(rep["requeued"]) != K:
+            break  # conservation check below reports the loss
+    walls_ms.sort()
+    stats = queue.stats()
+    accounted = (stats["pending"] + stats["claimed"] + stats["done"]
+                 + stats["failed"])
+    return {
+        "sched_reschedule_p50_ms": round(
+            walls_ms[len(walls_ms) // 2], 3),
+        "sched_reschedule_p99_ms": round(
+            walls_ms[min(len(walls_ms) - 1,
+                         int(round(0.99 * (len(walls_ms) - 1))))], 3),
+        "sched_rounds": len(walls_ms),
+        "sched_studies": K,
+        "sched_lost_studies": K - accounted,
+    }
+
+
 SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
-               "kernel", "lanes", "serve", "posterior_gate",
+               "kernel", "lanes", "serve", "sched", "posterior_gate",
                "lotka_volterra", "sir", "petab_ode", "sharded_mesh1",
                "ab_vec_sharded", "sharded_cpu8", "podstar")
 
@@ -1061,6 +1127,8 @@ def _run_sub(name: str) -> dict:
         return bench_lanes()
     if name == "serve":
         return bench_serve()
+    if name == "sched":
+        return bench_sched()
     if name == "posterior_gate":
         # the 1e6 adaptive posterior-exactness gate (BASELINE.md
         # "Correctness at scale", now repeatable): perf work cannot
@@ -1178,7 +1246,7 @@ def main():
                if k.startswith(("primary_", "northstar_",
                                 "fused_northstar_", "seq_northstar_",
                                 "onedispatch_", "kernel_", "lanes_",
-                                "podstar_", "serve_",
+                                "podstar_", "serve_", "sched_",
                                 "posterior_gate_",
                                 "telemetry_", "resilience_",
                                 "checkpoint_", "store_", "lint_"))

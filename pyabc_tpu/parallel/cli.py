@@ -260,6 +260,29 @@ def _render_top(run_dir) -> str:
         if tenants:
             lines.append("  tenants: " + " ".join(
                 f"{t}={int(n)}" for t, n in tenants))
+    # the scheduler (sched/): fleet control-plane state from the same
+    # snapshots — worker liveness as the scheduler sees it, lease
+    # reaping activity and the autoscaler's replica target
+    sched_vals = {}
+    for s in snaps:
+        for k, v in (s.get("metrics") or {}).items():
+            if (k.startswith("sched_") and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                sched_vals.setdefault(k, []).append(float(v))
+    if sched_vals:
+        from ..telemetry.aggregate import _SCHED_GAUGES
+
+        def sc(key):
+            vals = sched_vals.get(key, [0.0])
+            return max(vals) if key in _SCHED_GAUGES else sum(vals)
+
+        lines.append(
+            f"sched: alive={int(sc('sched_workers_alive'))} "
+            f"dead={int(sc('sched_workers_dead'))} "
+            f"lapsed={int(sc('sched_leases_lapsed_total'))} "
+            f"requeues={int(sc('sched_requeues_total'))} "
+            f"quarantined={int(sc('sched_quarantines_total'))} "
+            f"desired={int(sc('sched_desired_replicas'))}")
     lines.extend(rows or ["  (no telemetry snapshots yet)"])
     # recent generations across the fleet, newest last
     tail = []

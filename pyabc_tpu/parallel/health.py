@@ -68,10 +68,18 @@ class Heartbeat:
 
     def __init__(self, directory: str, interval_s: float = 5.0,
                  process_index: Optional[int] = None,
-                 metrics_fn: Optional[callable] = None):
+                 metrics_fn: Optional[callable] = None,
+                 on_beat: Optional[callable] = None):
         self.directory = directory
         self.interval_s = interval_s
         self.process_index = process_index
+        #: zero-arg callable invoked after every successful beat — the
+        #: serve worker renews its queue claim leases here
+        #: (``StudyQueue.renew_leases``), so lease liveness rides the
+        #: same thread, cadence and failure mode as the heartbeat
+        #: itself; exceptions are swallowed (a lease-renewal hiccup
+        #: must never kill the liveness signal)
+        self.on_beat = on_beat
         #: zero-arg callable returning a flat scalar dict embedded in
         #: every heartbeat, so ``info`` shows per-host throughput, not
         #: just liveness; defaults to the telemetry summary
@@ -114,6 +122,11 @@ class Heartbeat:
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, self.path)  # atomic on POSIX
+        if self.on_beat is not None:
+            try:
+                self.on_beat()
+            except Exception:
+                pass  # renewal failure must not stop the heartbeat
 
     def start(self) -> "Heartbeat":
         def loop():

@@ -207,6 +207,23 @@ def journal_dir_for(db_path: str, in_memory: bool) -> Optional[str]:
     return os.path.join(base, suffix) if suffix else base
 
 
+def purge_for_db(db_path: str):
+    """Remove the spill-journal directory of a retired file-backed DB
+    (``<db>.journal``).  Serving-tier glue: a durable study DB
+    (``serve/worker.py``, ``PYABC_TPU_SERVE_DURABLE``) is deleted once
+    its summary is cached, and its journal — only useful for resuming
+    the now-finished run — must not outlive it on the serve mount.
+    No-op when journaling is off or redirected elsewhere by
+    ``PYABC_TPU_JOURNAL_DIR`` (a shared override directory may hold
+    other runs' segments)."""
+    if os.environ.get(JOURNAL_DIR_ENV, "").strip():
+        return
+    base = db_path + ".journal"
+    if os.path.isdir(base):
+        import shutil
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def pod_sibling_dirs(directory: str) -> list:
     """All per-host journal directories of the pod run that
     ``directory`` belongs to, host-major (``h000``, ``h001``, ...).

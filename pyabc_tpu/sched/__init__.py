@@ -1,0 +1,21 @@
+"""Elastic fleet scheduling: the control plane over the serving tier.
+
+Two pieces compose the ROADMAP's "preemptible-first production ops"
+item out of machinery the repo already has:
+
+- :mod:`pyabc_tpu.sched.scheduler` — the ``abc-sched`` reconciliation
+  loop: joins worker heartbeats (``parallel/health.py``) to claim
+  leases (``serve/queue.py``), requeues dead workers' tickets with
+  bounce accounting, quarantines poison tickets with a flight dump,
+  and publishes ``sched_*`` telemetry;
+- :mod:`pyabc_tpu.sched.autoscale` — hysteresis-filtered desired-
+  replica targeting from queue depth and aging pressure.
+
+All scheduler knobs are environment variables, documented with the
+lease and bounce contract in ``docs/scheduling.md``.
+"""
+
+from .autoscale import Autoscaler
+from .scheduler import Scheduler
+
+__all__ = ["Autoscaler", "Scheduler"]

@@ -29,6 +29,15 @@ Crash-safety semantics, precisely:
   requeueing it, and a double requeue converges because the pending
   destination is keyed by id.  Duplication is therefore at most
   transient, never silent.
+- every claim carries a **lease**: the claimed file's mtime, stamped
+  immediately before the claim rename (so the stamp travels with the
+  rename — a ticket is never claimed without a live lease) and renewed
+  by the worker's heartbeat thread (:meth:`~StudyQueue.renew_leases`).
+  A lease older than ``PYABC_TPU_SERVE_LEASE_S`` has *lapsed* and the
+  scheduler (``sched/scheduler.py``) may requeue it; lease age is
+  measured on the queue filesystem's own clock (:meth:`~StudyQueue
+  .fs_now`), so a live-but-slow study is never stolen by clock skew
+  and a dead worker's claims lapse deterministically.
 - ``done``/``failed`` tickets are tombstones: the pickled spec (the
   payload's bulk) is stripped on arrival, and
   :meth:`~StudyQueue.sweep` (called from the worker's idle loop)
@@ -70,7 +79,6 @@ import hmac
 import json
 import os
 import pickle
-import socket
 import tempfile
 import time
 import uuid
@@ -99,10 +107,20 @@ HMAC_KEY_ENV = "PYABC_TPU_SERVE_HMAC_KEY"
 #: done/failed tombstone retention in seconds (0 disables the sweep)
 RETAIN_S_ENV = "PYABC_TPU_SERVE_RETAIN_S"
 
+#: claim lease TTL: a claimed study whose lease stamp has not been
+#: renewed for this long is reappable by the scheduler (sched/)
+LEASE_S_ENV = "PYABC_TPU_SERVE_LEASE_S"
+
+#: poison-ticket budget: a study bounced back to pending this many
+#: times is quarantined into ``failed/`` instead of requeued again
+MAX_BOUNCES_ENV = "PYABC_TPU_SERVE_MAX_BOUNCES"
+
 _DEFAULT_MAX_DEPTH = 256
 _DEFAULT_TENANT_QUOTA = 32
 _DEFAULT_AGING_S = 30.0
 _DEFAULT_RETAIN_S = 3600.0
+_DEFAULT_LEASE_S = 60.0
+_DEFAULT_MAX_BOUNCES = 3
 
 
 class QueueFull(RuntimeError):
@@ -145,7 +163,22 @@ def serve_root(root: Optional[str] = None) -> str:
 
 
 def default_worker_id() -> str:
-    return f"{socket.gethostname()}_{os.getpid()}"
+    # host_id() (not the raw hostname) so a worker's claimed/<worker>
+    # directory and its hb_<host>_<pid>.json heartbeat key the SAME
+    # fleet identity — the scheduler (sched/scheduler.py) joins the two
+    # to decide which claims belong to a dead worker
+    from ..telemetry.aggregate import host_id
+    return f"{host_id()}_{os.getpid()}"
+
+
+def lease_s_default() -> float:
+    """The claim lease TTL: ``$PYABC_TPU_SERVE_LEASE_S`` or 60 s."""
+    return _env_float(LEASE_S_ENV, _DEFAULT_LEASE_S)
+
+
+def max_bounces_default() -> int:
+    """The poison-ticket budget: ``$PYABC_TPU_SERVE_MAX_BOUNCES`` or 3."""
+    return _env_int(MAX_BOUNCES_ENV, _DEFAULT_MAX_BOUNCES)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -175,6 +208,9 @@ class Ticket:
     submitted_unix: float
     requeues: int = 0
     path: Optional[str] = None
+    #: holder of the claim this ticket was listed from (claimed state
+    #: only — the claimed/<worker>/ directory name)
+    worker: Optional[str] = None
     _payload: Optional[dict] = field(default=None, repr=False)
 
     def load_spec(self) -> StudySpec:
@@ -219,7 +255,8 @@ class StudyQueue:
     def __init__(self, root: Optional[str] = None,
                  max_depth: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
-                 aging_s: Optional[float] = None):
+                 aging_s: Optional[float] = None,
+                 lease_s: Optional[float] = None):
         self.root = os.path.join(serve_root(root), "queue")
         self.max_depth = (_env_int(MAX_DEPTH_ENV, _DEFAULT_MAX_DEPTH)
                           if max_depth is None else int(max_depth))
@@ -228,6 +265,8 @@ class StudyQueue:
             if tenant_quota is None else int(tenant_quota))
         self.aging_s = (_env_float(AGING_S_ENV, _DEFAULT_AGING_S)
                         if aging_s is None else float(aging_s))
+        self.lease_s = (lease_s_default() if lease_s is None
+                        else float(lease_s))
         for state in ("pending", "claimed", "done", "failed"):
             os.makedirs(os.path.join(self.root, state), exist_ok=True)
 
@@ -247,6 +286,8 @@ class StudyQueue:
                     continue
                 t = _ticket_from_file(os.path.join(dirpath, name))
                 if t is not None:
+                    if state == "claimed":
+                        t.worker = os.path.basename(dirpath)
                     out.append(t)
         return out
 
@@ -255,6 +296,69 @@ class StudyQueue:
 
     def claimed(self) -> List[Ticket]:
         return self._list("claimed")
+
+    def fs_now(self) -> float:
+        """Reference "now" from the SAME filesystem the queue lives on
+        (touch a probe file and stat its mtime, the ``parallel/health``
+        clock trick): lease age is then mtime-vs-mtime on one clock —
+        worker↔scheduler wall-clock skew can neither steal a live lease
+        nor keep a dead one alive.  Falls back to local time on a
+        read-only mount."""
+        probe = os.path.join(self.root, ".now_probe")
+        try:
+            if os.path.exists(probe):
+                os.utime(probe, None)
+            else:
+                with open(probe, "w"):
+                    pass
+            return os.stat(probe).st_mtime
+        except OSError:
+            return time.time()
+
+    # ---- leases ----------------------------------------------------------
+
+    def lease_age_s(self, ticket: Ticket,
+                    now: Optional[float] = None) -> float:
+        """Seconds since this claimed ticket's lease stamp (its file
+        mtime) was last renewed; ``inf`` if the file vanished (claim
+        settled concurrently — the caller should re-list)."""
+        if not ticket.path:
+            return float("inf")
+        try:
+            mtime = os.stat(ticket.path).st_mtime
+        except OSError:
+            return float("inf")
+        return (self.fs_now() if now is None else now) - mtime
+
+    def renew_leases(self, worker_id: str) -> int:
+        """Re-stamp every lease this worker holds (utime on its claimed
+        files).  Called from the worker's heartbeat thread
+        (``parallel/health.py``) so lease liveness and heartbeat
+        liveness are the same signal: a live-but-slow study keeps its
+        lease for as long as the worker keeps beating, and a dead
+        worker's leases stop advancing the moment its heartbeat does."""
+        wdir = os.path.join(self._dir("claimed"), worker_id)
+        if not os.path.isdir(wdir):
+            return 0
+        n = 0
+        for name in os.listdir(wdir):
+            if not name.endswith(".json"):
+                continue
+            try:
+                os.utime(os.path.join(wdir, name), None)
+                n += 1
+            except OSError:
+                continue  # settled concurrently by the main thread
+        return n
+
+    def lapsed(self, lease_s: Optional[float] = None) -> List[Ticket]:
+        """Claimed tickets whose lease is older than ``lease_s``
+        (default: this queue's TTL) — the scheduler's reap candidates.
+        Measured on the queue filesystem's clock (:meth:`fs_now`)."""
+        lease_s = self.lease_s if lease_s is None else float(lease_s)
+        now = self.fs_now()
+        return [t for t in self.claimed()
+                if self.lease_age_s(t, now=now) > lease_s]
 
     def depth(self) -> int:
         return sum(1 for n in os.listdir(self._dir("pending"))
@@ -275,6 +379,7 @@ class StudyQueue:
             "max_depth": self.max_depth,
             "tenant_quota": self.tenant_quota,
             "aging_s": self.aging_s,
+            "lease_s": self.lease_s,
             "pending_by_tenant": per_tenant,
         }
 
@@ -338,7 +443,21 @@ class StudyQueue:
 
     def claim(self, worker_id: Optional[str] = None) -> Optional[Ticket]:
         """Claim the highest aged-priority pending study (atomic
-        rename; a lost race just moves on to the next candidate)."""
+        rename; a lost race just moves on to the next candidate).
+
+        The lease stamp travels WITH the rename: the pending file's
+        mtime is refreshed *first*, then the rename moves it — so there
+        is no instant at which a claimed ticket exists without a live
+        lease.  A worker dying between the two steps leaves a pending
+        file with a fresh mtime (harmless); dying right after the
+        rename leaves a claimed file whose lease is already counting
+        down toward the scheduler's reap — the claim/crash invisibility
+        window is zero, no janitor sweep needed.
+
+        A pending file whose id already reached ``done``/``failed`` is
+        a requeued duplicate of a settled study (a partitioned worker
+        completed it after the scheduler bounced it): it is reaped
+        here, never served twice."""
         worker_id = worker_id or default_worker_id()
         wdir = os.path.join(self._dir("claimed"), worker_id)
         os.makedirs(wdir, exist_ok=True)
@@ -348,12 +467,22 @@ class StudyQueue:
             key=lambda t: (-t.effective_priority(self.aging_s, now),
                            t.submitted_unix, t.id))
         for t in candidates:
+            if any(os.path.exists(os.path.join(
+                    self._dir(state), f"{t.id}.json"))
+                    for state in ("done", "failed")):
+                try:
+                    os.unlink(t.path)
+                except OSError:
+                    pass
+                continue
             dest = os.path.join(wdir, os.path.basename(t.path))
             try:
+                os.utime(t.path, None)  # lease stamp, THEN the rename
                 os.rename(t.path, dest)
             except OSError:
                 continue  # another worker won this one
             t.path = dest
+            t.worker = worker_id
             return t
         return None
 
@@ -395,10 +524,15 @@ class StudyQueue:
             "error": str(error)[:2000],
         })
 
-    def requeue(self, ticket: Ticket) -> bool:
+    def requeue(self, ticket: Ticket, worker: Optional[str] = None,
+                error: Optional[str] = None) -> bool:
         """Return a claimed study to pending (SIGTERM drain, crashed
-        attempt) with its original submission time — its accumulated
-        age, and therefore its aged priority, survives the bounce.
+        attempt, lapsed lease) with its original submission time — its
+        accumulated age, and therefore its aged priority, survives the
+        bounce.  Each bounce leaves a breadcrumb (``last_worker``,
+        ``last_error``, an appended ``bounce_history`` entry) so a
+        ticket that ends up quarantined is diagnosable from its
+        tombstone alone.
 
         If the ticket's id already reached ``done``/``failed`` the
         claimed file is a stale copy from a crash between
@@ -416,8 +550,17 @@ class StudyQueue:
                     except OSError:
                         pass
                 return False
+        worker = worker if worker is not None else ticket.worker
         payload = dict(ticket._payload or {})
         payload["requeues"] = int(payload.get("requeues", 0)) + 1
+        payload["last_worker"] = worker
+        payload["last_error"] = (None if error is None
+                                 else str(error)[:2000])
+        history = list(payload.get("bounce_history", []))
+        history.append({"worker": worker,
+                        "error": payload["last_error"],
+                        "requeued_unix": time.time()})
+        payload["bounce_history"] = history[-32:]  # bounded breadcrumb
         dest = os.path.join(self._dir("pending"), f"{ticket.id}.json")
         self._write_atomic(dest, payload)
         if ticket.path and os.path.exists(ticket.path):
@@ -433,9 +576,10 @@ class StudyQueue:
             "claimed studies returned to pending (drain/crash)").inc()
         return True
 
-    def requeue_worker(self, worker_id: str) -> int:
+    def requeue_worker(self, worker_id: str,
+                       error: Optional[str] = None) -> int:
         """Requeue EVERY study a worker still holds — the drain path's
-        bulk form, also the janitor's recovery for a crashed worker.
+        bulk form, also the scheduler's recovery for a dead worker.
         Stale claims whose id already completed are reaped instead of
         requeued (see :meth:`requeue`); the count excludes them."""
         wdir = os.path.join(self._dir("claimed"), worker_id)
@@ -446,9 +590,33 @@ class StudyQueue:
             if not name.endswith(".json"):
                 continue
             t = _ticket_from_file(os.path.join(wdir, name))
-            if t is not None and self.requeue(t):
+            if t is None:
+                continue
+            t.worker = worker_id
+            if self.requeue(t, worker=worker_id, error=error):
                 n += 1
         return n
+
+    def quarantine(self, ticket: Ticket, error: str,
+                   flight_path: Optional[str] = None):
+        """Retire a poison ticket into ``failed/`` with its full bounce
+        history and (when the scheduler captured one) the path of the
+        flight-recorder dump — the post-mortem surface for a study that
+        kept killing workers.  The tombstone keeps ``last_worker`` /
+        ``bounce_history`` from :meth:`requeue`, so *which* workers it
+        took down and with what errors is readable from one file."""
+        extra = {
+            "failed_unix": time.time(),
+            "error": str(error)[:2000],
+            "quarantined": True,
+        }
+        if flight_path:
+            extra["flight_path"] = flight_path
+        self._move(ticket, "failed", extra)
+        REGISTRY.counter(
+            "serve_queue_quarantined_total",
+            "poison tickets retired after exhausting their bounce "
+            "budget").inc()
 
     # ---- housekeeping ----------------------------------------------------
 

@@ -65,7 +65,18 @@ _MAX_ENGINES = 4
 #: compiled study-axis programs held per worker (LRU beyond this)
 _MAX_BATCH_PROGRAMS = 8
 
+#: opt-in durable solo studies: each miss runs against a file-backed
+#: DB under <serve root>/studies/ so an interrupted study RESUMES from
+#: its journaled generation (ABCSMC.load → recover_lazy) instead of
+#: restarting at generation 0 when the scheduler requeues its ticket
+DURABLE_ENV = "PYABC_TPU_SERVE_DURABLE"
+
 _TENANT_SAFE = re.compile(r"[^A-Za-z0-9_]")
+
+
+def durable_default() -> bool:
+    return os.environ.get(DURABLE_ENV, "0").lower() in (
+        "1", "true", "yes", "on")
 
 
 def _tenant_counter(tenant: str):
@@ -82,13 +93,20 @@ class ServeWorker:
                  worker_id: Optional[str] = None,
                  cache: Optional[StudyCache] = None,
                  max_engines: int = _MAX_ENGINES,
-                 run_mode: str = "onedispatch"):
+                 run_mode: str = "onedispatch",
+                 durable: Optional[bool] = None):
         self.root = serve_root(root)
         self.worker_id = worker_id or default_worker_id()
         self.cache = cache if cache is not None else StudyCache(
             root=os.path.join(self.root, "cache"))
         self.max_engines = max(int(max_engines), 1)
         self.run_mode = run_mode
+        #: durable solo studies (``PYABC_TPU_SERVE_DURABLE``): misses
+        #: run on a file-backed DB under <root>/studies/ and an
+        #: interrupted study resumes from its journaled generation
+        self.durable = (durable_default() if durable is None
+                        else bool(durable))
+        self.studies_dir = os.path.join(self.root, "studies")
         self._engines: "OrderedDict[str, object]" = OrderedDict()
         self._batch_programs: "OrderedDict[tuple, object]" = OrderedDict()
         self._draining = threading.Event()
@@ -114,24 +132,9 @@ class ServeWorker:
 
     # ---- engine pool -----------------------------------------------------
 
-    def _engine_for(self, spec: StudySpec):
-        """Warm :class:`ABCSMC` for this spec's problem, renewed for
-        this study.  A pool hit re-arms the SAME kernel and ladder —
-        zero new compiles for eligible repeats."""
+    def _build_engine(self, spec: StudySpec):
         import pyabc_tpu as pt
-        pk = problem_key(spec)
-        abc = self._engines.get(pk)
-        if abc is not None:
-            self._engines.move_to_end(pk)
-            REGISTRY.counter(
-                "serve_engine_hits_total",
-                "studies served on an already-warm engine").inc()
-            abc.renew("sqlite://", dict(spec.observed), seed=spec.seed)
-            return abc
-        REGISTRY.counter(
-            "serve_engine_builds_total",
-            "warm engines built (first study of a problem)").inc()
-        abc = pt.ABCSMC(
+        return pt.ABCSMC(
             pt.SimpleModel(spec.model),
             spec.prior,
             pt.PNormDistance(p=spec.distance_p),
@@ -142,7 +145,25 @@ class ServeWorker:
             # the bench one-dispatch rows
             fuse_generations=4,
             seed=int(spec.seed))
-        abc.new("sqlite://", dict(spec.observed))
+
+    def _engine_for(self, spec: StudySpec, db: str = "sqlite://"):
+        """Warm :class:`ABCSMC` for this spec's problem, renewed for
+        this study.  A pool hit re-arms the SAME kernel and ladder —
+        zero new compiles for eligible repeats."""
+        pk = problem_key(spec)
+        abc = self._engines.get(pk)
+        if abc is not None:
+            self._engines.move_to_end(pk)
+            REGISTRY.counter(
+                "serve_engine_hits_total",
+                "studies served on an already-warm engine").inc()
+            abc.renew(db, dict(spec.observed), seed=spec.seed)
+            return abc
+        REGISTRY.counter(
+            "serve_engine_builds_total",
+            "warm engines built (first study of a problem)").inc()
+        abc = self._build_engine(spec)
+        abc.new(db, dict(spec.observed))
         self._engines[pk] = abc
         while len(self._engines) > self.max_engines:
             self._engines.popitem(last=False)
@@ -225,12 +246,9 @@ class ServeWorker:
                 "study-axis programs dropped by the pool LRU").inc()
         return batch.run()
 
-    def _solo_summary(self, spec: StudySpec, digest: str) -> dict:
-        abc = self._engine_for(spec)
-        history = abc.run(
-            minimum_epsilon=float(spec.minimum_epsilon),
-            max_nr_populations=int(spec.max_generations),
-            min_acceptance_rate=float(spec.min_acceptance_rate))
+    @staticmethod
+    def _history_summary(spec: StudySpec, digest: str, abc,
+                         history) -> dict:
         df, w = history.get_distribution()
         pops = history.get_all_populations()
         names = list(df.columns)
@@ -250,6 +268,71 @@ class ServeWorker:
             "posterior_mean": mean,
             "posterior_std": std,
         }
+
+    def _solo_summary(self, spec: StudySpec, digest: str) -> dict:
+        if self.durable:
+            return self._durable_solo_summary(spec, digest)
+        abc = self._engine_for(spec)
+        history = abc.run(
+            minimum_epsilon=float(spec.minimum_epsilon),
+            max_nr_populations=int(spec.max_generations),
+            min_acceptance_rate=float(spec.min_acceptance_rate))
+        return self._history_summary(spec, digest, abc, history)
+
+    def _durable_solo_summary(self, spec: StudySpec,
+                              digest: str) -> dict:
+        """Durable solo path (``PYABC_TPU_SERVE_DURABLE``): the study
+        runs on a file-backed DB keyed by its digest, so a worker dying
+        mid-study leaves generations behind.  When the scheduler
+        bounces the ticket to another worker, that worker finds the DB,
+        replays the spill journal (:meth:`ABCSMC.load` →
+        ``recover_lazy`` — the checkpoint-splice contract from the
+        resilience tier) and continues at ``max_t + 1`` instead of
+        generation 0.  The DB and its journal are deleted once the
+        summary is cached — results live in the cache, ``studies/``
+        holds only in-flight state."""
+        os.makedirs(self.studies_dir, exist_ok=True)
+        db_path = os.path.join(self.studies_dir, f"{digest}.solo.db")
+        db_url = "sqlite:///" + db_path
+        resumed_from = 0
+        abc = None
+        if os.path.exists(db_path):
+            try:
+                # a fresh (cold) engine: load() rebinds from the DB's
+                # own observed stats, which must win over the pool's
+                abc = self._build_engine(spec)
+                history = abc.load(db_url)
+                resumed_from = int(history.max_t) + 1
+            except Exception:
+                abc, resumed_from = None, 0  # unreadable: start over
+            else:
+                REGISTRY.counter(
+                    "serve_study_resumes_total",
+                    "interrupted durable studies resumed from their "
+                    "journaled generation").inc()
+        if abc is None:
+            abc = self._engine_for(spec, db=db_url)
+            history = abc.history
+        remaining = int(spec.max_generations) - resumed_from
+        if remaining > 0:
+            history = abc.run(
+                minimum_epsilon=float(spec.minimum_epsilon),
+                max_nr_populations=remaining,
+                min_acceptance_rate=float(spec.min_acceptance_rate))
+        summary = self._history_summary(spec, digest, abc, history)
+        if resumed_from:
+            summary["resumed_from_gen"] = resumed_from
+        try:
+            history.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(db_path)
+        except OSError:
+            pass
+        from ..resilience.journal import purge_for_db
+        purge_for_db(db_path)
+        return summary
 
     def _batch_summary(self, spec: StudySpec, res: dict,
                        digest: str) -> dict:
@@ -380,8 +463,20 @@ class ServeWorker:
         # ride the fleet telemetry mount when a run dir is advertised:
         # serve_* counters land in snapshots for abc-top / /api/serve /
         # the Prometheus exporter
+        from ..parallel import health
         from ..telemetry import aggregate
         publisher = aggregate.publisher_from_env()
+        # heartbeat into the run dir and renew claim leases on the same
+        # thread: the scheduler joins hb_<host>_<pid> to this worker's
+        # claimed/ directory, and a worker that stops beating stops
+        # renewing — one liveness signal, two consumers
+        hb = None
+        rd = health.run_dir()
+        if rd is not None:
+            hb = health.Heartbeat(
+                rd, on_beat=lambda: queue.renew_leases(self.worker_id)
+            ).start()
+        clean_exit = False
         try:
             while not self.draining:
                 if (max_studies is not None
@@ -428,7 +523,12 @@ class ServeWorker:
                 self._snapshot_gauges(queue)
                 if publisher is not None:
                     publisher.publish()
+            clean_exit = True
         finally:
+            if hb is not None:
+                # clean exit deregisters; an exception leaves the last
+                # heartbeat so the fleet sees STALE, not silently absent
+                hb.stop(remove=clean_exit)
             requeued = queue.requeue_worker(self.worker_id)
             if requeued:
                 REGISTRY.gauge(
@@ -455,9 +555,14 @@ def main():  # pragma: no cover - thin CLI shell over ServeWorker
                   help="Exit after serving this many studies.")
     @click.option("--once", is_flag=True,
                   help="Drain the current queue once and exit.")
-    def cli(serve_dir, worker_id, poll_s, max_studies, once):
+    @click.option("--durable", is_flag=True, default=None,
+                  help="Durable solo studies: file-backed DBs under "
+                       "<serve root>/studies/ so interrupted studies "
+                       "resume (default $PYABC_TPU_SERVE_DURABLE).")
+    def cli(serve_dir, worker_id, poll_s, max_studies, once, durable):
         """Persistent warm study server on this accelerator."""
-        worker = ServeWorker(root=serve_dir, worker_id=worker_id)
+        worker = ServeWorker(root=serve_dir, worker_id=worker_id,
+                             durable=durable)
         worker.install_signal_handlers()
         queue = StudyQueue(root=worker.root)
         n = worker.run_forever(queue, poll_s=poll_s,

@@ -367,6 +367,7 @@ def fleet_rollup(run_dir: str) -> Dict:
             "run_progress": merge_progress(
                 [s.get("run_progress") for s in snaps]),
             "serve": _serve_rollup(rollup),
+            "sched": _sched_rollup(rollup),
             "metrics": rollup}
 
 
@@ -397,6 +398,29 @@ def _serve_rollup(metrics_rollup: Dict) -> Dict:
     return out
 
 
+#: sched_* keys that are point-in-time gauges — fleet view reads their
+#: max; everything else under sched_* is a counter and rolls up as sum
+_SCHED_GAUGES = frozenset({
+    "sched_workers_alive", "sched_workers_dead",
+    "sched_desired_replicas", "sched_queue_pending",
+    "sched_queue_claimed", "sched_oldest_pending_s",
+    "sched_last_tick_ms",
+})
+
+
+def _sched_rollup(metrics_rollup: Dict) -> Dict:
+    """The scheduler's slice of the fleet rollup: every ``sched_*``
+    metric collapsed to one number (counters summed across scheduler
+    replicas, gauges maxed) — the control-plane mirror of
+    :func:`_serve_rollup`."""
+    out: Dict = {}
+    for key, aggs in metrics_rollup.items():
+        if not key.startswith("sched_"):
+            continue
+        out[key] = aggs["max" if key in _SCHED_GAUGES else "sum"]
+    return out
+
+
 def render_prometheus(run_dir: str) -> str:
     """The fleet rollup as Prometheus text: each metric exported as
     ``pyabc_tpu_fleet_<key>{agg="sum|max|p50|p99"}`` samples plus a
@@ -424,6 +448,11 @@ def render_prometheus(run_dir: str) -> str:
     for key, val in sorted((roll.get("serve") or {}).items()):
         if key == "tenants":
             continue
+        lines.append(f"pyabc_tpu_{key} {val}")
+    # the scheduler's scrape surface: flat ``pyabc_tpu_sched_*`` lines
+    # (workers alive/dead, leases lapsed, requeues, quarantines,
+    # desired replicas) from the same snapshot rollup
+    for key, val in sorted((roll.get("sched") or {}).items()):
         lines.append(f"pyabc_tpu_{key} {val}")
     for key, aggs in roll["metrics"].items():
         for agg in ("sum", "max", "p50", "p99"):

@@ -119,6 +119,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(self._fleet_state())
         if parts == ["serve"]:
             return self._json(self._serve_state())
+        if parts == ["sched"]:
+            return self._json(self._sched_state())
         if parts == ["runs"]:
             h = History(self.db_path, abc_id=1)
             runs = h.all_runs()
@@ -250,6 +252,29 @@ class _Handler(BaseHTTPRequestHandler):
         if os.path.isdir(os.path.join(serve_dir, "queue")):
             from ..serve.queue import StudyQueue
             out["queue"] = StudyQueue(root=serve_dir).stats()
+        return out
+
+    def _sched_state(self) -> dict:
+        """Live scheduler view (needs --run-dir): the ``sched_*``
+        rollup (workers alive/dead, leases lapsed, requeues,
+        quarantines, desired replicas) from the scheduler snapshots
+        plus the queue's current lease state — how many claims exist
+        and how many have already lapsed past the TTL."""
+        if not self.run_dir:
+            return {"enabled": False}
+        import os
+
+        from ..telemetry import aggregate
+
+        roll = aggregate.fleet_rollup(self.run_dir)
+        out = {"enabled": True, "sched": roll.get("sched") or {}}
+        serve_dir = os.path.join(self.run_dir, "serve")
+        if os.path.isdir(os.path.join(serve_dir, "queue")):
+            from ..serve.queue import StudyQueue
+            q = StudyQueue(root=serve_dir)
+            out["queue"] = q.stats()
+            out["leases"] = {"lease_s": q.lease_s,
+                             "lapsed": len(q.lapsed())}
         return out
 
     def _index(self):

@@ -1,0 +1,381 @@
+"""Tier-1 gate for the elastic fleet scheduler (``pyabc_tpu/sched/``).
+
+Pins the contracts docs/scheduling.md advertises:
+
+- the lease mechanics: the stamp travels WITH the claim rename (zero
+  invisibility window), the worker's heartbeat thread renews it, and a
+  lease that stops being renewed lapses deterministically;
+- scheduler reconciliation: a live (beating) worker's claims are never
+  stolen however slow its study is; a heartbeat-dead worker's claims
+  are reaped immediately (no lease wait) with diagnosable bounce
+  breadcrumbs; a poison ticket is quarantined within its bounce budget
+  with the flight dump attached;
+- resume-not-restart: a requeued durable study continues from its
+  journaled generation — the generation counter carries on and the
+  posterior still gates — instead of restarting at generation 0;
+- double-completion defense: a settled study's requeued duplicate is
+  reaped at claim time, never served twice;
+- autoscale hysteresis: replica targets move only after sustained
+  pressure (``up_ticks``/``down_ticks``), with aging pressure and
+  min/max clamps;
+- observability: ``sched_*`` metrics ride the normal snapshot into
+  ``fleet_rollup`` and the Prometheus exporter.
+
+The deterministic fast subset of the ``--sched`` chaos suite
+(``tools/chaos_soak.py``) runs here; the full suite (subprocess
+kill -9 + journal corruption) is slow-marked.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     os.pardir))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import pyabc_tpu as pt  # noqa: E402
+from pyabc_tpu.sched import Autoscaler, Scheduler  # noqa: E402
+from pyabc_tpu.serve import (ServeWorker, StudyQueue,  # noqa: E402
+                             StudySpec, study_digest)
+
+
+def _model(key, theta):
+    """Module-level (pickled through the queue, like a real tenant's
+    importable model)."""
+    import jax
+    noise = 0.1 * jax.random.normal(key, (theta.shape[0], 1))
+    return {"y": theta[:, :1] + noise}
+
+
+def _spec(pop=100, seed=0, tenant="default", y=0.4, **kw):
+    return StudySpec(
+        model=_model,
+        prior=pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+        observed={"y": float(y)}, population_size=pop,
+        seed=seed, tenant=tenant, **kw)
+
+
+def _rewind(path, by_s=3600.0):
+    old = time.time() - by_s
+    os.utime(path, (old, old))
+
+
+def _clean_env(monkeypatch):
+    for var in ("PYABC_TPU_RUN_DIR", "PYABC_TPU_SERVE_DIR",
+                "PYABC_TPU_SERVE_LEASE_S",
+                "PYABC_TPU_SERVE_MAX_BOUNCES"):
+        monkeypatch.delenv(var, raising=False)
+
+
+# ---------------------------------------------------------------------------
+# lease mechanics
+# ---------------------------------------------------------------------------
+
+def test_lease_stamp_travels_with_claim(tmp_path, monkeypatch):
+    """The pending file's mtime is refreshed immediately before the
+    claim rename, so a stale pending ticket can never surface as an
+    already-lapsed claim (the claim/crash invisibility hole)."""
+    _clean_env(monkeypatch)
+    q = StudyQueue(root=str(tmp_path), lease_s=60.0)
+    t = q.submit(_spec(seed=1))
+    _rewind(t.path)  # the ticket waited in pending for an hour
+    got = q.claim("w1")
+    assert got is not None and got.id == t.id
+    assert q.lease_age_s(got) < 5.0, (
+        "claim must re-stamp the lease: a pending-age mtime leaking "
+        "into claimed/ would let the scheduler steal a fresh claim")
+    assert q.lapsed() == []
+
+
+def test_renew_and_lapse(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    q = StudyQueue(root=str(tmp_path), lease_s=60.0)
+    q.submit(_spec(seed=2))
+    got = q.claim("w1")
+    _rewind(got.path)
+    assert [t.id for t in q.lapsed()] == [got.id]
+    # the heartbeat hook's renewal brings it back
+    assert q.renew_leases("w1") == 1
+    assert q.lapsed() == []
+    assert q.lease_age_s(got) < 5.0
+
+
+def test_heartbeat_on_beat_renews(tmp_path, monkeypatch):
+    """The worker's heartbeat thread is the lease-renewal thread: one
+    liveness signal, two consumers."""
+    from pyabc_tpu.parallel.health import Heartbeat
+    _clean_env(monkeypatch)
+    q = StudyQueue(root=str(tmp_path), lease_s=60.0)
+    q.submit(_spec(seed=3))
+    got = q.claim("w1")
+    _rewind(got.path)
+    hb = Heartbeat(str(tmp_path / "run"),
+                   on_beat=lambda: q.renew_leases("w1"))
+    hb.beat()
+    assert q.lapsed() == []
+    hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# scheduler reconciliation
+# ---------------------------------------------------------------------------
+
+def test_live_worker_never_stolen(tmp_path, monkeypatch):
+    """A worker with a LIVE heartbeat keeps its claims even when the
+    lease looks lapsed from the scheduler's side (e.g. an fs-cache
+    hiccup delayed the renewal stamp): liveness wins."""
+    _clean_env(monkeypatch)
+    rd = str(tmp_path / "run")
+    os.makedirs(rd)
+    q = StudyQueue(root=str(tmp_path / "serve"), lease_s=60.0)
+    q.submit(_spec(seed=4))
+    got = q.claim("h1_42")
+    _rewind(got.path)  # lease LOOKS lapsed...
+    with open(os.path.join(rd, "hb_h1_42.json"), "w") as f:
+        json.dump({"host": "h1", "pid": 42, "ts": time.time()}, f)
+    rep = Scheduler(run_dir=rd, queue=q).tick()  # ...but hb is fresh
+    assert rep["alive"] == 1 and rep["requeued"] == []
+    assert q.stats()["claimed"] == 1
+
+
+def test_dead_worker_fast_reap_with_breadcrumbs(tmp_path, monkeypatch):
+    """A heartbeat-dead worker's claims are reaped on the next tick —
+    no lease-TTL wait — and the requeued ticket carries the
+    diagnosable bounce breadcrumbs."""
+    _clean_env(monkeypatch)
+    rd = str(tmp_path / "run")
+    os.makedirs(rd)
+    q = StudyQueue(root=str(tmp_path / "serve"), lease_s=3600.0)
+    q.submit(_spec(seed=5))
+    got = q.claim("h2_77")  # fresh lease, dead worker
+    hb = os.path.join(rd, "hb_h2_77.json")
+    with open(hb, "w") as f:
+        json.dump({"host": "h2", "pid": 77,
+                   "ts": time.time() - 900}, f)
+    _rewind(hb, by_s=900.0)
+    rep = Scheduler(run_dir=rd, queue=q).tick()
+    assert rep["dead"] == 1 and rep["requeued"] == [got.id]
+    pend = q.pending()
+    assert len(pend) == 1 and pend[0].requeues == 1
+    assert pend[0]._payload["last_worker"] == "h2_77"
+    assert "dead" in pend[0]._payload["last_error"]
+    hist = pend[0]._payload["bounce_history"]
+    assert len(hist) == 1 and hist[0]["worker"] == "h2_77"
+
+
+def test_poison_quarantine_within_budget(tmp_path, monkeypatch):
+    """A ticket that keeps lapsing is quarantined within MAX_BOUNCES
+    bounces, into a tombstone diagnosable from one file (bounce
+    history + flight dump), and is never claimable again."""
+    _clean_env(monkeypatch)
+    q = StudyQueue(root=str(tmp_path), lease_s=60.0)
+    t = q.submit(_spec(seed=6))
+    sched = Scheduler(run_dir=None, queue=q, max_bounces=3)
+    bounces = 0
+    for i in range(10):
+        got = q.claim(f"w{i}")
+        if got is None:
+            break
+        _rewind(got.path)
+        rep = sched.tick()
+        bounces += 1
+        if rep["quarantined"]:
+            break
+    assert rep["quarantined"] == [t.id]
+    assert bounces <= 3, f"quarantine took {bounces} > MAX_BOUNCES"
+    with open(os.path.join(q.root, "failed", f"{t.id}.json")) as f:
+        tomb = json.load(f)
+    assert tomb["quarantined"] is True
+    assert len(tomb["bounce_history"]) == bounces - 1
+    assert tomb.get("flight_path") and os.path.exists(
+        tomb["flight_path"])
+    assert "spec_b64" not in tomb  # tombstones stay spec-stripped
+    assert q.claim("w_next") is None
+
+
+def test_claim_reaps_settled_duplicate(tmp_path, monkeypatch):
+    """A pending duplicate of an already-settled study (partitioned
+    worker completed it after the scheduler bounced it) is reaped at
+    claim time — never served twice."""
+    _clean_env(monkeypatch)
+    q = StudyQueue(root=str(tmp_path), lease_s=60.0)
+    t = q.submit(_spec(seed=7))
+    stale = q.claim("w_partitioned")
+    assert Scheduler(run_dir=None, queue=q).queue is q
+    _rewind(stale.path)
+    Scheduler(run_dir=None, queue=q, max_bounces=99).tick()  # bounce
+    assert q.stats()["pending"] == 1
+    # the partition heals; the old worker completes its stale copy
+    q.complete(stale, wall_s=0.1, engine="solo")
+    assert q.claim("w_second") is None, "double-serve of a settled id"
+    stats = q.stats()
+    assert stats == {**stats, "pending": 0, "done": 1}
+
+
+def test_scheduler_run_forever_max_ticks(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    q = StudyQueue(root=str(tmp_path), lease_s=60.0)
+    sched = Scheduler(run_dir=None, queue=q)
+    seen = []
+    n = sched.run_forever(interval_s=0.01, max_ticks=2,
+                          on_tick=seen.append)
+    assert n == 2 and len(seen) == 2
+    assert all("desired_replicas" in rep for rep in seen)
+
+
+# ---------------------------------------------------------------------------
+# resume-not-restart (the durable contract end to end)
+# ---------------------------------------------------------------------------
+
+def test_dead_worker_requeue_resumes_not_restarts(tmp_path, monkeypatch):
+    """The acceptance path: a durable study interrupted mid-run is
+    requeued by the scheduler and RESUMES from its persisted
+    generation on the rescue worker — the generation counter
+    continues, and the posterior still gates."""
+    _clean_env(monkeypatch)
+    monkeypatch.setenv("PYABC_TPU_SERVE_MULTIPLEX", "1")  # solo-only
+    root = str(tmp_path / "serve")
+    q = StudyQueue(root=root, lease_s=60.0)
+    gens_total = 4
+    spec = _spec(pop=128, seed=8, max_generations=gens_total)
+    t = q.submit(spec)
+    # a first worker claims it and dies mid-study: simulate by running
+    # the study's first 2 generations onto the durable DB through the
+    # exact engine the worker would build, then abandoning the claim
+    dead = q.claim("w_dead")
+    assert dead is not None
+    worker = ServeWorker(root=root, worker_id="w_rescue",
+                         run_mode="classic", durable=True)
+    os.makedirs(worker.studies_dir, exist_ok=True)
+    digest = study_digest(spec)
+    db_path = os.path.join(worker.studies_dir, f"{digest}.solo.db")
+    abc = worker._build_engine(spec)
+    abc.new("sqlite:///" + db_path, dict(spec.observed))
+    partial = abc.run(max_nr_populations=2)
+    done_gens = int(partial.max_t) + 1
+    assert done_gens == 2
+    partial.close()
+    # the worker is dead: its lease lapses and the scheduler bounces
+    _rewind(dead.path)
+    rep = Scheduler(run_dir=None, queue=q, max_bounces=5).tick()
+    assert rep["requeued"] == [t.id]
+    # the rescue worker claims the bounced ticket and must RESUME
+    served = worker.run_forever(q, once=True)
+    assert served == 1
+    summary = worker.cache.get(f"{digest}.solo")
+    assert summary is not None
+    assert summary["resumed_from_gen"] == done_gens, (
+        f"restarted instead of resumed: {summary}")
+    assert summary["gens"] >= gens_total, (
+        "the generation counter must CONTINUE across the bounce")
+    # posterior gate: observed 0.4 under mu + noise, uniform prior
+    assert abs(summary["posterior_mean"]["mu"] - 0.4) < 0.3
+    stats = q.stats()
+    assert stats["done"] == 1 and stats["pending"] == 0, (
+        f"lost or duplicated study: {stats}")
+    assert not os.path.exists(db_path), (
+        "completed durable study must clean up its DB")
+
+
+# ---------------------------------------------------------------------------
+# autoscale hysteresis (pure units)
+# ---------------------------------------------------------------------------
+
+def test_autoscale_raw_target_and_clamps():
+    a = Autoscaler(min_replicas=2, max_replicas=6,
+                   studies_per_worker=4, aging_pressure_s=120.0)
+    assert a.target(0, 0, 0.0) == 2          # min clamp
+    assert a.target(8, 0, 0.0) == 2          # ceil(8/4)
+    assert a.target(9, 0, 0.0) == 3          # ceil(9/4)
+    assert a.target(8, 4, 0.0) == 3          # claimed counts as load
+    assert a.target(8, 0, 300.0) == 3        # aging pressure adds one
+    assert a.target(999, 0, 0.0) == 6        # max clamp
+
+
+def test_autoscale_hysteresis_both_directions():
+    a = Autoscaler(min_replicas=1, max_replicas=16,
+                   studies_per_worker=1, up_ticks=2, down_ticks=3)
+    assert a.observe(4, 0, 0.0) == 4         # first observation seeds
+    assert a.observe(8, 0, 0.0) == 4         # up-streak 1: hold
+    assert a.observe(8, 0, 0.0) == 8         # up-streak 2: move up
+    assert a.observe(1, 0, 0.0) == 8         # down-streak 1: hold
+    assert a.observe(1, 0, 0.0) == 8         # down-streak 2: hold
+    assert a.observe(1, 0, 0.0) == 1         # down-streak 3: move down
+    # a blip resets the streak: no flapping
+    assert a.observe(8, 0, 0.0) == 1
+    assert a.observe(1, 0, 0.0) == 1         # raw == desired: reset
+    assert a.observe(8, 0, 0.0) == 1
+    assert a.observe(8, 0, 0.0) == 8
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_sched_rollup_and_prometheus(tmp_path):
+    from pyabc_tpu.telemetry import aggregate
+    rd = str(tmp_path)
+    td = aggregate.telemetry_dir(rd)
+    os.makedirs(td, exist_ok=True)
+    for host, (alive, requeues) in (("hostA", (2, 3)),
+                                    ("hostB", (1, 4))):
+        snap = {"schema_version": aggregate.SCHEMA_VERSION,
+                "host": host, "pid": 1,
+                "metrics": {"sched_workers_alive": alive,
+                            "sched_requeues_total": requeues,
+                            "sched_desired_replicas": alive + 1}}
+        with open(os.path.join(td, f"snap_{host}.json"), "w") as f:
+            json.dump(snap, f)
+    roll = aggregate.fleet_rollup(rd)
+    sched = roll["sched"]
+    # gauges take the max across scheduler replicas; counters sum
+    assert sched["sched_workers_alive"] == 2
+    assert sched["sched_desired_replicas"] == 3
+    assert sched["sched_requeues_total"] == 7
+    text = aggregate.render_prometheus(rd)
+    assert "pyabc_tpu_sched_workers_alive 2" in text
+    assert "pyabc_tpu_sched_requeues_total 7" in text
+
+
+def test_scheduler_tick_publishes_sched_metrics(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    rd = str(tmp_path / "run")
+    os.makedirs(rd)
+    q = StudyQueue(root=str(tmp_path / "serve"), lease_s=60.0)
+    q.submit(_spec(seed=9))
+    rep = Scheduler(run_dir=rd, queue=q).tick()
+    assert rep["desired_replicas"] >= 1
+    from pyabc_tpu.telemetry import aggregate
+    roll = aggregate.fleet_rollup(rd)
+    assert roll["sched"].get("sched_queue_pending", 0) >= 1
+    assert "sched_last_tick_ms" in roll["sched"]
+
+
+# ---------------------------------------------------------------------------
+# chaos suite: deterministic fast subset tier-1, full soak slow
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("trial", ("freeze", "poison"))
+def test_sched_chaos_fast_subset(trial, tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    from tools.chaos_soak import SCHED_FAST_TRIALS, run_sched_trial
+    assert trial in SCHED_FAST_TRIALS
+    rep = run_sched_trial(trial, str(tmp_path), seed=0)
+    assert rep["lost"] == 0
+    assert rep["reschedule_ms"] < 10_000
+
+
+@pytest.mark.slow
+def test_sched_chaos_full_soak(tmp_path, monkeypatch):
+    """The whole --sched suite, subprocess kill -9 and journal
+    corruption included (slow: spawns JAX child processes)."""
+    _clean_env(monkeypatch)
+    from tools.chaos_soak import sched_soak
+    reports = sched_soak(workdir=str(tmp_path), seed=0)
+    assert len(reports) == 4
+    assert sum(r["lost"] for r in reports) == 0

@@ -98,6 +98,16 @@ WATCHED = (
     # cache; the ratio is pinned by the bench's fixed mix, so a drop
     # means digests stopped matching (cache.py / spec.py drift)
     ("serve_cache_hit_ratio", "higher", 0.10),
+    # scheduler conservation (bench_sched, sched/scheduler.py): every
+    # submitted study stays in exactly one queue state across every
+    # preemption bounce — ZERO tolerance, a scheduler that loses or
+    # double-books a study is wrong, not slow
+    ("sched_lost_studies", "zero", 0.0),
+    # ... and the time-to-reschedule bound: one tick reaps + requeues
+    # the whole preempted batch; the reference is small (ms of fs
+    # renames), so the wide relative slack absorbs shared-filesystem
+    # jitter while an O(lease) or O(poll) stall still blows through
+    ("sched_reschedule_p99_ms", "lower", 1.00),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

@@ -434,6 +434,324 @@ def run_trial(trial: Trial, workdir: str, seed: int = 0) -> dict:
     return report
 
 
+# ------------------------------------------------------- scheduler suite
+
+SCHED_POP = 256
+SCHED_GENS = 4
+
+#: the deterministic ``--sched`` trial names; ``SCHED_FAST_TRIALS`` is
+#: the queue-level subset cheap enough for tier-1 (tests/test_sched.py)
+SCHED_TRIALS = ("kill9", "freeze", "corrupt", "poison")
+SCHED_FAST_TRIALS = ("freeze", "poison")
+
+_SCHED_CHILD = """
+import sys
+
+from pyabc_tpu.serve.queue import StudyQueue
+from pyabc_tpu.serve.worker import ServeWorker
+
+root, wid = sys.argv[1], sys.argv[2]
+worker = ServeWorker(root=root, worker_id=wid, run_mode="classic",
+                     durable=True)
+queue = StudyQueue(root=root)
+worker.run_forever(queue, once=True)
+sys.exit(0)
+"""
+
+
+def _sched_spec(seed: int, pop: int = SCHED_POP):
+    """One serve-queue study spec for the scheduler trials.  The model
+    lives in ``pyabc_tpu.models`` so BOTH sides of a subprocess trial
+    (the submitting parent and the claiming child) unpickle it by
+    import, like a real tenant's importable model."""
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import gaussian_model
+    from pyabc_tpu.serve import StudySpec
+    return StudySpec(
+        model=gaussian_model,
+        prior=pt.Distribution(mu=pt.RV("norm", 0.0, 1.0)),
+        observed={"y": 0.5}, population_size=pop, seed=seed,
+        max_generations=SCHED_GENS, tenant="chaos")
+
+
+class _SchedEnv:
+    """Scheduler-trial environment: solo-only routing (the durable
+    resume path is the solo engine's), durable studies, ring capacity
+    1 so every generation spills through the journal (the resume
+    anchor a kill -9 leaves behind).  Ambient run-dir/serve-dir/fault
+    config is scrubbed so trials are hermetic."""
+
+    _VARS = {"PYABC_TPU_SERVE_MULTIPLEX": "1",
+             "PYABC_TPU_SERVE_DURABLE": "1",
+             "PYABC_TPU_STORE_GENS": "1"}
+    _UNSET = ("PYABC_TPU_RUN_DIR", "PYABC_TPU_SERVE_DIR",
+              "PYABC_TPU_FAULTS")
+
+    def __enter__(self):
+        keys = list(self._VARS) + list(self._UNSET)
+        self._old = {k: os.environ.get(k) for k in keys}
+        os.environ.update(self._VARS)
+        for k in self._UNSET:
+            os.environ.pop(k, None)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _rewind_lease(queue, worker_id: str, by_s: float = 3600.0):
+    """Deterministically age a worker's leases (instead of sleeping
+    through the TTL): backdate the claimed files' mtimes."""
+    import time as _time
+    wdir = os.path.join(queue.root, "claimed", worker_id)
+    old = _time.time() - by_s
+    for name in os.listdir(wdir):
+        if name.endswith(".json"):
+            os.utime(os.path.join(wdir, name), (old, old))
+
+
+def _sched_conservation(queue, n_submitted: int) -> int:
+    """Zero-lost-studies invariant: every submitted study is in
+    exactly one queue state.  Returns the number lost (asserted 0)."""
+    stats = queue.stats()
+    present = (stats["pending"] + stats["claimed"] + stats["done"]
+               + stats["failed"])
+    lost = n_submitted - present
+    assert lost == 0, (
+        f"lost studies: submitted={n_submitted} but only {present} "
+        f"accounted for ({stats})")
+    return lost
+
+
+def _run_dead_child(root: str, worker_id: str, fault_plan: str,
+                    workdir: str, slug: str):
+    """Spawn a durable serve worker subprocess under a kill plan and
+    assert it actually died by SIGKILL mid-study."""
+    script = os.path.join(workdir, f"{slug}_worker.py")
+    with open(script, "w") as f:
+        f.write(_SCHED_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO,
+               PYABC_TPU_FAULTS=fault_plan,
+               PYABC_TPU_SERVE_MULTIPLEX="1",
+               PYABC_TPU_SERVE_DURABLE="1",
+               PYABC_TPU_STORE_GENS="1")
+    env.pop("PYABC_TPU_RUN_DIR", None)  # lease lapse is the signal
+    proc = subprocess.run(
+        [sys.executable, script, root, worker_id], env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == -9, (
+        f"expected SIGKILL death mid-study, got rc={proc.returncode}: "
+        f"{proc.stderr[-2000:]}")
+
+
+def _corrupt_tail(path: str, n: int = 64):
+    """Flip the last ``n`` bytes of a file — bit rot on the journal
+    segment's newest frames; earlier frames still CRC-scan clean."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        start = max(size - n, 0)
+        f.seek(start)
+        chunk = bytes(b ^ 0xFF for b in f.read(size - start))
+        f.seek(start)
+        f.write(chunk)
+
+
+def run_sched_trial(name: str, workdir: str, seed: int = 0) -> dict:
+    """One scheduler chaos trial (see ``--sched``); asserts zero lost
+    studies, no double-completion, resume-not-restart and bounded
+    time-to-reschedule.  Returns a report dict."""
+    import time as _time
+
+    from pyabc_tpu.sched import Scheduler
+    from pyabc_tpu.serve.queue import StudyQueue
+
+    root = os.path.join(workdir, f"serve_{name}_{seed}")
+    report = {"plan": f"sched:{name}", "kind": "sched",
+              "outcome": "completed", "recovered": False,
+              "lost": 0, "reschedule_ms": 0.0}
+    queue = StudyQueue(root=root, lease_s=30.0)
+
+    if name in ("kill9", "corrupt"):
+        with _SchedEnv():
+            spec = _sched_spec(seed=100 + seed)
+            ticket = queue.submit(spec)
+            # visit 3 = generation 2's deposit (kill9: journal holds
+            # gen 0); visit 4 leaves gens 0-1 journaled so the corrupt
+            # trial can lose the newest frame and STILL resume > 0
+            visit = 3 if name == "kill9" else 4
+            _run_dead_child(root, "w_chaos",
+                            f"store.deposit@{visit}:sigkill",
+                            workdir, f"sched_{name}_{seed}")
+            assert queue.stats()["claimed"] == 1, (
+                "the killed worker's claim should survive as a lease")
+            if name == "corrupt":
+                # bit-rot the newest journal frame of the orphaned
+                # durable study; the CRC scan must drop it and resume
+                # from the intact prefix
+                from pyabc_tpu.serve.spec import study_digest
+                jdir = os.path.join(
+                    root, "studies",
+                    f"{study_digest(spec)}.solo.db.journal")
+                segs = sorted(n for n in os.listdir(jdir)
+                              if n.endswith(".wal"))
+                assert segs, "no journal segments to corrupt"
+                _corrupt_tail(os.path.join(jdir, segs[-1]))
+            # the dead worker's lease lapses; the scheduler requeues
+            # with bounce accounting — rewind the lease instead of
+            # sleeping through the TTL
+            _rewind_lease(queue, "w_chaos")
+            sched = Scheduler(run_dir=None, queue=queue, max_bounces=3)
+            t0 = _time.perf_counter()
+            rep = sched.tick()
+            report["reschedule_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3)
+            assert rep["requeued"] == [ticket.id], (
+                f"expected one requeue, got {rep}")
+            pend = queue.pending()
+            assert pend and pend[0].requeues == 1 \
+                and pend[0]._payload.get("last_worker") == "w_chaos", (
+                    "bounce breadcrumbs missing after scheduler requeue")
+            # a rescue worker claims the bounced ticket and RESUMES the
+            # durable study from its journaled generation
+            from pyabc_tpu.serve.worker import ServeWorker
+            rescue = ServeWorker(root=root, worker_id="w_rescue",
+                                 run_mode="classic", durable=True)
+            served = rescue.run_forever(queue, once=True)
+            assert served == 1, f"rescue served {served} studies"
+            report["recovered"] = True
+            from pyabc_tpu.serve.spec import study_digest as _dig
+            summary = rescue.cache.get(f"{_dig(spec)}.solo")
+            assert summary is not None, "rescued study not cached"
+            assert summary.get("resumed_from_gen", 0) >= 1, (
+                f"study restarted from generation 0: {summary}")
+            assert summary["gens"] >= SCHED_GENS, (
+                f"resumed study lost generations: {summary['gens']}")
+            # posterior gate: y ~ N(mu, 1), mu ~ N(0, 1), y_obs = 0.5
+            # -> posterior mean mu = 0.25; ABC tolerance is loose
+            mu = summary["posterior_mean"]["mu"]
+            assert abs(mu - 0.25) < 0.35, f"posterior gate: mu={mu}"
+            stats = queue.stats()
+            assert stats["done"] == 1 and stats["failed"] == 0, (
+                f"exactly one completion expected: {stats}")
+            report["lost"] = _sched_conservation(queue, 1)
+
+    elif name == "freeze":
+        # partitioned host: heartbeats frozen (file exists, mtime never
+        # advances) -> the monotonic cross-check declares it dead, its
+        # claims are reaped immediately (no lease wait) — and when the
+        # partition heals and the old worker completes its stale
+        # ticket, the completion converges by id: no double-serve
+        import json as _json
+        run_dir = os.path.join(workdir, f"run_{name}_{seed}")
+        os.makedirs(run_dir, exist_ok=True)
+        with _SchedEnv():
+            spec = _sched_spec(seed=200 + seed)
+            ticket = queue.submit(spec)
+            stale = queue.claim("hfrozen_77")
+            assert stale is not None
+            hb = os.path.join(run_dir, "hb_hfrozen_77.json")
+            with open(hb, "w") as f:
+                _json.dump({"host": "hfrozen", "pid": 77,
+                            "ts": _time.time() - 3600}, f)
+            old = _time.time() - 3600
+            os.utime(hb, (old, old))
+            sched = Scheduler(run_dir=run_dir, queue=queue,
+                              max_bounces=3)
+            t0 = _time.perf_counter()
+            rep = sched.tick()
+            report["reschedule_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3)
+            assert rep["dead"] == 1, (
+                f"frozen host not declared dead: {rep}")
+            assert rep["requeued"] == [ticket.id], (
+                f"frozen host's claim not requeued: {rep}")
+            # the partition heals: the old worker completes its stale
+            # copy
+            queue.complete(stale, wall_s=0.1, engine="solo")
+            # the requeued duplicate must now be reaped at claim time,
+            # not served again
+            assert queue.claim("w_second") is None, (
+                "settled study was claimable again — double-serve")
+            stats = queue.stats()
+            assert stats["done"] == 1 and stats["pending"] == 0, (
+                f"double-completion or lost study: {stats}")
+            report["lost"] = _sched_conservation(queue, 1)
+            report["recovered"] = True
+
+    elif name == "poison":
+        # a study that keeps killing workers: every claim's lease
+        # lapses with no completion.  The scheduler's bounce budget
+        # (PYABC_TPU_SERVE_MAX_BOUNCES) quarantines it into failed/
+        # with the flight dump attached — workers stop dying for it
+        with _SchedEnv():
+            spec = _sched_spec(seed=300 + seed)
+            ticket = queue.submit(spec)
+            max_bounces = 3
+            sched = Scheduler(run_dir=None, queue=queue,
+                              max_bounces=max_bounces)
+            bounces = 0
+            rep = {"quarantined": []}
+            for _round in range(max_bounces + 2):
+                t = queue.claim(f"w_poison_{_round}")
+                if t is None:
+                    break
+                _rewind_lease(queue, f"w_poison_{_round}")
+                rep = sched.tick()
+                bounces += 1
+                if rep["quarantined"]:
+                    break
+            assert rep["quarantined"] == [ticket.id], (
+                f"poison ticket not quarantined: {rep}")
+            assert bounces <= max_bounces, (
+                f"quarantine took {bounces} bounces > {max_bounces}")
+            import json as _json
+            tomb_path = os.path.join(queue.root, "failed",
+                                     f"{ticket.id}.json")
+            with open(tomb_path) as f:
+                tomb = _json.load(f)
+            assert tomb.get("quarantined") \
+                and tomb.get("bounce_history"), (
+                    f"quarantine tombstone not diagnosable: {tomb}")
+            assert tomb.get("flight_path") and os.path.exists(
+                tomb["flight_path"]), (
+                    "flight dump missing from tombstone")
+            report["lost"] = _sched_conservation(queue, 1)
+
+    else:
+        raise ValueError(f"unknown sched trial {name!r}")
+
+    # bounded time-to-reschedule: one tick must be enough once the
+    # lease lapsed/host died — the reap is never deferred to a later
+    # pass (10 s bounds a pathological shared-FS stall, not the mean)
+    assert report["reschedule_ms"] < 10_000, (
+        f"reschedule took {report['reschedule_ms']} ms")
+    return report
+
+
+def sched_soak(trials=None, workdir=None, seed: int = 0,
+               verbose: bool = True):
+    """Run the scheduler chaos suite; returns the report dicts."""
+    if trials is None:
+        trials = SCHED_TRIALS
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="chaos_sched_")
+    reports = []
+    for i, name in enumerate(trials):
+        if verbose:
+            print(f"[sched {i + 1}/{len(trials)}] {name}", flush=True)
+        reports.append(run_sched_trial(name, workdir, seed=seed))
+        if verbose:
+            r = reports[-1]
+            print(f"    -> {r['outcome']} lost={r['lost']} "
+                  f"reschedule={r['reschedule_ms']}ms", flush=True)
+    return reports
+
+
 def soak(trials, workdir=None, seed: int = 0, verbose: bool = True):
     """Run a list of trials; returns the list of report dicts."""
     owns = workdir is None
@@ -460,7 +778,23 @@ def main(argv=None) -> int:
                          "deterministic subset)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--sched", action="store_true",
+                    help="run the scheduler chaos suite (lease reaping,"
+                         " resume-not-restart, partitioned host, poison"
+                         " quarantine) instead of the store/journal "
+                         "matrix")
     args = ap.parse_args(argv)
+
+    if args.sched:
+        try:
+            reports = sched_soak(workdir=args.workdir, seed=args.seed)
+        except AssertionError as err:
+            print(f"SCHED CHAOS SOAK FAILED: {err}", file=sys.stderr)
+            return 1
+        lost = sum(r["lost"] for r in reports)
+        print(f"sched chaos soak: {len(reports)} trial(s) passed, "
+              f"lost={lost}")
+        return 0
 
     trials = list(DETERMINISTIC_TRIALS)
     if args.trials:

@@ -1,0 +1,24 @@
+"""Planted claim-discipline violations: claims whose settle calls all
+sit on the happy path, so any exception strands the ticket in
+claimed/ for a full lease TTL."""
+
+
+def serve_one(queue, worker_id):
+    # settle exists but only on the happy path: an exception between
+    # claim and complete leaks the ticket
+    ticket = queue.claim(worker_id)
+    if ticket is None:
+        return None
+    summary = run_study(ticket)
+    queue.complete(ticket)
+    return summary
+
+
+def claim_and_forget(queue, worker_id):
+    # no settle at all
+    ticket = queue.claim(worker_id)
+    return ticket.id if ticket else None
+
+
+def run_study(ticket):
+    return {"id": ticket.id}

@@ -1,0 +1,44 @@
+"""The claim-discipline_bad violations, silenced every sanctioned way:
+settle-in-finally, claim-and-return handoff, the historical
+``# claim-ok`` marker, and the generic graftlint allow."""
+
+
+def serve_one(queue, worker_id):
+    # the real contract: settle on every unwind path
+    ticket = queue.claim(worker_id)
+    if ticket is None:
+        return None
+    try:
+        summary = run_study(ticket)
+        queue.complete(ticket)
+        return summary
+    except Exception as exc:
+        queue.requeue(ticket, error=repr(exc))
+        raise
+
+
+def claim_next(queue, worker_id):
+    # claim-and-return helper: the caller owns settlement
+    return queue.claim(worker_id)
+
+
+def claim_for_janitor(queue, worker_id):
+    # unwind story lives in a process-level janitor sweep
+    ticket = queue.claim(worker_id)  # claim-ok
+    return ticket.id if ticket else None
+
+
+def claim_suppressed(queue, worker_id):
+    ticket = queue.claim(worker_id)  # graftlint: allow(claim-discipline)
+    return ticket.id if ticket else None
+
+
+def drain(queue, worker_id):
+    try:
+        serve_one(queue, worker_id)
+    finally:
+        queue.requeue_worker(worker_id)
+
+
+def run_study(ticket):
+    return {"id": ticket.id}

@@ -1,5 +1,5 @@
 """graftlint rule modules — importing this package registers all
-fourteen rules with :data:`tools.lint.core.RULES` (registration order
+fifteen rules with :data:`tools.lint.core.RULES` (registration order
 is the default run order: the six ported gates first, then the new
 analyzers)."""
 
@@ -17,3 +17,4 @@ from . import sort_discipline    # noqa: F401
 from . import precision_policy   # noqa: F401
 from . import collective_discipline  # noqa: F401
 from . import study_isolation    # noqa: F401
+from . import claim_discipline   # noqa: F401

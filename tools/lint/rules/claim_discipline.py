@@ -1,0 +1,133 @@
+"""Rule ``claim-discipline``: a queue claim in the serving/scheduling
+tier settles on every unwind path.
+
+``StudyQueue.claim`` moves a ticket into ``claimed/<worker>/`` — from
+that instant the study is invisible to other workers until somebody
+settles it (``complete``/``fail``/``requeue``/``requeue_worker``/
+``quarantine``) or its lease lapses.  A claim site whose settle calls
+all sit on the happy path leaks the ticket on ANY exception between
+claim and settle: the study hangs for a full lease TTL before the
+scheduler notices, which is exactly the latency class the lease
+machinery exists to bound.  The worker loop's contract is therefore
+structural: every function in ``pyabc_tpu/serve/`` or
+``pyabc_tpu/sched/`` that calls ``.claim(...)`` must also settle in an
+unwind position — a ``finally`` block or an ``except`` handler — so
+the ticket is handed back no matter how the serve attempt dies.
+
+Exemptions:
+
+- a claim whose result is immediately returned (``return
+  queue.claim(...)``) — a claim-and-return helper hands ownership, and
+  therefore the settle obligation, to its caller;
+- ``# claim-ok`` on the claim line — the historical per-rule escape
+  for sites whose unwind story lives elsewhere (e.g. a process-level
+  janitor), mirroring ``# wire-ok`` / ``# jit-ok``;
+- the generic ``# graftlint: allow(claim-discipline)``.
+
+The rule is deliberately scoped to the two packages that touch the
+queue's claim side; test helpers and tools stay free to claim without
+ceremony.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import (Finding, Rule, ancestors, attach_parents, register)
+
+#: methods that settle a claimed ticket (hand it off the claim state)
+SETTLE_ATTRS = frozenset({
+    "complete", "fail", "requeue", "requeue_worker", "quarantine"})
+
+CLAIM_OK = "# claim-ok"
+
+#: package-relative directory prefixes the rule applies to
+SCOPES = ("serve/", "sched/")
+
+
+def _innermost_function(node: ast.AST) -> Optional[ast.AST]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _call_attr(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _unwind_settles(func: ast.AST) -> Set[int]:
+    """Line numbers of settle calls in an unwind position within
+    ``func``: inside a ``finally`` block or an ``except`` handler."""
+    out: Set[int] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Try):
+            continue
+        unwind_stmts = list(node.finalbody)
+        for handler in node.handlers:
+            unwind_stmts.extend(handler.body)
+        for stmt in unwind_stmts:
+            for call in ast.walk(stmt):
+                if isinstance(call, ast.Call) \
+                        and _call_attr(call) in SETTLE_ATTRS:
+                    out.add(call.lineno)
+    return out
+
+
+def check(files) -> List[tuple]:
+    """``files`` is an iterable of (rel, SourceFile) pairs scoped to
+    serve/ + sched/; returns ``[(rel, lineno, message), ...]``."""
+    violations = []
+    for rel, sf in files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        attach_parents(tree)
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call) \
+                    or _call_attr(call) != "claim":
+                continue
+            if CLAIM_OK in sf.line(call.lineno):
+                continue
+            # claim-and-return helper: ownership (and the settle
+            # obligation) transfers to the caller
+            parent = getattr(call, "graftlint_parent", None)
+            if isinstance(parent, ast.Return):
+                continue
+            func = _innermost_function(call)
+            if func is None:
+                # module-level claim: no function to hold a finally —
+                # always a finding (scripts belong outside the package)
+                violations.append((
+                    rel, call.lineno,
+                    "module-level .claim() with no enclosing function "
+                    "to settle it on unwind"))
+                continue
+            if not _unwind_settles(func):
+                violations.append((
+                    rel, call.lineno,
+                    f".claim() in `{func.name}` has no "
+                    "complete/fail/requeue/quarantine in a finally or "
+                    "except — the ticket leaks for a full lease TTL on "
+                    "any unwind (settle in a finally, or mark "
+                    "`# claim-ok`)"))
+    violations.sort()
+    return violations
+
+
+@register
+class ClaimDisciplineRule(Rule):
+    id = "claim-discipline"
+    description = ("queue claims in serve/ and sched/ settle on every "
+                   "unwind path (complete/fail/requeue/quarantine in "
+                   "a finally or except)")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        pairs = [(sf.rel, sf) for sf in tree.package_files()
+                 if sf.rel.startswith(SCOPES)]
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, msg)
+                for rel, lineno, msg in check(pairs)]
