@@ -856,8 +856,140 @@ def bench_sched():
     }
 
 
+def bench_serve_load():
+    """Serving DATA-PLANE row: a ≥1e4-study closed-loop load run
+    against ≥2 platform-managed workers — the fleet-scale mirror of
+    ``bench_serve``'s one-worker row.
+
+    A ``SubprocessPlatform`` under a ticking ``Scheduler`` (autoscaler
+    pinned to 2 replicas) spawns real ``abc-serve`` worker processes
+    on the CPU backend (two processes cannot share one TPU chip — like
+    ``sharded_cpu8`` this row prices the DATA PLANE, not device rates);
+    ``tools/loadgen.py`` then drives a duplicate-heavy mixed-size spec
+    pool through the sharded queue at a controlled Poisson arrival
+    rate.  Headline sentinel rows: ``serve_load_studies_per_s``
+    (fail-low), ``serve_load_p99_ms`` and ``serve_load_shed_rate``
+    (fail-high), plus the tier-1/tier-2 cache hit split — the two-tier
+    contract (docs/serving.md "Data plane") priced end to end:
+    submit → partition → claim → serve → tombstone."""
+    import tempfile
+    import threading
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import gaussian_model
+    from pyabc_tpu.parallel import health
+    from pyabc_tpu.sched import Scheduler, SubprocessPlatform
+    from pyabc_tpu.sched.autoscale import Autoscaler
+    from pyabc_tpu.serve import StudyQueue, StudySpec
+    from pyabc_tpu.serve.admission import AdmissionController
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from loadgen import ClosedLoopLoadGen
+
+    n_studies = int(os.environ.get("BENCH_SERVE_LOAD_STUDIES",
+                                   "10000"))
+    workers = 2
+    root = tempfile.mkdtemp(prefix="bench_serve_load_")
+    run_dir = os.path.join(root, "run")
+    os.makedirs(run_dir, exist_ok=True)
+
+    def spec(pop, seed, tenant, y=0.4):
+        # model by import path (pyabc_tpu.models), NOT a local def:
+        # the subprocess workers must unpickle it on their side
+        return StudySpec(
+            model=gaussian_model,
+            prior=pt.Distribution(mu=pt.RV("norm", 0.0, 1.0)),
+            observed={"y": float(y)}, population_size=pop,
+            seed=seed, tenant=tenant, max_generations=2)
+
+    # duplicate-heavy mixed-size pool: 12 distinct studies over 1e4
+    # submissions — after the first pass everything is a cache hit,
+    # which is exactly the traffic shape the two-tier cache exists for
+    pool = ([spec(100, s, "t_small", y=0.1 * (s % 4))
+             for s in range(6)]
+            + [spec(300, s, "t_mid") for s in range(4)]
+            + [spec(1000, s, "t_big") for s in range(2)])
+
+    queue = StudyQueue(
+        root=root, max_depth=4096, tenant_quota=4096,
+        # shedding armed but generous: a healthy run sheds ~nothing,
+        # a regressed fleet (stalled workers, hot partition) sheds
+        # visibly and fails the sentinel's fail-high row
+        admission=AdmissionController(root, slo_depth=512))
+    child_env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+        "PYABC_TPU_RUN_DIR": run_dir,
+        "PYABC_TPU_SERVE_MAX_DEPTH": "4096",
+        "PYABC_TPU_SERVE_TENANT_QUOTA": "4096",
+    }
+    platform = SubprocessPlatform(
+        serve_dir=root,
+        argv=[sys.executable, "-m", "pyabc_tpu.serve.worker",
+              "--serve-dir", root, "--poll-s", "0.02"],
+        env=child_env)
+    sched = Scheduler(
+        run_dir=run_dir, queue=queue, platform=platform,
+        autoscaler=Autoscaler(min_replicas=workers,
+                              max_replicas=workers))
+    stop = threading.Event()
+
+    def _tick_loop():
+        while not stop.is_set():
+            sched.tick()
+            stop.wait(0.5)
+
+    ticker = threading.Thread(target=_tick_loop, daemon=True)
+    ticker.start()
+    try:
+        # wait for both platform workers to heartbeat (the jax import
+        # dominates their cold start)
+        deadline = time.time() + 180.0
+        while time.time() < deadline:
+            alive = sum(1 for e in health.worker_status(run_dir)
+                        if e.get("alive"))
+            if alive >= workers:
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("platform workers never came up")
+        # warmup pass outside the timed window: one submission per
+        # distinct spec pays the fleet's compile bill
+        warm = ClosedLoopLoadGen(
+            queue, pool, n_studies=len(pool), clients=4,
+            seed=1, study_timeout_s=300.0)
+        warm.run()
+        gen = ClosedLoopLoadGen(
+            queue, pool, n_studies=n_studies, clients=32,
+            rate_hz=400.0, seed=2, study_timeout_s=300.0)
+        report = gen.run()
+    finally:
+        stop.set()
+        ticker.join(timeout=10.0)
+        platform.shutdown()
+    cache_stats = queue.stats()
+    return {
+        "serve_load_studies_per_s": report["studies_per_s"],
+        "serve_load_p50_ms": report["p50_ms"],
+        "serve_load_p99_ms": report["p99_ms"],
+        "serve_load_shed_rate": report["shed_rate"],
+        "serve_load_cache_hit_tier1": report["cache_hit_tier1"],
+        "serve_load_cache_hit_tier2": report["cache_hit_tier2"],
+        "serve_load_studies": report["completed"],
+        "serve_load_failed": report["failed"] + report["timeouts"],
+        "serve_load_workers": workers,
+        "serve_load_partitions": queue.partitions,
+        "serve_load_partition_depth_max": max(
+            cache_stats["partition_depths"] or [0]),
+        "serve_load_clients": report["clients"],
+        "serve_load_rate_hz": report["rate_hz"],
+    }
+
+
 SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
-               "kernel", "lanes", "serve", "sched", "posterior_gate",
+               "kernel", "lanes", "serve", "serve_load", "sched",
+               "posterior_gate",
                "lotka_volterra", "sir", "petab_ode", "sharded_mesh1",
                "ab_vec_sharded", "sharded_cpu8", "podstar")
 
@@ -1127,6 +1259,8 @@ def _run_sub(name: str) -> dict:
         return bench_lanes()
     if name == "serve":
         return bench_serve()
+    if name == "serve_load":
+        return bench_serve_load()
     if name == "sched":
         return bench_sched()
     if name == "posterior_gate":
@@ -1186,6 +1320,10 @@ def main():
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 " --xla_force_host_platform_device_count=8")
+        if name == "serve_load":
+            # two subprocess workers cannot share one TPU chip: the
+            # data-plane row runs the whole fleet on the CPU backend
+            env["JAX_PLATFORMS"] = "cpu"
         try:
             proc = subprocess.run(
                 [sys.executable, here, "--sub", name],

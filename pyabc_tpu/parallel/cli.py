@@ -236,11 +236,11 @@ def _render_top(run_dir) -> str:
                     and not isinstance(v, bool)):
                 serve_vals.setdefault(k, []).append(float(v))
     if serve_vals:
-        from ..telemetry.aggregate import _SERVE_GAUGES
+        from ..telemetry.aggregate import is_serve_gauge
 
         def sv(key):
             vals = serve_vals.get(key, [0.0])
-            return max(vals) if key in _SERVE_GAUGES else sum(vals)
+            return max(vals) if is_serve_gauge(key) else sum(vals)
 
         looked = sv("serve_cache_hits_total") + sv(
             "serve_cache_misses_total")
@@ -252,6 +252,15 @@ def _render_top(run_dir) -> str:
             f"engines={int(sv('serve_engines_warm'))} "
             f"cache_hit_ratio="
             f"{sv('serve_cache_hits_total') / looked if looked else 0.0:.2f}")
+        # the data plane: shard spread, tier split and shed pressure
+        # (only once a worker reports a partitioned queue)
+        if sv("serve_partitions"):
+            lines.append(
+                f"  data: partitions={int(sv('serve_partitions'))} "
+                f"depth_max={int(sv('serve_partition_depth_max'))} "
+                f"t1_hit={sv('serve_cache_hit_ratio_t1'):.2f} "
+                f"t2_hit={sv('serve_cache_hit_ratio_t2'):.2f} "
+                f"shed={int(sv('serve_shed_total'))}")
         tenants = sorted(
             (k[len("serve_tenant_"):-len("_studies_total")], sv(k))
             for k in serve_vals
@@ -282,7 +291,8 @@ def _render_top(run_dir) -> str:
             f"lapsed={int(sc('sched_leases_lapsed_total'))} "
             f"requeues={int(sc('sched_requeues_total'))} "
             f"quarantined={int(sc('sched_quarantines_total'))} "
-            f"desired={int(sc('sched_desired_replicas'))}")
+            f"desired={int(sc('sched_desired_replicas'))} "
+            f"replicas={int(sc('sched_platform_replicas'))}")
     lines.extend(rows or ["  (no telemetry snapshots yet)"])
     # recent generations across the fleet, newest last
     tail = []

@@ -1,21 +1,26 @@
 """Elastic fleet scheduling: the control plane over the serving tier.
 
-Two pieces compose the ROADMAP's "preemptible-first production ops"
+Three pieces compose the ROADMAP's "preemptible-first production ops"
 item out of machinery the repo already has:
 
 - :mod:`pyabc_tpu.sched.scheduler` — the ``abc-sched`` reconciliation
   loop: joins worker heartbeats (``parallel/health.py``) to claim
   leases (``serve/queue.py``), requeues dead workers' tickets with
   bounce accounting, quarantines poison tickets with a flight dump,
-  and publishes ``sched_*`` telemetry;
+  sweeps expired tombstones, and publishes ``sched_*`` telemetry;
 - :mod:`pyabc_tpu.sched.autoscale` — hysteresis-filtered desired-
-  replica targeting from queue depth and aging pressure.
+  replica targeting from queue depth and aging pressure;
+- :mod:`pyabc_tpu.sched.platform` — the actuator behind the target:
+  worker platform drivers (``abc-sched --platform subprocess``) that
+  start/stop/restart ``abc-serve`` workers to match it.
 
 All scheduler knobs are environment variables, documented with the
 lease and bounce contract in ``docs/scheduling.md``.
 """
 
 from .autoscale import Autoscaler
+from .platform import SubprocessPlatform, WorkerPlatform
 from .scheduler import Scheduler
 
-__all__ = ["Autoscaler", "Scheduler"]
+__all__ = ["Autoscaler", "Scheduler", "SubprocessPlatform",
+           "WorkerPlatform"]

@@ -22,8 +22,14 @@ plane that treats preemption as the common case.  Each :meth:`tick`:
    quarantined into ``failed/`` with the flight-recorder dump attached
    instead of being handed to yet another worker;
 5. the autoscaler (:mod:`pyabc_tpu.sched.autoscale`) folds queue depth
-   and aging pressure into ``sched_desired_replicas`` — the target an
-   operator or wrapper script acts on.
+   and aging pressure into ``sched_desired_replicas``, and — when a
+   platform driver is wired in (:mod:`pyabc_tpu.sched.platform`,
+   ``abc-sched --platform subprocess``) — the platform reconciles the
+   actual worker set toward that target (spawn on scale-up, SIGTERM
+   drain on scale-down, crash restart with backoff);
+6. done/failed tombstones past retention are swept
+   (:meth:`StudyQueue.sweep`) — GC belongs on the control loop, not
+   the workers' idle path, because a busy fleet never idles.
 
 The scheduler is stateless between ticks apart from the autoscaler's
 hysteresis streaks: every decision re-derives from the mount, so any
@@ -68,7 +74,8 @@ class Scheduler:
                  lease_s: Optional[float] = None,
                  max_bounces: Optional[int] = None,
                  stale_after_s: Optional[float] = None,
-                 autoscaler: Optional[Autoscaler] = None):
+                 autoscaler: Optional[Autoscaler] = None,
+                 platform=None):
         self.run_dir = run_dir if run_dir is not None else health.run_dir()
         self.queue = queue if queue is not None else StudyQueue(
             root=serve_root(serve_dir), lease_s=lease_s)
@@ -78,6 +85,10 @@ class Scheduler:
                             else max(int(max_bounces), 1))
         self.stale_after_s = stale_after_s
         self.autoscaler = autoscaler or Autoscaler()
+        #: optional worker platform (sched/platform.py): when set,
+        #: every tick reconciles the running worker set toward the
+        #: autoscaler's desired count
+        self.platform = platform
         self.ticks = 0
         self._publisher = None
         if self.run_dir:
@@ -169,6 +180,14 @@ class Scheduler:
         report["desired_replicas"] = self.autoscaler.observe(
             stats["pending"], stats["claimed"],
             oldest_pending_s=oldest_s)
+        if self.platform is not None:
+            # close the autoscale loop: the platform converges the
+            # running worker set toward the desired count
+            report["platform"] = self.platform.reconcile(
+                report["desired_replicas"])
+        # tombstone GC on the control loop (the worker idle-loop call
+        # is only a fallback — a busy fleet never idles)
+        report["swept"] = self.queue.sweep()
         self._gauges(report, stats, oldest_s,
                      (time.perf_counter() - t0) * 1e3)
         if self._publisher is not None:
@@ -240,25 +259,43 @@ def main():  # pragma: no cover - thin CLI shell over Scheduler
                   help="One reconciliation tick, then exit.")
     @click.option("--max-ticks", default=None, type=int,
                   help="Exit after this many ticks.")
+    @click.option("--platform", "platform_name", default="none",
+                  type=click.Choice(["none", "subprocess"]),
+                  show_default=True,
+                  help="Worker platform to actuate the autoscaler's "
+                       "replica target (sched/platform.py): "
+                       "'subprocess' starts/stops abc-serve workers "
+                       "on this host.")
     def cli(run_dir, serve_dir, interval_s, lease_s, max_bounces,
-            once, max_ticks):
+            once, max_ticks, platform_name):
         """Elastic fleet scheduler: lease reaping, bounce accounting,
         poison-ticket quarantine and replica targeting over a serve
         queue on the shared run-dir mount."""
+        from .platform import platform_from_name
+        platform = platform_from_name(platform_name,
+                                      serve_dir=serve_dir)
         sched = Scheduler(run_dir=run_dir, serve_dir=serve_dir,
-                          lease_s=lease_s, max_bounces=max_bounces)
+                          lease_s=lease_s, max_bounces=max_bounces,
+                          platform=platform)
 
         def show(rep):
+            plat = rep.get("platform") or {}
+            extra = (f" replicas={plat.get('running', 0)}"
+                     if plat else "")
             click.echo(
                 f"tick: alive={rep['alive']} dead={rep['dead']} "
                 f"lapsed={rep['lapsed']} "
                 f"requeued={len(rep['requeued'])} "
                 f"quarantined={len(rep['quarantined'])} "
-                f"desired={rep['desired_replicas']}")
+                f"desired={rep['desired_replicas']}" + extra)
 
-        sched.run_forever(interval_s=interval_s,
-                          max_ticks=1 if once else max_ticks,
-                          on_tick=show)
+        try:
+            sched.run_forever(interval_s=interval_s,
+                              max_ticks=1 if once else max_ticks,
+                              on_tick=show)
+        finally:
+            if platform is not None:
+                platform.shutdown()
 
     cli()
 

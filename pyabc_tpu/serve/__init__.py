@@ -11,9 +11,16 @@ programs warm across studies.  Five pieces:
 - :mod:`pyabc_tpu.serve.queue` — the admission queue over the
   ``parallel/`` mount contract, with per-tenant quotas, backpressure
   and priority aging;
-- :mod:`pyabc_tpu.serve.cache` — the content-addressed study cache
-  (digest → posterior summary) serving duplicate submissions without a
-  dispatch;
+- :mod:`pyabc_tpu.serve.shards` — the partitioned queue layout:
+  ``pending/`` sharded by ``hash(digest) % PYABC_TPU_SERVE_PARTITIONS``
+  so claim scans and rename contention are O(depth/P);
+- :mod:`pyabc_tpu.serve.cache` — the two-tier content-addressed study
+  cache (worker LRU in front of a shared CRC-verified store) serving
+  any worker's duplicate submissions without a dispatch;
+- :mod:`pyabc_tpu.serve.admission` — SLO load-shedding: reject-fast
+  with a computed ``retry_after_s`` when partition depth or the
+  fleet's served p99 breach the configured SLO knobs
+  (``PYABC_TPU_SERVE_SLO_DEPTH``, ``PYABC_TPU_SERVE_SLO_P99_MS``);
 - :mod:`pyabc_tpu.serve.multiplex` — the study axis: N small studies
   vmapped into ONE fused program with per-study live-sentinel masking;
 - :mod:`pyabc_tpu.serve.worker` — the persistent warm worker
@@ -24,7 +31,8 @@ All serving knobs are serve-prefixed environment variables,
 documented in ``docs/serving.md``.
 """
 
-from .cache import StudyCache
+from .admission import AdmissionController, ServeOverloaded
+from .cache import SharedResultStore, StudyCache, TieredStudyCache
 from .multiplex import StudyBatch, lane_eligible, multiplex_eligible
 from .queue import (QueueFull, SpecAuthError, StudyQueue,
                     TenantQuotaExceeded)
@@ -32,14 +40,18 @@ from .spec import StudySpec, problem_key, study_digest
 from .worker import ServeWorker
 
 __all__ = [
+    "AdmissionController",
     "QueueFull",
+    "ServeOverloaded",
     "ServeWorker",
+    "SharedResultStore",
     "SpecAuthError",
     "StudyBatch",
     "StudyCache",
     "StudyQueue",
     "StudySpec",
     "TenantQuotaExceeded",
+    "TieredStudyCache",
     "lane_eligible",
     "multiplex_eligible",
     "problem_key",

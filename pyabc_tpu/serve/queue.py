@@ -11,10 +11,24 @@ no connection state.
 Layout under the serve root (``$PYABC_TPU_SERVE_DIR``, defaulting to
 ``$PYABC_TPU_RUN_DIR/serve``)::
 
-    queue/pending/<id>.json            submitted, unclaimed
+    queue/pending/p0000/<id>.json      submitted, unclaimed (sharded:
+    queue/pending/p0001/<id>.json      partition = hash(digest) % P,
+    ...                                see serve/shards.py)
     queue/claimed/<worker>/<id>.json   claimed by one worker (rename)
     queue/done/<id>.json               served (result in the cache)
     queue/failed/<id>.json             exhausted its attempts
+
+``pending/`` is sharded into ``P = PYABC_TPU_SERVE_PARTITIONS``
+per-partition directories keyed by the study digest
+(``serve/shards.py``), so claim scans and rename contention are
+O(depth/P); ``claim()`` walks partitions in a worker-rotated order
+and takes the best aged-priority candidate from the first non-empty
+partition — strict priority order holds *within* a partition,
+cross-partition order is approximate but starvation-free (aging still
+accrues wherever a ticket sits, and the rotation revisits every
+partition).  A pre-partition flat queue is upgraded in place on first
+touch (:func:`~pyabc_tpu.serve.shards.migrate_layout`), and flat
+stragglers are still scanned last, so no layout mix loses tickets.
 
 Crash-safety semantics, precisely:
 
@@ -40,9 +54,11 @@ Crash-safety semantics, precisely:
   and a dead worker's claims lapse deterministically.
 - ``done``/``failed`` tickets are tombstones: the pickled spec (the
   payload's bulk) is stripped on arrival, and
-  :meth:`~StudyQueue.sweep` (called from the worker's idle loop)
-  reaps tombstones older than ``PYABC_TPU_SERVE_RETAIN_S`` so a
-  long-lived serve root stays bounded.
+  :meth:`~StudyQueue.sweep` (called from every ``Scheduler.tick()``,
+  with the worker idle loop as a fallback on scheduler-less
+  deployments) reaps tombstones older than
+  ``PYABC_TPU_SERVE_RETAIN_S`` so a long-lived serve root stays
+  bounded even on a fleet that never idles.
 
 Admission enforces *backpressure* (``PYABC_TPU_SERVE_MAX_DEPTH``
 pending studies total → :class:`QueueFull`) and *per-tenant quotas*
@@ -86,6 +102,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..telemetry.metrics import REGISTRY
+from . import shards
 from .spec import StudySpec, study_digest
 
 #: serve root (queue + cache persistence); default <run dir>/serve
@@ -256,7 +273,9 @@ class StudyQueue:
                  max_depth: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
                  aging_s: Optional[float] = None,
-                 lease_s: Optional[float] = None):
+                 lease_s: Optional[float] = None,
+                 partitions: Optional[int] = None,
+                 admission=None):
         self.root = os.path.join(serve_root(root), "queue")
         self.max_depth = (_env_int(MAX_DEPTH_ENV, _DEFAULT_MAX_DEPTH)
                           if max_depth is None else int(max_depth))
@@ -267,15 +286,67 @@ class StudyQueue:
                         if aging_s is None else float(aging_s))
         self.lease_s = (lease_s_default() if lease_s is None
                         else float(lease_s))
+        self.partitions = (shards.partitions_default()
+                           if partitions is None
+                           else max(int(partitions), 1))
         for state in ("pending", "claimed", "done", "failed"):
             os.makedirs(os.path.join(self.root, state), exist_ok=True)
+        for i in range(self.partitions):
+            os.makedirs(self._partition_dir(i), exist_ok=True)
+        self.migrate_layout()
+        if admission is None:
+            # lazy import: admission subclasses this module's QueueFull
+            from .admission import AdmissionController
+            admission = AdmissionController(os.path.dirname(self.root))
+        self.admission = admission
+        self._claim_salt = 0
 
     # ---- introspection ---------------------------------------------------
 
     def _dir(self, state: str) -> str:
         return os.path.join(self.root, state)
 
+    def _partition_dir(self, index: int) -> str:
+        return os.path.join(self._dir("pending"),
+                            shards.partition_name(index))
+
+    def _pending_dirs(self) -> List[str]:
+        """Every pending location a ticket can live in: each existing
+        partition directory (whatever P wrote it), then the flat
+        ``pending/`` root itself for pre-partition stragglers."""
+        return shards.partition_dirs(self._dir("pending")) + [
+            self._dir("pending")]
+
+    def migrate_layout(self) -> int:
+        """Upgrade a pre-partition flat queue in place (one atomic
+        rename per ticket — see :func:`serve.shards.migrate_layout`);
+        a no-op on an already-sharded or empty queue."""
+        return shards.migrate_layout(self._dir("pending"),
+                                     self.partitions)
+
+    def _list_dir(self, dirpath: str) -> List[Ticket]:
+        try:
+            names = sorted(os.listdir(dirpath))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(dirpath, name)
+            if not os.path.isfile(path):
+                continue
+            t = _ticket_from_file(path)
+            if t is not None:
+                out.append(t)
+        return out
+
     def _list(self, state: str) -> List[Ticket]:
+        if state == "pending":
+            out = []
+            for d in self._pending_dirs():
+                out.extend(self._list_dir(d))
+            return out
         out = []
         base = self._dir(state)
         walk = ([(base, None, sorted(os.listdir(base)))] if state
@@ -360,9 +431,25 @@ class StudyQueue:
         return [t for t in self.claimed()
                 if self.lease_age_s(t, now=now) > lease_s]
 
+    def _dir_depth(self, dirpath: str) -> int:
+        try:
+            return sum(1 for n in os.listdir(dirpath)
+                       if n.endswith(".json")
+                       and os.path.isfile(os.path.join(dirpath, n)))
+        except OSError:
+            return 0
+
     def depth(self) -> int:
-        return sum(1 for n in os.listdir(self._dir("pending"))
-                   if n.endswith(".json"))
+        return sum(self._dir_depth(d) for d in self._pending_dirs())
+
+    def partition_depth(self, index: int) -> int:
+        return self._dir_depth(self._partition_dir(index))
+
+    def partition_depths(self) -> List[int]:
+        """Pending count per configured partition (index-aligned).
+        Flat stragglers and foreign-P partitions are not included —
+        :meth:`depth` is the total."""
+        return [self.partition_depth(i) for i in range(self.partitions)]
 
     def stats(self) -> dict:
         per_tenant: dict = {}
@@ -380,6 +467,8 @@ class StudyQueue:
             "tenant_quota": self.tenant_quota,
             "aging_s": self.aging_s,
             "lease_s": self.lease_s,
+            "partitions": self.partitions,
+            "partition_depths": self.partition_depths(),
             "pending_by_tenant": per_tenant,
         }
 
@@ -408,6 +497,13 @@ class StudyQueue:
             raise TenantQuotaExceeded(
                 f"tenant {tenant!r} at quota {self.tenant_quota}")
         digest = study_digest(spec)
+        partition = shards.partition_of(digest, self.partitions)
+        if self.admission is not None and self.admission.enabled():
+            # SLO load-shedding (serve/admission.py): distinct from the
+            # depth/quota rejections above — raises ServeOverloaded
+            # with a computed retry_after_s
+            self.admission.check(self.partition_depth(partition),
+                                 partition=partition)
         sid = f"{time.time_ns():019d}-{digest[:12]}-{uuid.uuid4().hex[:8]}"
         payload = {
             "id": sid,
@@ -422,7 +518,9 @@ class StudyQueue:
         key = _hmac_key()
         if key is not None:
             payload["spec_hmac"] = _sign_spec(key, payload["spec_b64"])
-        path = os.path.join(self._dir("pending"), f"{sid}.json")
+        pdir = self._partition_dir(partition)
+        os.makedirs(pdir, exist_ok=True)
+        path = os.path.join(pdir, f"{sid}.json")
         self._write_atomic(path, payload)
         REGISTRY.counter(
             "serve_queue_submitted_total",
@@ -457,33 +555,50 @@ class StudyQueue:
         A pending file whose id already reached ``done``/``failed`` is
         a requeued duplicate of a settled study (a partitioned worker
         completed it after the scheduler bounced it): it is reaped
-        here, never served twice."""
+        here, never served twice.
+
+        The scan is sharded (``serve/shards.py``): partitions are
+        walked in this worker's rotated order and the claim goes to
+        the best aged-priority candidate in the FIRST non-empty
+        partition — O(depth/P) per claim, strict priority order
+        within a partition, approximate across partitions (the
+        rotation advances each call so no partition is camped on, and
+        aging accrues wherever a ticket waits).  Foreign-P partition
+        directories and flat pre-partition stragglers are scanned
+        last, so a mixed layout still drains."""
         worker_id = worker_id or default_worker_id()
         wdir = os.path.join(self._dir("claimed"), worker_id)
         os.makedirs(wdir, exist_ok=True)
         now = time.time()
-        candidates = sorted(
-            self.pending(),
-            key=lambda t: (-t.effective_priority(self.aging_s, now),
-                           t.submitted_unix, t.id))
-        for t in candidates:
-            if any(os.path.exists(os.path.join(
-                    self._dir(state), f"{t.id}.json"))
-                    for state in ("done", "failed")):
+        order = shards.rotation(self.partitions, worker_id,
+                                self._claim_salt)
+        self._claim_salt += 1
+        scan = [self._partition_dir(i) for i in order]
+        seen = set(scan)
+        scan.extend(d for d in self._pending_dirs() if d not in seen)
+        for dirpath in scan:
+            candidates = sorted(
+                self._list_dir(dirpath),
+                key=lambda t: (-t.effective_priority(self.aging_s, now),
+                               t.submitted_unix, t.id))
+            for t in candidates:
+                if any(os.path.exists(os.path.join(
+                        self._dir(state), f"{t.id}.json"))
+                        for state in ("done", "failed")):
+                    try:
+                        os.unlink(t.path)
+                    except OSError:
+                        pass
+                    continue
+                dest = os.path.join(wdir, os.path.basename(t.path))
                 try:
-                    os.unlink(t.path)
+                    os.utime(t.path, None)  # lease stamp, THEN rename
+                    os.rename(t.path, dest)
                 except OSError:
-                    pass
-                continue
-            dest = os.path.join(wdir, os.path.basename(t.path))
-            try:
-                os.utime(t.path, None)  # lease stamp, THEN the rename
-                os.rename(t.path, dest)
-            except OSError:
-                continue  # another worker won this one
-            t.path = dest
-            t.worker = worker_id
-            return t
+                    continue  # another worker won this one
+                t.path = dest
+                t.worker = worker_id
+                return t
         return None
 
     def _move(self, ticket: Ticket, state: str, extra: dict) -> str:
@@ -561,7 +676,13 @@ class StudyQueue:
                         "error": payload["last_error"],
                         "requeued_unix": time.time()})
         payload["bounce_history"] = history[-32:]  # bounded breadcrumb
-        dest = os.path.join(self._dir("pending"), f"{ticket.id}.json")
+        # partition-aware: the bounce returns to the SAME partition the
+        # digest keys to (pure function — every requeuer converges on
+        # one destination path, so a double requeue still overwrites)
+        pdir = self._partition_dir(
+            shards.partition_of(ticket.digest, self.partitions))
+        os.makedirs(pdir, exist_ok=True)
+        dest = os.path.join(pdir, f"{ticket.id}.json")
         self._write_atomic(dest, payload)
         if ticket.path and os.path.exists(ticket.path):
             try:
@@ -625,8 +746,10 @@ class StudyQueue:
         """Reap ``done``/``failed`` tombstones older than the
         retention window (``PYABC_TPU_SERVE_RETAIN_S``, default 1 h;
         ``0`` disables) so a long-lived serve root stays bounded and
-        :meth:`stats` stays cheap.  Called from the worker's idle
-        loop; safe to run from any process on the mount."""
+        :meth:`stats` stays cheap.  Called from every scheduler tick
+        (a busy fleet never idles, so the worker's idle-loop call —
+        kept as a fallback for scheduler-less deployments — cannot be
+        the only GC); safe to run from any process on the mount."""
         if retain_s is None:
             try:
                 retain_s = float(os.environ.get(

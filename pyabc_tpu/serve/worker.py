@@ -53,7 +53,8 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..telemetry.metrics import REGISTRY
-from .cache import StudyCache
+from .admission import publish_latency_snapshot
+from .cache import StudyCache, TieredStudyCache
 from .multiplex import (STOP_NAMES, StudyBatch, lane_eligible,
                         multiplex_eligible, multiplex_width)
 from .queue import StudyQueue, Ticket, default_worker_id, serve_root
@@ -97,8 +98,16 @@ class ServeWorker:
                  durable: Optional[bool] = None):
         self.root = serve_root(root)
         self.worker_id = worker_id or default_worker_id()
-        self.cache = cache if cache is not None else StudyCache(
-            root=os.path.join(self.root, "cache"))
+        if cache is None:
+            # two-tier default (docs/serving.md "Data plane"): the
+            # tier-1 spill is worker-private (restart warmth), the
+            # tier-2 store is shared across the fleet (any worker
+            # serves any worker's duplicates)
+            safe = _TENANT_SAFE.sub("_", self.worker_id)[:64]
+            cache = TieredStudyCache(
+                root=os.path.join(self.root, "cache", "t1", safe),
+                shared_root=os.path.join(self.root, "cache", "shared"))
+        self.cache = cache
         self.max_engines = max(int(max_engines), 1)
         self.run_mode = run_mode
         #: durable solo studies (``PYABC_TPU_SERVE_DURABLE``): misses
@@ -112,6 +121,7 @@ class ServeWorker:
         self._draining = threading.Event()
         self.served = 0
         self.walls_ms: List[float] = []
+        self._last_slo_pub = 0.0
 
     # ---- engine routing --------------------------------------------------
 
@@ -129,6 +139,20 @@ class ServeWorker:
         with different multiplex knobs sharing this serve root misses
         rather than aliasing."""
         return f"{digest}.{engine}"
+
+    def _cache_lookup(self, key: str):
+        """Tier-labelled cache probe: ``(summary, served_from)`` where
+        ``served_from`` is ``"cache"`` for a tier-1 hit, ``"cache_t2"``
+        for a shared-store hit, ``None`` on a miss.  Degrades to a
+        plain probe when the injected cache has no tiers."""
+        lookup = getattr(self.cache, "lookup", None)
+        if lookup is None:
+            hit = self.cache.get(key)
+            return hit, ("cache" if hit is not None else None)
+        hit, tier = lookup(key)
+        if hit is None:
+            return None, None
+        return hit, ("cache_t2" if tier == "t2" else "cache")
 
     # ---- engine pool -----------------------------------------------------
 
@@ -200,10 +224,10 @@ class ServeWorker:
         t0 = time.perf_counter()
         digest = study_digest(spec)
         engine = self._engine_of(spec)
-        hit = self.cache.get(self._cache_key(digest, engine))
+        hit, tier = self._cache_lookup(self._cache_key(digest, engine))
         if hit is not None:
             return self._finish(spec, hit, time.perf_counter() - t0,
-                                "cache")
+                                tier)
         summary = self._dispatch_miss(spec, digest, engine)
         return self._finish(spec, summary, time.perf_counter() - t0,
                             engine)
@@ -378,11 +402,11 @@ class ServeWorker:
                 # than dispatching the same study twice
                 waiters.append((i, spec, digest))
                 continue
-            hit = self.cache.get(
+            hit, tier = self._cache_lookup(
                 self._cache_key(digest, self._engine_of(spec)))
             if hit is not None:
                 out[i] = self._finish(
-                    spec, hit, time.perf_counter() - t0, "cache")
+                    spec, hit, time.perf_counter() - t0, tier)
             else:
                 seen_digests.add(digest)
                 misses.append((i, spec, digest))
@@ -415,10 +439,11 @@ class ServeWorker:
         for i, spec, digest in waiters:
             t0 = time.perf_counter()
             engine = self._engine_of(spec)
-            hit = self.cache.get(self._cache_key(digest, engine))
+            hit, tier = self._cache_lookup(
+                self._cache_key(digest, engine))
             if hit is not None:
                 out[i] = self._finish(
-                    spec, hit, time.perf_counter() - t0, "cache")
+                    spec, hit, time.perf_counter() - t0, tier)
             else:  # original evicted between put and here: serve it
                 summary = self._dispatch_miss(spec, digest, engine)
                 out[i] = self._finish(
@@ -443,6 +468,17 @@ class ServeWorker:
         REGISTRY.gauge("serve_queue_depth",
                        "pending studies in the serve queue"
                        ).set(queue.depth())
+        pdepths = queue.partition_depths()
+        REGISTRY.gauge("serve_partitions",
+                       "configured queue partitions (shard count)"
+                       ).set(queue.partitions)
+        REGISTRY.gauge("serve_partition_depth_max",
+                       "deepest queue partition (the hot shard)"
+                       ).set(max(pdepths) if pdepths else 0)
+        for i, d in enumerate(pdepths):
+            REGISTRY.gauge(
+                f"serve_partition_p{i:04d}_depth",
+                "pending studies in one queue partition").set(d)
         REGISTRY.gauge("serve_engines_warm",
                        "warm engines held by this worker"
                        ).set(len(self._engines))
@@ -450,6 +486,23 @@ class ServeWorker:
         REGISTRY.gauge("serve_cache_hit_ratio",
                        "study cache hit ratio since worker start"
                        ).set(round(stats["hit_ratio"], 4))
+        if "hit_ratio_t1" in stats:
+            REGISTRY.gauge(
+                "serve_cache_hit_ratio_t1",
+                "tier-1 (worker LRU) share of cache lookups"
+            ).set(round(stats["hit_ratio_t1"], 4))
+            REGISTRY.gauge(
+                "serve_cache_hit_ratio_t2",
+                "tier-2 (shared store) share of cache lookups"
+            ).set(round(stats["hit_ratio_t2"], 4))
+        # publish this worker's rolling served-latency snapshot for
+        # the admission controller's fleet-p99 read (throttled; a
+        # failed publish never fails a serve)
+        now = time.time()
+        if self.walls_ms and now - self._last_slo_pub >= 2.0:
+            publish_latency_snapshot(self.root, self.worker_id,
+                                     self.walls_ms)
+            self._last_slo_pub = now
 
     def run_forever(self, queue: Optional[StudyQueue] = None,
                     poll_s: float = 0.5,
@@ -486,7 +539,10 @@ class ServeWorker:
                 head = queue.claim(self.worker_id)
                 if head is None:
                     self._snapshot_gauges(queue)
-                    queue.sweep()  # idle housekeeping: done/failed GC
+                    # fallback GC for scheduler-less deployments; the
+                    # authoritative sweep runs from Scheduler.tick()
+                    # (a busy fleet never reaches this branch)
+                    queue.sweep()
                     if once:
                         break
                     time.sleep(poll_s)

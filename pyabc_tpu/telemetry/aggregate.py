@@ -375,8 +375,20 @@ def fleet_rollup(run_dir: str) -> Dict:
 #: max; everything else under serve_* is a counter and rolls up as sum
 _SERVE_GAUGES = frozenset({
     "serve_queue_depth", "serve_engines_warm", "serve_cache_hit_ratio",
+    "serve_cache_hit_ratio_t1", "serve_cache_hit_ratio_t2",
     "serve_last_study_ms", "serve_drain_requeued",
+    "serve_partitions", "serve_partition_depth_max",
 })
+
+
+def is_serve_gauge(key: str) -> bool:
+    """Whether a ``serve_*`` metric is a point-in-time gauge (fleet
+    max) rather than a counter (fleet sum).  Per-partition depth
+    gauges (``serve_partition_p<NNNN>_depth``) are name-generated, so
+    they match by shape rather than by set membership."""
+    return (key in _SERVE_GAUGES
+            or (key.startswith("serve_partition_p")
+                and key.endswith("_depth")))
 
 
 def _serve_rollup(metrics_rollup: Dict) -> Dict:
@@ -388,7 +400,7 @@ def _serve_rollup(metrics_rollup: Dict) -> Dict:
     for key, aggs in metrics_rollup.items():
         if not key.startswith("serve_"):
             continue
-        val = aggs["max" if key in _SERVE_GAUGES else "sum"]
+        val = aggs["max" if is_serve_gauge(key) else "sum"]
         out[key] = val
         if key.startswith("serve_tenant_") and key.endswith(
                 "_studies_total"):
@@ -404,7 +416,7 @@ _SCHED_GAUGES = frozenset({
     "sched_workers_alive", "sched_workers_dead",
     "sched_desired_replicas", "sched_queue_pending",
     "sched_queue_claimed", "sched_oldest_pending_s",
-    "sched_last_tick_ms",
+    "sched_last_tick_ms", "sched_platform_replicas",
 })
 
 

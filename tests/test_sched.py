@@ -360,7 +360,7 @@ def test_scheduler_tick_publishes_sched_metrics(tmp_path, monkeypatch):
 # chaos suite: deterministic fast subset tier-1, full soak slow
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("trial", ("freeze", "poison"))
+@pytest.mark.parametrize("trial", ("freeze", "poison", "shards"))
 def test_sched_chaos_fast_subset(trial, tmp_path, monkeypatch):
     _clean_env(monkeypatch)
     from tools.chaos_soak import SCHED_FAST_TRIALS, run_sched_trial
@@ -375,7 +375,7 @@ def test_sched_chaos_full_soak(tmp_path, monkeypatch):
     """The whole --sched suite, subprocess kill -9 and journal
     corruption included (slow: spawns JAX child processes)."""
     _clean_env(monkeypatch)
-    from tools.chaos_soak import sched_soak
+    from tools.chaos_soak import SCHED_TRIALS, sched_soak
     reports = sched_soak(workdir=str(tmp_path), seed=0)
-    assert len(reports) == 4
+    assert len(reports) == len(SCHED_TRIALS)
     assert sum(r["lost"] for r in reports) == 0

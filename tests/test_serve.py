@@ -86,8 +86,10 @@ def test_tenant_quota_isolates_tenants(tmp_path):
 
 
 def test_claim_orders_by_aged_priority(tmp_path):
-    # aging so slow it cannot matter: raw priority decides
-    q = StudyQueue(root=str(tmp_path), aging_s=1e9)
+    # aging so slow it cannot matter: raw priority decides.  ONE
+    # partition: the strict-order contract is per partition (claim
+    # order across partitions is rotation-approximate by design)
+    q = StudyQueue(root=str(tmp_path), aging_s=1e9, partitions=1)
     low = q.submit(_spec(seed=0, priority=0))
     high = q.submit(_spec(seed=1, priority=5))
     assert q.claim("w1").id == high.id
@@ -96,7 +98,7 @@ def test_claim_orders_by_aged_priority(tmp_path):
 
 
 def test_aging_lets_old_low_priority_win(tmp_path):
-    q = StudyQueue(root=str(tmp_path), aging_s=30.0)
+    q = StudyQueue(root=str(tmp_path), aging_s=30.0, partitions=1)
     old = q.submit(_spec(seed=0, priority=0))
     q.submit(_spec(seed=1, priority=5))
     # age the low-priority ticket by 10 aging intervals on disk —
@@ -132,6 +134,122 @@ def test_requeue_worker_sweeps_all_claims(tmp_path):
     assert q.requeue_worker("w1") == 2
     assert q.depth() == 2
     assert q.requeue_worker("w1") == 0
+
+
+# ---------------------------------------------------------------------------
+# sharded queue + admission shedding
+# ---------------------------------------------------------------------------
+
+def test_sharded_placement_is_digest_stable(tmp_path):
+    """Every pending ticket lives in exactly the partition its digest
+    hashes to — and equal content ALWAYS lands in the same partition
+    (the locality the tier-2 cache and hot-bucket shedding rely on)."""
+    from pyabc_tpu.serve import shards
+    q = StudyQueue(root=str(tmp_path), partitions=4)
+    specs = [_spec(seed=s, tenant=f"t{s % 2}") for s in range(8)]
+    for spec in specs:
+        t = q.submit(spec)
+        part = shards.partition_of(study_digest(spec), q.partitions)
+        assert os.path.exists(os.path.join(
+            q.root, "pending", shards.partition_name(part),
+            f"{t.id}.json"))
+    assert q.depth() == 8
+    assert sum(q.partition_depths()) == 8
+    # same digest, fresh submission (new id): same partition
+    dup = _spec(seed=0, tenant="t0")
+    t2 = q.submit(dup)
+    part = shards.partition_of(study_digest(dup), q.partitions)
+    assert os.path.exists(os.path.join(
+        q.root, "pending", shards.partition_name(part),
+        f"{t2.id}.json"))
+
+
+def test_sharded_claim_never_double_claims(tmp_path):
+    """Two workers draining a sharded queue see disjoint tickets and
+    between them see EVERY ticket (rename atomicity per partition)."""
+    q = StudyQueue(root=str(tmp_path), partitions=4)
+    submitted = {q.submit(_spec(seed=s)).id for s in range(10)}
+    got = {"wa": set(), "wb": set()}
+    while True:
+        before = sum(len(v) for v in got.values())
+        for wid in got:
+            t = q.claim(wid)
+            if t is not None:
+                got[wid].add(t.id)
+        if sum(len(v) for v in got.values()) == before:
+            break
+    assert not got["wa"] & got["wb"]
+    assert got["wa"] | got["wb"] == submitted
+
+
+def test_migrate_layout_loses_zero_tickets(tmp_path):
+    """A flat (pre-sharding) pending/ layout is migrated into
+    partition dirs losing nothing, and an in-progress submission (a
+    .tmp not yet renamed) is left alone rather than destroyed."""
+    q = StudyQueue(root=str(tmp_path), partitions=4)
+    tickets = [q.submit(_spec(seed=s)) for s in range(6)]
+    # rewind the layout: drop every ticket back into the flat root
+    for t in tickets:
+        for sub in os.listdir(os.path.join(q.root, "pending")):
+            p = os.path.join(q.root, "pending", sub, f"{t.id}.json")
+            if os.path.exists(p):
+                os.rename(p, os.path.join(q.root, "pending",
+                                          f"{t.id}.json"))
+    torn = os.path.join(q.root, "pending", "torn.json.tmp")
+    with open(torn, "w", encoding="utf-8") as f:
+        f.write("{not json")
+    assert q.migrate_layout() == 6
+    assert q.depth() == 6
+    assert os.path.exists(torn)  # skipped, not eaten
+    drained = set()
+    while True:
+        t = q.claim("w1")
+        if t is None:
+            break
+        drained.add(t.id)
+    assert drained == {t.id for t in tickets}
+
+
+def test_shed_is_distinct_from_quota(tmp_path):
+    """Depth shedding raises ServeOverloaded (a QueueFull subclass,
+    NOT a tenant-quota error) with a computed retry_after_s scaled by
+    the overload ratio."""
+    from pyabc_tpu.serve import AdmissionController, ServeOverloaded
+    q = StudyQueue(root=str(tmp_path), partitions=1,
+                   admission=AdmissionController(
+                       str(tmp_path), slo_depth=2, retry_s=2.0))
+    q.submit(_spec(seed=0))
+    q.submit(_spec(seed=1))
+    with pytest.raises(ServeOverloaded) as err:
+        q.submit(_spec(seed=2))
+    assert isinstance(err.value, QueueFull)
+    assert not isinstance(err.value, TenantQuotaExceeded)
+    assert err.value.reason == "depth"
+    assert err.value.retry_after_s == pytest.approx(2.0)
+    assert q.depth() == 2
+    # drain below the SLO: admission opens again
+    assert q.claim("w1") is not None
+    q.submit(_spec(seed=2))
+
+
+def test_p99_shed_reads_fleet_snapshots(tmp_path):
+    """Latency shedding closes the loop on the workers' published
+    rolling p99 — and ignores stale snapshots from dead workers."""
+    from pyabc_tpu.serve.admission import (AdmissionController,
+                                           ServeOverloaded,
+                                           publish_latency_snapshot)
+    root = str(tmp_path)
+    adm = AdmissionController(root, slo_p99_ms=100.0, retry_s=1.0)
+    adm.check(0)  # no snapshots: no shed
+    publish_latency_snapshot(root, "w_slow", [250.0] * 20)
+    with pytest.raises(ServeOverloaded) as err:
+        adm.check(0)
+    assert err.value.reason == "p99"
+    assert err.value.retry_after_s == pytest.approx(2.5)
+    # the slow worker dies; its last word goes stale and stops mattering
+    publish_latency_snapshot(root, "w_slow", [250.0] * 20,
+                             now=time.time() - 3600)
+    adm.check(0)
 
 
 def test_serve_root_resolution(tmp_path, monkeypatch):
@@ -182,6 +300,66 @@ def test_cache_hit_miss_eviction_and_disk_spill(tmp_path):
     # a fresh cache over the same root re-hits from the JSON spill
     again = StudyCache(capacity=2, root=str(tmp_path))
     assert again.get("b" * 64) == {"x": 2}
+
+
+def test_spill_corruption_degrades_to_miss(tmp_path):
+    """A torn/bit-rotted tier-1 spill is detected by its CRC frame and
+    degrades to a miss (recompute), never a crash or a wrong result."""
+    cache = StudyCache(capacity=4, root=str(tmp_path))
+    cache.put("a" * 64, {"x": 1})
+    cache.put("b" * 64, {"x": 2})
+    (spill_a,) = [p for p in os.listdir(str(tmp_path))
+                  if p.startswith("a")]
+    with open(os.path.join(str(tmp_path), spill_a), "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(size // 2)
+        f.write(b"\xff\xff\xff\xff")
+    fresh = StudyCache(capacity=4, root=str(tmp_path))
+    assert fresh.get("a" * 64) is None  # corrupt: miss, file reaped
+    assert fresh.get("b" * 64) == {"x": 2}  # intact neighbor survives
+    assert not os.path.exists(os.path.join(str(tmp_path), spill_a))
+
+
+def test_shared_store_single_writer_and_crc(tmp_path):
+    """Tier-2 publish is first-writer-wins (a racing duplicate is a
+    counted collision, not an overwrite) and reads are CRC-verified."""
+    from pyabc_tpu.serve.cache import SharedResultStore
+    store = SharedResultStore(str(tmp_path))
+    assert store.publish("k" * 64, {"mean": 1.0})
+    assert not store.publish("k" * 64, {"mean": 2.0})  # collision
+    assert store.get("k" * 64) == {"mean": 1.0}  # first writer kept
+    ok, corrupt = store.verify_all()
+    assert (ok, corrupt) == (1, 0)
+    # bit-rot the entry: the CRC catches it and the read degrades to
+    # a miss (dispatch fallback), reaping the bad file
+    (entry,) = [p for p in os.listdir(str(tmp_path))
+                if p.endswith(".json")]
+    path = os.path.join(str(tmp_path), entry)
+    with open(path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff")
+    assert store.get("k" * 64) is None
+    assert not os.path.exists(path)
+
+
+def test_tiered_cache_promotes_t2_hits(tmp_path):
+    """A tier-2 hit is promoted into tier-1: the second lookup of the
+    same key is a local LRU hit with no shared-store read."""
+    from pyabc_tpu.serve.cache import TieredStudyCache
+    shared = str(tmp_path / "shared")
+    a = TieredStudyCache(capacity=8, root=str(tmp_path / "a"),
+                         shared_root=shared)
+    b = TieredStudyCache(capacity=8, root=str(tmp_path / "b"),
+                         shared_root=shared)
+    a.put("k" * 64, {"mean": 3.0})
+    summary, tier = b.lookup("k" * 64)
+    assert (summary, tier) == ({"mean": 3.0}, "t2")
+    summary, tier = b.lookup("k" * 64)
+    assert (summary, tier) == ({"mean": 3.0}, "t1")
+    stats = b.stats()
+    assert stats["t2_hits"] == 1 and stats["t1_hits"] == 1
+    assert b.lookup("z" * 64) == (None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +426,30 @@ def test_duplicate_served_from_cache_without_dispatch(tmp_path):
     assert again["served_from"] == "cache"
     assert again["posterior_mean"] == first["posterior_mean"]
     assert worker.cache.stats()["hits"] >= 1
+
+
+def test_cross_worker_warm_hit_via_tier2(tmp_path):
+    """The fleet-wide dedup contract: worker A completes a study and
+    publishes to the shared tier-2 store; worker B — which has NEVER
+    seen the digest — serves the duplicate from tier-2 with ZERO
+    dispatches, bitwise equal, and promotes it into its own tier-1."""
+    a = ServeWorker(root=str(tmp_path), worker_id="wa")
+    first = a.serve_spec(_spec(pop=100, seed=0))
+    assert first["served_from"] == "multiplex"
+    b = ServeWorker(root=str(tmp_path), worker_id="wb")
+
+    def _boom(*_a, **_k):
+        raise AssertionError("tier-2 duplicate dispatched")
+    b._solo_summary = _boom
+    b._run_batch = _boom
+    warm = b.serve_spec(_spec(pop=100, seed=0))
+    assert warm["served_from"] == "cache_t2"
+    assert warm["posterior_mean"] == first["posterior_mean"]
+    # promoted: the next duplicate is a LOCAL tier-1 hit on B
+    again = b.serve_spec(_spec(pop=100, seed=0))
+    assert again["served_from"] == "cache"
+    stats = b.cache.stats()
+    assert stats["t2_hits"] == 1 and stats["t1_hits"] >= 1
 
 
 def test_warm_worker_zero_recompiles_after_first(tmp_path, monkeypatch):

@@ -1,0 +1,187 @@
+"""Tier-1 gate for the data-plane fan-out: the closed-loop load
+generator (``tools/loadgen.py``) and the subprocess worker platform
+(``pyabc_tpu/sched/platform.py``).
+
+The slow/expensive fleet runs live in ``bench.py bench_serve_load``
+(two platform-managed worker PROCESSES, >=1e4 studies) and the chaos
+soak (``--sched`` ``platform`` trial); these tests pin the same
+contracts at toy scale:
+
+- the load generator drives the REAL submit path (queue -> partition
+  -> claim -> tombstone), measures end-to-end latency, derives the
+  cache-tier split from the tombstones' ``engine`` field, and counts
+  sheds separately from quota rejections;
+- the platform's 3-method interface converges the process set to the
+  desired count, SIGTERM-drains the newest on scale-down, counts
+  crashes and backs off before respawning.
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     os.pardir))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import pyabc_tpu as pt  # noqa: E402
+from pyabc_tpu.sched.platform import SubprocessPlatform  # noqa: E402
+from pyabc_tpu.serve import (ServeWorker, StudyQueue,  # noqa: E402
+                             StudySpec)
+
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+from loadgen import ClosedLoopLoadGen  # noqa: E402
+
+
+def _model(key, theta):
+    import jax
+    noise = 0.1 * jax.random.normal(key, (theta.shape[0], 1))
+    return {"y": theta[:, :1] + noise}
+
+
+def _spec(pop=100, seed=0, y=0.4):
+    return StudySpec(
+        model=_model,
+        prior=pt.Distribution(mu=pt.RV("uniform", -1.0, 2.0)),
+        observed={"y": float(y)}, population_size=pop,
+        seed=seed, tenant="load", max_generations=2)
+
+
+# ---------------------------------------------------------------------------
+# closed-loop load generator
+# ---------------------------------------------------------------------------
+
+def test_loadgen_closed_loop_end_to_end(tmp_path):
+    """A small closed-loop run against one in-process worker: every
+    study settles, latency percentiles are positive, and the
+    duplicate-heavy pool shows up as tier-1 cache hits in the report
+    (derived from the done tombstones, not worker internals)."""
+    root = str(tmp_path)
+    queue = StudyQueue(root=root)
+    worker = ServeWorker(root=root, worker_id="w_load")
+    t = threading.Thread(
+        target=worker.run_forever, args=(queue,),
+        kwargs={"poll_s": 0.01}, daemon=True)
+    t.start()
+    try:
+        pool = [_spec(seed=s) for s in range(3)]
+        gen = ClosedLoopLoadGen(queue, pool, n_studies=12, clients=4,
+                                seed=7, study_timeout_s=120.0)
+        report = gen.run()
+    finally:
+        worker.drain()
+        t.join(timeout=30.0)
+    assert report["completed"] == 12
+    assert report["failed"] == 0 and report["timeouts"] == 0
+    assert report["studies_per_s"] > 0
+    assert 0 < report["p50_ms"] <= report["p99_ms"]
+    # 12 draws from a 3-spec pool: most are served without a dispatch
+    # (the first wave of concurrent distinct submissions is not)
+    assert report["cache_hit_tier1"] >= 0.5
+    assert report["shed_rate"] == 0.0
+    assert queue.stats()["done"] == 12
+
+
+def test_loadgen_counts_sheds_separately(tmp_path):
+    """With a 1-deep SLO and nobody draining, the generator records
+    sheds (honoring retry_after_s) and times the studies out — sheds
+    are not failures and not quota rejections."""
+    from pyabc_tpu.serve import AdmissionController
+    root = str(tmp_path)
+    queue = StudyQueue(root=root, partitions=1,
+                       admission=AdmissionController(
+                           root, slo_depth=1, retry_s=0.01))
+    gen = ClosedLoopLoadGen(queue, [_spec(seed=s) for s in range(4)],
+                            n_studies=4, clients=2, seed=3,
+                            study_timeout_s=1.0)
+    report = gen.run()
+    assert report["completed"] == 0
+    assert report["sheds"] > 0
+    assert report["shed_rate"] > 0
+    assert report["rejected"] == 0  # sheds, not quota rejections
+    assert report["timeouts"] + report["sheds"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# subprocess worker platform
+# ---------------------------------------------------------------------------
+
+def _idle_platform(tmp_path, backoff_s=0.05):
+    """A platform whose 'workers' are inert sleepers — the process
+    lifecycle is under test, not the serving."""
+    return SubprocessPlatform(
+        serve_dir=str(tmp_path),
+        argv=[sys.executable, "-c",
+              "import signal, time\n"
+              "signal.signal(signal.SIGTERM,"
+              " lambda *_: exit(0))\n"
+              "time.sleep(600)"],
+        backoff_s=backoff_s)
+
+
+def test_platform_scales_up_and_down(tmp_path):
+    platform = _idle_platform(tmp_path)
+    try:
+        rep = platform.reconcile(2)
+        assert rep["started"] == 2 and rep["running"] == 2
+        assert platform.replicas() == 2
+        rep = platform.reconcile(1)  # SIGTERM-drains the newest
+        assert rep["stopped"] == 1
+        deadline = time.time() + 10.0
+        while time.time() < deadline and platform.replicas() > 1:
+            time.sleep(0.05)
+        assert platform.replicas() == 1
+        # the drain exit is an asked-for exit, not a crash
+        assert platform.reconcile(1)["crashed"] == 0
+    finally:
+        platform.shutdown()
+    assert platform.replicas() == 0
+
+
+def test_platform_restarts_crashed_worker_with_backoff(tmp_path):
+    platform = _idle_platform(tmp_path, backoff_s=0.2)
+    try:
+        platform.reconcile(1)
+        victim = platform._procs[0].proc
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+        rep = platform.reconcile(1)
+        assert rep["crashed"] == 1
+        # inside the backoff window: no respawn yet
+        assert rep["started"] == 0 and rep["running"] == 0
+        assert rep["backoff_until_unix"] > 0
+        deadline = time.time() + 10.0
+        while time.time() < deadline and platform.replicas() < 1:
+            platform.reconcile(1)
+            time.sleep(0.05)
+        assert platform.replicas() == 1  # respawned after backoff
+        pids = [m.proc.pid for m in platform._procs]
+        assert victim.pid not in pids
+    finally:
+        platform.shutdown()
+
+
+def test_scheduler_tick_drives_platform(tmp_path):
+    """Scheduler.tick() hands the autoscaler's desired count to the
+    platform and reports the reconcile accounting."""
+    from pyabc_tpu.sched import Scheduler
+    from pyabc_tpu.sched.autoscale import Autoscaler
+    queue = StudyQueue(root=str(tmp_path))
+    platform = _idle_platform(tmp_path)
+    sched = Scheduler(
+        run_dir=None, queue=queue,
+        autoscaler=Autoscaler(min_replicas=2, max_replicas=2),
+        platform=platform)
+    try:
+        rep = sched.tick()
+        assert rep["desired_replicas"] == 2
+        assert rep["platform"]["started"] == 2
+        assert rep["platform"]["running"] == 2
+        assert "swept" in rep  # tombstone GC moved into the tick
+    finally:
+        platform.shutdown()

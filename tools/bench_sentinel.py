@@ -108,6 +108,24 @@ WATCHED = (
     # renames), so the wide relative slack absorbs shared-filesystem
     # jitter while an O(lease) or O(poll) stall still blows through
     ("sched_reschedule_p99_ms", "lower", 1.00),
+    # data-plane fleet rows (bench_serve_load: closed-loop loadgen
+    # over 2 platform-managed workers + the sharded queue + the
+    # two-tier cache).  Throughput fails low when partition claim
+    # scans, cache publishes or the platform loop regress; the
+    # end-to-end p99 fails high with wide slack (it prices fs renames
+    # + polling, noisy on shared mounts) — a queue-scan or cache-miss
+    # regression is multiplicative and still blows through
+    ("serve_load_studies_per_s", "higher", 0.30),
+    ("serve_load_p99_ms", "lower", 1.00),
+    # a healthy fleet at the bench's arrival rate sheds ~nothing; any
+    # sustained shed rate means admission is firing in steady state
+    # (reference ~0, so the absolute floor carries the row)
+    ("serve_load_shed_rate", "lower", 1.00),
+    # the duplicate-heavy mix pins the tier split: tier-1 dropping
+    # means per-worker LRU/digest drift, the tier-2 row guards the
+    # cross-worker publish/read path staying alive at all
+    ("serve_load_cache_hit_tier1", "higher", 0.15),
+    ("serve_load_cache_hit_tier2", "higher", 0.80),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

@@ -41,6 +41,7 @@ from __future__ import annotations
 import argparse
 import os
 import random
+import signal
 import subprocess
 import sys
 import tempfile
@@ -441,8 +442,9 @@ SCHED_GENS = 4
 
 #: the deterministic ``--sched`` trial names; ``SCHED_FAST_TRIALS`` is
 #: the queue-level subset cheap enough for tier-1 (tests/test_sched.py)
-SCHED_TRIALS = ("kill9", "freeze", "corrupt", "poison")
-SCHED_FAST_TRIALS = ("freeze", "poison")
+SCHED_TRIALS = ("kill9", "freeze", "corrupt", "poison", "shards",
+                "platform")
+SCHED_FAST_TRIALS = ("freeze", "poison", "shards")
 
 _SCHED_CHILD = """
 import sys
@@ -721,6 +723,155 @@ def run_sched_trial(name: str, workdir: str, seed: int = 0) -> dict:
                 tomb["flight_path"]), (
                     "flight dump missing from tombstone")
             report["lost"] = _sched_conservation(queue, 1)
+
+    elif name == "shards":
+        # sharded-queue invariants under churn: partition-stable
+        # placement, no cross-worker double-claim, lease-lapse requeue
+        # landing back in the digest's partition, and a flat->sharded
+        # layout migration losing zero tickets — all queue-level, so
+        # this trial is cheap enough for the tier-1 fast subset
+        from pyabc_tpu.serve import shards as _shards
+        from pyabc_tpu.serve.spec import study_digest
+
+        def _pending_path(q, digest, ticket_id):
+            part = _shards.partition_of(digest, q.partitions)
+            return os.path.join(q.root, "pending",
+                                _shards.partition_name(part),
+                                f"{ticket_id}.json")
+
+        with _SchedEnv():
+            queue = StudyQueue(root=root, lease_s=30.0, partitions=4)
+            specs = [_sched_spec(seed=400 + 16 * seed + i)
+                     for i in range(6)]
+            tickets = [queue.submit(s) for s in specs]
+            for s, t in zip(specs, tickets):
+                assert os.path.exists(
+                    _pending_path(queue, study_digest(s), t.id)), (
+                        "ticket not in its digest's partition")
+            claims = {"w_a": [], "w_b": []}
+            for wid in ("w_a", "w_b"):
+                for _ in range(3):
+                    t = queue.claim(wid)
+                    assert t is not None, f"{wid} starved"
+                    claims[wid].append(t)
+            ids_a = {t.id for t in claims["w_a"]}
+            ids_b = {t.id for t in claims["w_b"]}
+            assert not ids_a & ids_b, (
+                f"double-claim across workers: {ids_a & ids_b}")
+            assert queue.claim("w_c") is None, (
+                "claimed more tickets than were submitted")
+            # w_b dies: its leases lapse and the scheduler requeues
+            # every ticket back into its digest's partition
+            _rewind_lease(queue, "w_b")
+            sched = Scheduler(run_dir=None, queue=queue, max_bounces=3)
+            t0 = _time.perf_counter()
+            rep = sched.tick()
+            report["reschedule_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3)
+            assert sorted(rep["requeued"]) == sorted(ids_b), (
+                f"expected {sorted(ids_b)} requeued, got {rep}")
+            for t in claims["w_b"]:
+                assert os.path.exists(
+                    _pending_path(queue, t.digest, t.id)), (
+                        "requeued ticket left its digest's partition")
+            # a pre-sharding straggler in the FLAT pending root is
+            # picked up by migrate_layout() and stays claimable
+            t_flat = queue.submit(_sched_spec(seed=470 + seed))
+            src = _pending_path(queue, t_flat.digest, t_flat.id)
+            os.rename(src, os.path.join(queue.root, "pending",
+                                        f"{t_flat.id}.json"))
+            moved = queue.migrate_layout()
+            assert moved == 1 and os.path.exists(src), (
+                f"flat straggler not migrated (moved={moved})")
+            # a rescue worker drains the requeued + migrated tickets;
+            # w_a's live leases complete normally — nothing lost
+            drained = 0
+            while True:
+                t = queue.claim("w_rescue")
+                if t is None:
+                    break
+                queue.complete(t, wall_s=0.01, engine="solo")
+                drained += 1
+            assert drained == len(ids_b) + 1, (
+                f"rescue drained {drained}, expected {len(ids_b) + 1}")
+            for t in claims["w_a"]:
+                queue.complete(t, wall_s=0.01, engine="solo")
+            stats = queue.stats()
+            assert stats["done"] == 7 and stats["pending"] == 0, (
+                f"lost or duplicated tickets: {stats}")
+            report["lost"] = _sched_conservation(queue, 7)
+            report["recovered"] = True
+
+    elif name == "platform":
+        # the autoscale actuator under SIGKILL: a platform-spawned
+        # abc-serve worker is kill -9'd mid-study; reconcile counts
+        # the crash and respawns after backoff, the scheduler requeues
+        # the orphaned lease, and the respawned worker completes the
+        # study — zero lost, shared tier-2 store scans clean
+        from pyabc_tpu.sched.autoscale import Autoscaler
+        from pyabc_tpu.sched.platform import SubprocessPlatform
+        from pyabc_tpu.serve.cache import SharedResultStore
+        with _SchedEnv():
+            spec = _sched_spec(seed=500 + seed)
+            ticket = queue.submit(spec)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=_REPO)
+            env.pop("PYABC_TPU_RUN_DIR", None)
+            platform = SubprocessPlatform(
+                serve_dir=root,
+                argv=[sys.executable, "-m", "pyabc_tpu.serve.worker",
+                      "--serve-dir", root, "--poll-s", "0.05"],
+                env=env, backoff_s=0.2)
+            sched = Scheduler(
+                run_dir=None, queue=queue, max_bounces=3,
+                autoscaler=Autoscaler(min_replicas=1, max_replicas=1),
+                platform=platform)
+            try:
+                rep = sched.tick()
+                assert rep["platform"]["started"] == 1, (
+                    f"platform did not start a worker: {rep}")
+                deadline = _time.time() + 180.0
+                while (_time.time() < deadline
+                       and queue.stats()["claimed"] == 0):
+                    _time.sleep(0.2)
+                assert queue.stats()["claimed"] == 1, (
+                    "platform worker never claimed the study")
+                victim = platform._procs[0].proc
+                os.kill(victim.pid, signal.SIGKILL)
+                victim.wait(timeout=30)
+                rep = sched.tick()
+                assert rep["platform"]["crashed"] == 1, (
+                    f"crash not counted by reconcile: {rep}")
+                # the dead worker's lease lapses -> requeue with
+                # breadcrumbs (rewind instead of sleeping the TTL out)
+                (wid,) = os.listdir(os.path.join(queue.root,
+                                                 "claimed"))
+                _rewind_lease(queue, wid)
+                t0 = _time.perf_counter()
+                rep = sched.tick()
+                report["reschedule_ms"] = round(
+                    (_time.perf_counter() - t0) * 1e3, 3)
+                assert rep["requeued"] == [ticket.id], (
+                    f"orphaned lease not requeued: {rep}")
+                # past the backoff the platform respawns; the new
+                # worker claims the bounced ticket and completes it
+                while (_time.time() < deadline
+                       and queue.stats()["done"] == 0):
+                    sched.tick()
+                    _time.sleep(0.2)
+                stats = queue.stats()
+                assert stats["done"] == 1 and stats["failed"] == 0, (
+                    f"study not completed after respawn: {stats}")
+                report["recovered"] = True
+                report["lost"] = _sched_conservation(queue, 1)
+                store = SharedResultStore(
+                    os.path.join(root, "cache", "shared"))
+                ok, corrupt = store.verify_all()
+                assert corrupt == 0 and ok >= 1, (
+                    f"tier-2 store integrity: ok={ok} "
+                    f"corrupt={corrupt}")
+            finally:
+                platform.shutdown()
 
     else:
         raise ValueError(f"unknown sched trial {name!r}")
