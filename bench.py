@@ -969,6 +969,33 @@ def bench_serve_load():
         ticker.join(timeout=10.0)
         platform.shutdown()
     cache_stats = queue.stats()
+    # trace overhead: events actually logged per completed study ×
+    # a calibrated per-emit cost, expressed as % of the client p50 —
+    # the <2% tracing budget (docs/observability.md), sentinel row
+    # serve_trace_overhead_pct fails on an ABSOLUTE 2.0 ceiling
+    trace_lines = 0
+    troot = queue.trace.root
+    if os.path.isdir(troot):
+        for part in sorted(os.listdir(troot)):
+            pdir = os.path.join(troot, part)
+            for seg in os.listdir(pdir):
+                try:
+                    with open(os.path.join(pdir, seg), "rb") as f:
+                        trace_lines += sum(1 for _ in f)
+                except OSError:
+                    continue
+    from pyabc_tpu.serve.tracing import TraceLog
+    cal = TraceLog(tempfile.mkdtemp(prefix="trace_cal_"))
+    cal_id = cal.new_id()
+    n_cal = 200
+    t_cal = time.perf_counter()
+    for _ in range(n_cal):
+        cal.emit(cal_id, "queued", partition=0, ticket="cal")
+    per_emit_ms = (time.perf_counter() - t_cal) / n_cal * 1e3
+    completed = max(report["completed"], 1)
+    overhead_pct = (0.0 if not report["p50_ms"] else
+                    (trace_lines / completed) * per_emit_ms
+                    / report["p50_ms"] * 100.0)
     return {
         "serve_load_studies_per_s": report["studies_per_s"],
         "serve_load_p50_ms": report["p50_ms"],
@@ -984,6 +1011,11 @@ def bench_serve_load():
             cache_stats["partition_depths"] or [0]),
         "serve_load_clients": report["clients"],
         "serve_load_rate_hz": report["rate_hz"],
+        "serve_load_queue_wait_p99_ms": report["queue_wait_p99_ms"],
+        "serve_load_client_server_gap_ms":
+            report["client_server_gap_ms"],
+        "serve_trace_events_total": trace_lines,
+        "serve_trace_overhead_pct": round(overhead_pct, 4),
     }
 
 

@@ -261,6 +261,18 @@ def _render_top(run_dir) -> str:
                 f"t1_hit={sv('serve_cache_hit_ratio_t1'):.2f} "
                 f"t2_hit={sv('serve_cache_hit_ratio_t2'):.2f} "
                 f"shed={int(sv('serve_shed_total'))}")
+        # the SLO burn ledger (telemetry/studytrace.py): how many
+        # admitted studies finished over/under the latency SLO, and
+        # how many were shed instead of burned
+        over = sv("serve_slo_over_total")
+        under = sv("serve_slo_under_total")
+        if over or under:
+            admitted = over + under
+            lines.append(
+                f"  slo: p99_slo={sv('serve_slo_p99_ms'):g}ms "
+                f"over={int(over)} under={int(under)} "
+                f"burn={over / admitted if admitted else 0.0:.1%} "
+                f"shed={int(sv('serve_shed_total'))}")
         tenants = sorted(
             (k[len("serve_tenant_"):-len("_studies_total")], sv(k))
             for k in serve_vals
@@ -313,6 +325,32 @@ def _render_top(run_dir) -> str:
     return "\n".join(lines)
 
 
+def _render_study(serve_dir: str, key: str,
+                  export: "str | None" = None) -> str:
+    """The single-study trace view behind ``abc-top --study``: the
+    assembled lifecycle event list plus the critical-path waterfall
+    (docs/observability.md, "Tracing a study")."""
+    from ..telemetry import studytrace
+
+    trace = studytrace.StudyTrace.assemble(serve_dir, key)
+    if trace is None:
+        return (f"no trace matching {key!r} under {serve_dir}/trace "
+                "(tracing off, wrong serve dir, or already swept?)")
+    lines = studytrace.waterfall_text(trace)
+    lines.append("events:")
+    for rec in trace.events:
+        extra = " ".join(
+            f"{k}={rec[k]}" for k in sorted(rec)
+            if k not in ("trace_id", "event", "unix", "mono", "pid",
+                         "digest", "ticket"))
+        lines.append(f"  {rec.get('unix', 0.0):.6f} "
+                     f"{rec.get('event', '?'):<12s} {extra}")
+    if export:
+        lines.append(
+            f"chrome trace: {trace.write_chrome_trace(export)}")
+    return "\n".join(lines)
+
+
 @click.command("abc-top")
 @click.option("--run-dir", required=True,
               help="shared run dir the workers publish telemetry into")
@@ -321,12 +359,29 @@ def _render_top(run_dir) -> str:
 @click.option("--trace", is_flag=True, default=False,
               help="also write the merged fleet Chrome trace "
                    "(telemetry/fleet_trace.json) before rendering")
-def top(run_dir, watch, trace):
+@click.option("--study", default=None,
+              help="render ONE study's lifecycle trace instead of the "
+                   "fleet view: trace id, ticket id, or study digest")
+@click.option("--serve-dir", default=None,
+              help="serve root holding the trace log (default "
+                   "<run-dir>/serve, or $PYABC_TPU_SERVE_DIR)")
+@click.option("--export", default=None,
+              help="with --study: also write the trace as a Chrome-"
+                   "trace JSON file at this path")
+def top(run_dir, watch, trace, study, serve_dir, export):
     """Live fleet view over a run directory: per-host throughput,
     resilience ledger, engine decision and the recent generation tail —
-    the ``top(1)`` of an ABC fleet."""
+    the ``top(1)`` of an ABC fleet.  With ``--study``, the per-study
+    latency waterfall instead."""
     from ..telemetry import aggregate
 
+    if study:
+        import os as _os
+        if serve_dir is None:
+            serve_dir = _os.environ.get("PYABC_TPU_SERVE_DIR",
+                                        _os.path.join(run_dir, "serve"))
+        click.echo(_render_study(serve_dir, study, export=export))
+        return
     while True:
         if trace:
             path = aggregate.write_merged_trace(run_dir)

@@ -188,6 +188,16 @@ class Scheduler:
         # tombstone GC on the control loop (the worker idle-loop call
         # is only a fallback — a busy fleet never idles)
         report["swept"] = self.queue.sweep()
+        # GC the per-worker slo/ latency snapshots alongside the
+        # tombstones: a dead worker's last (often worst) p99 would
+        # otherwise pollute the fleet max for the rest of its
+        # freshness window and shed traffic a healthy fleet could
+        # take — and stale files accumulate forever as workers churn
+        from ..serve.admission import sweep_snapshots
+        report["slo_swept"] = sweep_snapshots(
+            os.path.dirname(self.queue.root), liveness=liveness)
+        # and the trace event log's expired segments
+        report["trace_swept"] = self.queue.trace.sweep()
         self._gauges(report, stats, oldest_s,
                      (time.perf_counter() - t0) * 1e3)
         if self._publisher is not None:

@@ -145,6 +145,59 @@ def fleet_p99_ms(root: str, now: Optional[float] = None) -> float:
     return worst
 
 
+def sweep_snapshots(root: str, liveness: Optional[dict] = None,
+                    now: Optional[float] = None,
+                    fresh_s: float = _SNAPSHOT_FRESH_S) -> int:
+    """GC ``slo/<worker>.json`` latency snapshots (scheduler tick).
+
+    Two reasons to unlink a snapshot, both real leaks the tombstone
+    sweep never covered: (a) its worker is DEAD by the fleet liveness
+    join — reaped immediately, because inside the freshness window a
+    just-died worker's last (often worst) p99 still pollutes the
+    fleet max and sheds traffic a healthy fleet could take; (b) it is
+    simply stale past ``fresh_s`` — already ignored by
+    :func:`fleet_p99_ms`, but accumulating forever on a long-lived
+    serve root as workers come and go.
+
+    ``liveness`` maps worker id (``<host>_<pid>``, the snapshot's
+    filename stem) → alive, the shape
+    ``sched.scheduler.worker_liveness`` returns; ``None`` skips the
+    dead-worker reap and only ages out stale files."""
+    sdir = _slo_dir(root)
+    now = time.time() if now is None else now
+    n = 0
+    try:
+        names = os.listdir(sdir)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(sdir, name)
+        worker = name[:-len(".json")]
+        dead = (liveness is not None and worker in liveness
+                and not liveness[worker])
+        stale = False
+        if not dead:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    snap = json.load(f)
+                stale = now - float(snap.get("ts", 0.0)) > fresh_s
+            except (OSError, ValueError, TypeError):
+                stale = True  # unreadable: reap it
+        if dead or stale:
+            try:
+                os.unlink(path)
+                n += 1
+            except OSError:
+                continue  # another sweeper won the race
+    if n:
+        REGISTRY.counter(
+            "serve_slo_snapshots_swept_total",
+            "dead/stale per-worker latency snapshots reaped").inc(n)
+    return n
+
+
 def _env_pos(name: str) -> Optional[float]:
     raw = os.environ.get(name)
     if not raw:
@@ -154,6 +207,13 @@ def _env_pos(name: str) -> Optional[float]:
     except ValueError:
         return None
     return val if val > 0 else None
+
+
+def slo_p99_ms_configured() -> Optional[float]:
+    """The configured end-to-end latency SLO
+    (``$PYABC_TPU_SERVE_SLO_P99_MS``), or ``None`` — shared by the
+    admission controller and the trace fold's SLO burn ledger."""
+    return _env_pos(SLO_P99_MS_ENV)
 
 
 class AdmissionController:

@@ -199,10 +199,13 @@ class StudyCache:
                 "study digests not found in the cache").inc()
             return None
 
-    def put(self, digest: str, summary: dict):
+    def put(self, digest: str, summary: dict) -> str:
+        """Insert; returns the tier the entry landed in (``"t1"`` —
+        the lifecycle trace's ``published(tier)`` field)."""
         with self._lock:
             self._insert_locked(digest, dict(summary))
         self._persist(digest, summary)
+        return "t1"
 
     def _insert_locked(self, digest: str, summary: dict):
         self._entries[digest] = summary
@@ -377,10 +380,14 @@ class TieredStudyCache:
     def get(self, key: str) -> Optional[dict]:
         return self.lookup(key)[0]
 
-    def put(self, key: str, summary: dict):
+    def put(self, key: str, summary: dict) -> str:
+        """Insert into t1 and publish to the shared tier; returns the
+        deepest tier reached (``"t2"`` when this call created the
+        shared entry, else ``"t1"``) for trace attribution."""
         self.t1.put(key, summary)
-        if self.t2 is not None:
-            self.t2.publish(key, summary)
+        if self.t2 is not None and self.t2.publish(key, summary):
+            return "t2"
+        return "t1"
 
     def stats(self) -> dict:
         s = self.t1.stats()

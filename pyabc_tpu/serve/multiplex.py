@@ -211,6 +211,17 @@ class StudyBatch:
             self.program_cache_hit = True
         self._fn = fn
 
+    def trace_info(self) -> dict:
+        """The batch attributes a lifecycle ``batched`` event carries
+        (serve/tracing.py): enough to explain, per study, which fused
+        program it rode and whether that program was already warm."""
+        return {
+            "batch_key": str(self.program_key[0])[:12],
+            "width": len(self.specs),
+            "rung": self.rung,
+            "program_cache_hit": self.program_cache_hit,
+        }
+
     # ---- per-study engine (runs under vmap over the study axis) ---------
 
     def _distance(self, x, y_obs):

@@ -104,6 +104,7 @@ from typing import List, Optional
 from ..telemetry.metrics import REGISTRY
 from . import shards
 from .spec import StudySpec, study_digest
+from .tracing import TraceLog
 
 #: serve root (queue + cache persistence); default <run dir>/serve
 SERVE_DIR_ENV = "PYABC_TPU_SERVE_DIR"
@@ -228,7 +229,18 @@ class Ticket:
     #: holder of the claim this ticket was listed from (claimed state
     #: only — the claimed/<worker>/ directory name)
     worker: Optional[str] = None
+    #: wall-clock instant this process claimed the ticket (stamped by
+    #: :meth:`StudyQueue.claim`; ``None`` for listings) — the worker's
+    #: trace fold uses it for the synthetic ``claimed`` event
+    claimed_unix: Optional[float] = None
     _payload: Optional[dict] = field(default=None, repr=False)
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The study's lifecycle trace id, stamped at submit and
+        carried in the payload for the ticket's whole life (``None``
+        when tracing was off at submit)."""
+        return (self._payload or {}).get("trace_id")
 
     def load_spec(self) -> StudySpec:
         """Reconstruct the spec.  Unpickling EXECUTES code: with no
@@ -299,6 +311,10 @@ class StudyQueue:
             from .admission import AdmissionController
             admission = AdmissionController(os.path.dirname(self.root))
         self.admission = admission
+        # the lifecycle event log rides the same serve root and the
+        # same partitioning as the queue (serve/tracing.py)
+        self.trace = TraceLog(os.path.dirname(self.root),
+                              partitions=self.partitions)
         self._claim_salt = 0
 
     # ---- introspection ---------------------------------------------------
@@ -481,19 +497,24 @@ class StudyQueue:
         depth/quota checks are best-effort under concurrent submitters
         (module docstring): racers can overshoot the bound by at most
         the number of in-flight submissions."""
+        trace_id = self.trace.new_id()  # None while tracing is off
+        tenant = spec.tenant or "default"
         pending = self.pending()
         if len(pending) >= self.max_depth:
             REGISTRY.counter(
                 "serve_queue_rejected_total",
                 "study submissions rejected by admission control").inc()
+            self.trace.emit(trace_id, "rejected", partition=0,
+                            tenant=tenant, reason="depth")
             raise QueueFull(
                 f"queue at max depth {self.max_depth}")
-        tenant = spec.tenant or "default"
         mine = sum(1 for t in pending if t.tenant == tenant)
         if mine >= self.tenant_quota:
             REGISTRY.counter(
                 "serve_queue_rejected_total",
                 "study submissions rejected by admission control").inc()
+            self.trace.emit(trace_id, "rejected", partition=0,
+                            tenant=tenant, reason="tenant_quota")
             raise TenantQuotaExceeded(
                 f"tenant {tenant!r} at quota {self.tenant_quota}")
         digest = study_digest(spec)
@@ -502,8 +523,15 @@ class StudyQueue:
             # SLO load-shedding (serve/admission.py): distinct from the
             # depth/quota rejections above — raises ServeOverloaded
             # with a computed retry_after_s
-            self.admission.check(self.partition_depth(partition),
-                                 partition=partition)
+            try:
+                self.admission.check(self.partition_depth(partition),
+                                     partition=partition)
+            except QueueFull as exc:  # ServeOverloaded subclasses it
+                self.trace.emit(
+                    trace_id, "shed", digest=digest, tenant=tenant,
+                    reason=getattr(exc, "reason", "overload"),
+                    retry_after_s=getattr(exc, "retry_after_s", None))
+                raise
         sid = f"{time.time_ns():019d}-{digest[:12]}-{uuid.uuid4().hex[:8]}"
         payload = {
             "id": sid,
@@ -515,13 +543,20 @@ class StudyQueue:
             "spec_b64": base64.b64encode(
                 pickle.dumps(spec)).decode("ascii"),
         }
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
         key = _hmac_key()
         if key is not None:
             payload["spec_hmac"] = _sign_spec(key, payload["spec_b64"])
+        self.trace.emit(trace_id, "submitted", digest=digest,
+                        ticket=sid, tenant=tenant,
+                        priority=int(spec.priority))
         pdir = self._partition_dir(partition)
         os.makedirs(pdir, exist_ok=True)
         path = os.path.join(pdir, f"{sid}.json")
         self._write_atomic(path, payload)
+        self.trace.emit(trace_id, "queued", digest=digest, ticket=sid,
+                        partition=partition)
         REGISTRY.counter(
             "serve_queue_submitted_total",
             "studies admitted into the serve queue").inc()
@@ -598,6 +633,10 @@ class StudyQueue:
                     continue  # another worker won this one
                 t.path = dest
                 t.worker = worker_id
+                t.claimed_unix = time.time()
+                self.trace.emit(t.trace_id, "claimed",
+                                digest=t.digest, ticket=t.id,
+                                worker=worker_id, bounce=t.requeues)
                 return t
         return None
 
@@ -623,21 +662,37 @@ class StudyQueue:
                 pass
         ticket.path = dest
         ticket._payload = payload
+        if state in ("done", "failed"):
+            self.trace.emit(payload.get("trace_id"), "tombstoned",
+                            digest=ticket.digest, ticket=ticket.id,
+                            state=state)
         return dest
 
     def complete(self, ticket: Ticket, wall_s: float = 0.0,
-                 engine: str = "solo"):
-        self._move(ticket, "done", {
+                 engine: str = "solo",
+                 trace: Optional[dict] = None):
+        """Settle a served study into ``done/``.  ``trace`` is the
+        worker's folded critical-path block (phases + trace id) —
+        written into the tombstone so per-study latency attribution
+        is readable without assembling the event log."""
+        extra = {
             "completed_unix": time.time(),
             "wall_s": float(wall_s),
             "engine": engine,
-        })
+        }
+        if trace is not None:
+            extra["trace"] = trace
+        self._move(ticket, "done", extra)
 
-    def fail(self, ticket: Ticket, error: str):
-        self._move(ticket, "failed", {
+    def fail(self, ticket: Ticket, error: str,
+             trace: Optional[dict] = None):
+        extra = {
             "failed_unix": time.time(),
             "error": str(error)[:2000],
-        })
+        }
+        if trace is not None:
+            extra["trace"] = trace
+        self._move(ticket, "failed", extra)
 
     def requeue(self, ticket: Ticket, worker: Optional[str] = None,
                 error: Optional[str] = None) -> bool:
@@ -692,6 +747,10 @@ class StudyQueue:
         ticket.path = dest
         ticket._payload = payload
         ticket.requeues = payload["requeues"]
+        self.trace.emit(ticket.trace_id, "requeued",
+                        digest=ticket.digest, ticket=ticket.id,
+                        worker=worker, bounce=ticket.requeues,
+                        error=payload["last_error"])
         REGISTRY.counter(
             "serve_queue_requeues_total",
             "claimed studies returned to pending (drain/crash)").inc()

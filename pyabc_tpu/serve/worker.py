@@ -52,11 +52,13 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry import studytrace
 from ..telemetry.metrics import REGISTRY
-from .admission import publish_latency_snapshot
+from .admission import publish_latency_snapshot, slo_p99_ms_configured
 from .cache import StudyCache, TieredStudyCache
-from .multiplex import (STOP_NAMES, StudyBatch, lane_eligible,
-                        multiplex_eligible, multiplex_width)
+from .multiplex import (STOP_NAMES, StudyBatch, batch_key,
+                        lane_eligible, multiplex_eligible,
+                        multiplex_width)
 from .queue import StudyQueue, Ticket, default_worker_id, serve_root
 from .spec import StudySpec, problem_key, study_digest
 
@@ -122,6 +124,12 @@ class ServeWorker:
         self.served = 0
         self.walls_ms: List[float] = []
         self._last_slo_pub = 0.0
+        #: in-flight lifecycle-trace contexts, keyed ``id(spec)`` —
+        #: populated per claimed batch by :meth:`_trace_begin`, folded
+        #: into the tombstone by :meth:`_trace_fold` (empty, and every
+        #: ``_emit`` a no-op, when tracing is off or the study came in
+        #: without a ticket)
+        self._trace_ctx: dict = {}
 
     # ---- engine routing --------------------------------------------------
 
@@ -153,6 +161,87 @@ class ServeWorker:
         if hit is None:
             return None, None
         return hit, ("cache_t2" if tier == "t2" else "cache")
+
+    # ---- lifecycle tracing -----------------------------------------------
+
+    def _trace_begin(self, queue: StudyQueue,
+                     loaded: Sequence[Tuple[Ticket, StudySpec]]):
+        """Open a trace context per claimed study carrying a trace id.
+
+        The context replays the ticket's already-known instants
+        (``submitted`` at the payload's submit stamp, ``claimed`` at
+        this process's claim stamp) as SYNTHETIC local events so the
+        completion fold never scans the shared log on the hot path —
+        the log is re-read only for bounced studies, where earlier
+        workers' events must join the fold."""
+        for tk, spec in loaded:
+            trace_id = tk.trace_id
+            if not trace_id:
+                continue  # tracing off at submit: stay byte-identical
+            events = [{"trace_id": trace_id, "event": "submitted",
+                       "unix": tk.submitted_unix, "ticket": tk.id},
+                      {"trace_id": trace_id, "event": "claimed",
+                       "unix": tk.claimed_unix or time.time(),
+                       "ticket": tk.id, "worker": self.worker_id,
+                       "bounce": tk.requeues}]
+            self._trace_ctx[id(spec)] = {
+                "trace_id": trace_id, "ticket": tk.id,
+                "digest": tk.digest, "requeues": tk.requeues,
+                "log": queue.trace, "events": events,
+            }
+
+    def _emit(self, spec: StudySpec, event: str, **fields):
+        """Append one lifecycle event for an in-flight traced study —
+        to the shared log AND to the local context the completion fold
+        reads (so folding costs no log scan).  No-op for untraced
+        studies (direct ``serve_spec`` calls, tracing off)."""
+        ctx = self._trace_ctx.get(id(spec))
+        if ctx is None:
+            return
+        rec = ctx["log"].emit(ctx["trace_id"], event,
+                              digest=ctx["digest"],
+                              ticket=ctx["ticket"],
+                              worker=self.worker_id, **fields)
+        if rec is None:  # log write failed: the fold still gets it
+            rec = {"trace_id": ctx["trace_id"], "event": event,
+                   "unix": time.time(), "ticket": ctx["ticket"],
+                   "worker": self.worker_id, **fields}
+        ctx["events"].append(rec)
+
+    def _trace_fold(self, spec: StudySpec) -> Optional[dict]:
+        """Close a study's trace: fold its events into the critical
+        path, record the fleet latency/SLO accounting, and return the
+        tombstone ``trace`` block (``None`` for untraced studies).
+
+        A bounced study (``requeues > 0``) re-reads the shared log so
+        the earlier workers' claim/requeue events join the fold — the
+        trace is continuous across workers; an unbounced study folds
+        from the local context alone."""
+        ctx = self._trace_ctx.pop(id(spec), None)
+        if ctx is None:
+            return None
+        events = ctx["events"]
+        if ctx["requeues"] > 0:
+            # every local event also reached the log (emit falls back
+            # to local-only just on a failed mount write), so the log
+            # IS the superset — local context only backstops a log
+            # that cannot be read back
+            logged = ctx["log"].events_for(ctx["trace_id"])
+            if logged:
+                events = logged
+        now = time.time()
+        phases = studytrace.fold_phases(events, end_unix=now)
+        studytrace.record_study_slo(
+            e2e_ms=phases["total_s"] * 1e3,
+            queue_wait_ms=phases["queue_wait_s"] * 1e3,
+            slo_p99_ms=slo_p99_ms_configured())
+        return {
+            "trace_id": ctx["trace_id"],
+            "worker": self.worker_id,
+            "bounces": phases.pop("bounces"),
+            "events_n": phases.pop("events_n"),
+            "phases": phases,
+        }
 
     # ---- engine pool -----------------------------------------------------
 
@@ -226,6 +315,8 @@ class ServeWorker:
         engine = self._engine_of(spec)
         hit, tier = self._cache_lookup(self._cache_key(digest, engine))
         if hit is not None:
+            self._emit(spec, "cache_hit",
+                       tier="t2" if tier == "cache_t2" else "t1")
             return self._finish(spec, hit, time.perf_counter() - t0,
                                 tier)
         summary = self._dispatch_miss(spec, digest, engine)
@@ -237,14 +328,22 @@ class ServeWorker:
         """Run one miss on its content-routed engine and cache the
         summary under the engine-scoped key."""
         if engine == "multiplex":
-            res = self._run_batch([spec])[0]
+            self._emit(spec, "batched", engine="multiplex",
+                       batch_key=batch_key(spec)[:12], width=1)
+            res = self._run_batch(
+                [spec],
+                on_built=lambda b: self._emit(
+                    spec, "dispatched", **b.trace_info()))[0]
+            self._emit(spec, "drained")
             summary = self._batch_summary(spec, res, digest)
         else:
             summary = self._solo_summary(spec, digest)
-        self.cache.put(self._cache_key(digest, engine), summary)
+        tier = self.cache.put(self._cache_key(digest, engine), summary)
+        self._emit(spec, "published", tier=tier or "t1")
         return summary
 
-    def _run_batch(self, group: Sequence[StudySpec]) -> List[dict]:
+    def _run_batch(self, group: Sequence[StudySpec],
+                   on_built=None) -> List[dict]:
         """Dispatch one study-axis batch through the worker's compiled
         program pool — a repeat (batch shape, rung, budget) reuses the
         jitted function, so sequential eligible studies after the
@@ -268,6 +367,10 @@ class ServeWorker:
             REGISTRY.counter(
                 "serve_batch_program_evictions_total",
                 "study-axis programs dropped by the pool LRU").inc()
+        if on_built is not None:
+            # the program is resolved (built or pool-warm): the trace's
+            # compile phase ends here, the device phase starts with run
+            on_built(batch)
         return batch.run()
 
     @staticmethod
@@ -296,11 +399,14 @@ class ServeWorker:
     def _solo_summary(self, spec: StudySpec, digest: str) -> dict:
         if self.durable:
             return self._durable_solo_summary(spec, digest)
+        self._emit(spec, "batched", engine="solo", width=1)
         abc = self._engine_for(spec)
+        self._emit(spec, "dispatched")
         history = abc.run(
             minimum_epsilon=float(spec.minimum_epsilon),
             max_nr_populations=int(spec.max_generations),
             min_acceptance_rate=float(spec.min_acceptance_rate))
+        self._emit(spec, "drained")
         return self._history_summary(spec, digest, abc, history)
 
     def _durable_solo_summary(self, spec: StudySpec,
@@ -318,6 +424,7 @@ class ServeWorker:
         os.makedirs(self.studies_dir, exist_ok=True)
         db_path = os.path.join(self.studies_dir, f"{digest}.solo.db")
         db_url = "sqlite:///" + db_path
+        self._emit(spec, "batched", engine="solo", width=1)
         resumed_from = 0
         abc = None
         if os.path.exists(db_path):
@@ -334,15 +441,19 @@ class ServeWorker:
                     "serve_study_resumes_total",
                     "interrupted durable studies resumed from their "
                     "journaled generation").inc()
+                self._emit(spec, "rescued",
+                           resumed_from_gen=resumed_from)
         if abc is None:
             abc = self._engine_for(spec, db=db_url)
             history = abc.history
+        self._emit(spec, "dispatched")
         remaining = int(spec.max_generations) - resumed_from
         if remaining > 0:
             history = abc.run(
                 minimum_epsilon=float(spec.minimum_epsilon),
                 max_nr_populations=remaining,
                 min_acceptance_rate=float(spec.min_acceptance_rate))
+        self._emit(spec, "drained")
         summary = self._history_summary(spec, digest, abc, history)
         if resumed_from:
             summary["resumed_from_gen"] = resumed_from
@@ -405,6 +516,8 @@ class ServeWorker:
             hit, tier = self._cache_lookup(
                 self._cache_key(digest, self._engine_of(spec)))
             if hit is not None:
+                self._emit(spec, "cache_hit",
+                           tier="t2" if tier == "cache_t2" else "t1")
                 out[i] = self._finish(
                     spec, hit, time.perf_counter() - t0, tier)
             else:
@@ -417,8 +530,18 @@ class ServeWorker:
             by_id = {id(s): (i, d) for i, s, d in lanes}
             for group in multiplex_eligible([s for _i, s, _d in lanes]):
                 t0 = time.perf_counter()
-                results = self._run_batch(group)
+                for spec in group:
+                    self._emit(spec, "batched", engine="multiplex",
+                               batch_key=batch_key(spec)[:12],
+                               width=len(group))
+                results = self._run_batch(
+                    group,
+                    on_built=lambda b: [
+                        self._emit(s, "dispatched", **b.trace_info())
+                        for s in b.specs])
                 wall = time.perf_counter() - t0
+                for spec in group:
+                    self._emit(spec, "drained")
                 REGISTRY.counter(
                     "serve_multiplexed_studies_total",
                     "studies served fused on the study axis"
@@ -426,14 +549,17 @@ class ServeWorker:
                 for spec, res in zip(group, results):
                     i, digest = by_id[id(spec)]
                     summary = self._batch_summary(spec, res, digest)
-                    self.cache.put(
+                    tier = self.cache.put(
                         self._cache_key(digest, "multiplex"), summary)
+                    self._emit(spec, "published", tier=tier or "t1")
                     out[i] = self._finish(
                         spec, summary, wall / len(group), "multiplex")
         for i, spec, digest in solos:
             t0 = time.perf_counter()
             summary = self._solo_summary(spec, digest)
-            self.cache.put(self._cache_key(digest, "solo"), summary)
+            tier = self.cache.put(self._cache_key(digest, "solo"),
+                                  summary)
+            self._emit(spec, "published", tier=tier or "t1")
             out[i] = self._finish(
                 spec, summary, time.perf_counter() - t0, "solo")
         for i, spec, digest in waiters:
@@ -442,6 +568,8 @@ class ServeWorker:
             hit, tier = self._cache_lookup(
                 self._cache_key(digest, engine))
             if hit is not None:
+                self._emit(spec, "cache_hit",
+                           tier="t2" if tier == "cache_t2" else "t1")
                 out[i] = self._finish(
                     spec, hit, time.perf_counter() - t0, tier)
             else:  # original evicted between put and here: serve it
@@ -563,19 +691,22 @@ class ServeWorker:
                         queue.fail(tk, f"unpicklable spec: {exc!r}")
                 if not loaded:
                     continue
+                self._trace_begin(queue, loaded)
                 t0 = time.perf_counter()
                 try:
                     summaries = self.serve_many(
                         [s for _tk, s in loaded])
                 except Exception as exc:
-                    for tk, _s in loaded:
-                        queue.fail(tk, repr(exc))
+                    for tk, s in loaded:
+                        queue.fail(tk, repr(exc),
+                                   trace=self._trace_fold(s))
                     continue
                 wall = time.perf_counter() - t0
-                for (tk, _s), summary in zip(loaded, summaries):
+                for (tk, s), summary in zip(loaded, summaries):
                     queue.complete(tk, wall_s=wall,
                                    engine=summary.get("served_from",
-                                                      "solo"))
+                                                      "solo"),
+                                   trace=self._trace_fold(s))
                 self._snapshot_gauges(queue)
                 if publisher is not None:
                     publisher.publish()

@@ -378,6 +378,7 @@ _SERVE_GAUGES = frozenset({
     "serve_cache_hit_ratio_t1", "serve_cache_hit_ratio_t2",
     "serve_last_study_ms", "serve_drain_requeued",
     "serve_partitions", "serve_partition_depth_max",
+    "serve_slo_p99_ms",
 })
 
 
@@ -407,6 +408,17 @@ def _serve_rollup(metrics_rollup: Dict) -> Dict:
             tenants[key[len("serve_tenant_"):-len("_studies_total")]] \
                 = val
     out["tenants"] = tenants
+    # the study-trace accounting (telemetry/studytrace.py): re-fold
+    # the flat per-bucket counters into fleet latency histograms and
+    # the SLO burn ledger — bucket counters sum across workers, so
+    # the fleet histogram is exact, not an average of percentiles
+    from . import studytrace
+    if any(k.startswith("serve_latency_ms_") for k in out):
+        out["latency"] = studytrace.latency_histogram(
+            out, "serve_latency_ms")
+        out["queue_wait"] = studytrace.latency_histogram(
+            out, "serve_queue_wait_ms")
+        out["slo"] = studytrace.slo_ledger(out)
     return out
 
 
@@ -457,10 +469,27 @@ def render_prometheus(run_dir: str) -> str:
     # the serving tier's first-class scrape surface: flat
     # ``pyabc_tpu_serve_*`` gauges (tenant counters already carry the
     # tenant in the key), alongside the generic fleet aggregates below
-    for key, val in sorted((roll.get("serve") or {}).items()):
-        if key == "tenants":
-            continue
+    serve = roll.get("serve") or {}
+    for key, val in sorted(serve.items()):
+        if key in ("tenants", "latency", "queue_wait", "slo"):
+            continue  # structured blocks: rendered below / JSON-only
+        if (key.endswith("_sum_total") or "_ms_le_" in key):
+            continue  # flat bucket counters: rendered as histograms
         lines.append(f"pyabc_tpu_{key} {val}")
+    # the per-bucket latency counters re-assembled into real
+    # Prometheus histogram exposition (cumulative le labels)
+    for name in ("serve_latency_ms", "serve_queue_wait_ms"):
+        hist = serve.get("latency" if name == "serve_latency_ms"
+                         else "queue_wait")
+        if not hist or not hist.get("count"):
+            continue
+        for le, n in hist["buckets"].items():
+            lines.append(
+                f'pyabc_tpu_{name}_bucket{{le="{le}"}} {n}')
+        lines.append(
+            f'pyabc_tpu_{name}_bucket{{le="+Inf"}} {hist["count"]}')
+        lines.append(f"pyabc_tpu_{name}_sum {hist['sum_ms']}")
+        lines.append(f"pyabc_tpu_{name}_count {hist['count']}")
     # the scheduler's scrape surface: flat ``pyabc_tpu_sched_*`` lines
     # (workers alive/dead, leases lapsed, requeues, quarantines,
     # desired replicas) from the same snapshot rollup

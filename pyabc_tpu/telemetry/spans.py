@@ -106,6 +106,26 @@ class _NullSpan:
 _NULL = _NullSpan()
 
 
+def complete_event(name: str, ts_us: float, dur_us: float,
+                   pid: Optional[int] = None, tid: int = 0,
+                   args: Optional[dict] = None,
+                   cat: str = "pyabc_tpu") -> dict:
+    """One Chrome-trace complete event (``"ph": "X"``) — the single
+    place the event shape is written down.  Used by the span tracer's
+    JSONL sink and by :mod:`pyabc_tpu.telemetry.studytrace`'s per-study
+    waterfall export, so both load in Perfetto the same way."""
+    return {
+        "name": name,
+        "cat": cat,
+        "ph": "X",
+        "ts": round(ts_us, 3),
+        "dur": round(dur_us, 3),
+        "pid": os.getpid() if pid is None else pid,
+        "tid": tid,
+        "args": args or {},
+    }
+
+
 class SpanTracer:
     """Bounded ring of completed spans + optional Chrome-trace JSONL sink.
 
@@ -208,16 +228,13 @@ class SpanTracer:
         if span.gen is not None:
             args["gen"] = span.gen
         args.update(span.attrs)
-        return {
-            "name": span.name,
-            "cat": "pyabc_tpu",
-            "ph": "X",
-            "ts": round((span.t_start - self._t0) * 1e6, 3),
-            "dur": round((span.t_end - span.t_start) * 1e6, 3),
-            "pid": os.getpid(),
-            "tid": span.tid,
-            "args": args,
-        }
+        return complete_event(
+            span.name,
+            ts_us=(span.t_start - self._t0) * 1e6,
+            dur_us=(span.t_end - span.t_start) * 1e6,
+            tid=span.tid,
+            args=args,
+        )
 
     def flush(self):
         """Append buffered spans to the JSONL sink, sorted by start time

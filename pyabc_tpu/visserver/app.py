@@ -39,6 +39,15 @@ PAGE = """<!doctype html>
   <svg id=livetraj width=340 height=180></svg>
  </div>
 </div>
+<div class=card style="margin-bottom:1em">
+ <h2>study trace — latency waterfall <span id=traceinfo class=lbl></span></h2>
+ <input id=tracekey placeholder="trace id / ticket id / digest" size=44>
+ <button id=tracego>assemble</button>
+ <div class=row>
+  <svg id=waterfall width=560 height=170 style="display:none"></svg>
+  <div id=traceevents></div>
+ </div>
+</div>
 <div>
  run <select id=run></select>
  model <select id=model></select>
@@ -139,6 +148,33 @@ async function pollFleet(){
   if(A.length>1)line($('livetraj'),A.map(r=>r.gen),A.map(r=>r.accepted/r.total),{keep:true,color:'#2a9d3a',label:'acc rate',li:1,ymin:0,ymax:1});
  }
 }
+// per-study latency waterfall: /api/trace/<id> (trace id, ticket id
+// or digest) -> one horizontal bar per critical-path phase, offset by
+// the phases before it, so the card reads like a request waterfall
+const PHASES=['queue_wait_s','claim_to_dispatch_s','compile_s','device_s','drain_s','publish_s'];
+const PCOLORS=['#8899aa','#e08a1e','#c33','#1667c0','#2a9d3a','#7b52ab'];
+async function drawTrace(){
+ const key=$('tracekey').value.trim();if(!key)return;
+ let d;try{d=await j('/api/trace/'+encodeURIComponent(key))}catch(e){$('traceinfo').textContent='error';return}
+ if(!d.enabled){$('traceinfo').textContent='needs --run-dir';return}
+ if(!d.found){$('traceinfo').textContent='no trace found';$('waterfall').style.display='none';$('traceevents').innerHTML='';return}
+ const ph=d.phases||{},total=Math.max(ph.total_s||0,1e-9);
+ $('traceinfo').textContent=`${(total*1e3).toFixed(1)}ms | bounces=${ph.bounces||0} | workers=${(d.workers||[]).join(',')||'-'}`;
+ const svg=$('waterfall');svg.style.display='';svg.innerHTML='';
+ const W=560,H=170,L=140,R=70,bh=16;let off=0;
+ PHASES.forEach((p,i)=>{const v=ph[p]||0;const x=L+off/total*(W-L-R),w=Math.max(v/total*(W-L-R),v>0?1:0),y=8+i*(bh+8);
+  svg.innerHTML+=`<text class=lbl x=2 y=${y+12}>${p.slice(0,-2)}</text>`+
+   `<rect x=${x.toFixed(1)} y=${y} width=${w.toFixed(1)} height=${bh} fill="${PCOLORS[i]}"><title>${p}: ${(v*1e3).toFixed(2)}ms</title></rect>`+
+   `<text class=lbl x=${(x+w+4).toFixed(1)} y=${y+12}>${(v*1e3).toFixed(1)}ms</text>`;
+  off+=v});
+ let html='<table><tr><th>event</th><th>worker</th><th>detail</th></tr>';
+ for(const e of d.events||[]){const skip=new Set(['trace_id','event','unix','mono','pid','digest','ticket','worker']);
+  const det=Object.keys(e).filter(k=>!skip.has(k)).map(k=>`${k}=${e[k]}`).join(' ');
+  html+=`<tr><td>${e.event}</td><td>${e.worker||'-'}</td><td style="text-align:left">${det}</td></tr>`}
+ $('traceevents').innerHTML=html+'</table>';
+}
+$('tracego').onclick=drawTrace;
+$('tracekey').onkeydown=e=>{if(e.key==='Enter')drawTrace()};
 pollFleet();setInterval(pollFleet,2000);
 loadRuns();
 </script></body></html>

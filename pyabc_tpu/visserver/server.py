@@ -121,6 +121,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(self._serve_state())
         if parts == ["sched"]:
             return self._json(self._sched_state())
+        if parts[0] == "trace" and len(parts) == 2:
+            return self._json(self._trace_state(parts[1]))
         if parts == ["runs"]:
             h = History(self.db_path, abc_id=1)
             runs = h.all_runs()
@@ -276,6 +278,26 @@ class _Handler(BaseHTTPRequestHandler):
             out["leases"] = {"lease_s": q.lease_s,
                              "lapsed": len(q.lapsed())}
         return out
+
+    def _trace_state(self, key: str) -> dict:
+        """One study's assembled lifecycle trace (``/api/trace/<id>``,
+        id = trace id, ticket id, or digest): the ordered events plus
+        the folded critical-path phases — the JSON behind the latency
+        waterfall card and any notebook wanting a single study's
+        breakdown."""
+        if not self.run_dir:
+            return {"enabled": False}
+        import os
+
+        from ..telemetry import studytrace
+
+        serve_dir = os.environ.get("PYABC_TPU_SERVE_DIR",
+                                   os.path.join(self.run_dir, "serve"))
+        trace = studytrace.StudyTrace.assemble(serve_dir, key)
+        if trace is None:
+            return {"enabled": True, "found": False, "key": key}
+        return {"enabled": True, "found": True, "key": key,
+                **trace.to_dict()}
 
     def _index(self):
         h = History(self.db_path, abc_id=1)

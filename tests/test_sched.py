@@ -360,7 +360,8 @@ def test_scheduler_tick_publishes_sched_metrics(tmp_path, monkeypatch):
 # chaos suite: deterministic fast subset tier-1, full soak slow
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("trial", ("freeze", "poison", "shards"))
+@pytest.mark.parametrize("trial", ("freeze", "poison", "shards",
+                                   "trace"))
 def test_sched_chaos_fast_subset(trial, tmp_path, monkeypatch):
     _clean_env(monkeypatch)
     from tools.chaos_soak import SCHED_FAST_TRIALS, run_sched_trial
@@ -368,6 +369,9 @@ def test_sched_chaos_fast_subset(trial, tmp_path, monkeypatch):
     rep = run_sched_trial(trial, str(tmp_path), seed=0)
     assert rep["lost"] == 0
     assert rep["reschedule_ms"] < 10_000
+    if trial == "trace":
+        # continuity across the bounce: one trace_id, both workers
+        assert rep["trace_events"] >= 9
 
 
 @pytest.mark.slow

@@ -43,7 +43,10 @@ import sys
 
 #: (key, direction, relative tolerance).  Direction "higher" = bigger is
 #: better (fails when new < ref*(1-tol)); "lower" = smaller is better
-#: (fails when new > ref*(1+tol)); "zero" = any nonzero value fails.
+#: (fails when new > ref*(1+tol)); "zero" = any nonzero value fails;
+#: "ceiling" = tol is an ABSOLUTE threshold (fails when new > tol, no
+#: trajectory reference — for budget rows whose limit is a contract,
+#: not a median).
 #: Tolerances sit strictly below 20 % on the throughput rows so a 20 %
 #: regression always trips, while staying loose enough that
 #: shared-hardware scheduler jitter (single-digit %) never does.
@@ -126,6 +129,18 @@ WATCHED = (
     # cross-worker publish/read path staying alive at all
     ("serve_load_cache_hit_tier1", "higher", 0.15),
     ("serve_load_cache_hit_tier2", "higher", 0.80),
+    # queue-wait p99 (server-attributed, from the study traces): the
+    # slice of the end-to-end p99 the queue itself owns — fails high
+    # when claim scans or partition routing stall studies in pending/
+    # even while workers stay busy (invisible in serve_load_p99_ms
+    # alone, which folds device time in)
+    ("serve_load_queue_wait_p99_ms", "lower", 1.00),
+    # lifecycle tracing rides EVERY study (default-on), so its cost is
+    # a contract, not a trajectory: events-per-study × calibrated
+    # per-emit cost must stay under 2% of the client p50.  Absolute
+    # ceiling — a median of prior regressed captures must not launder
+    # a budget blowout
+    ("serve_trace_overhead_pct", "ceiling", 2.0),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means
@@ -237,6 +252,11 @@ def compare(new: dict, ref: dict, baseline_rate=None) -> list:
                 fails.append((key, nv, 0,
                               "must be 0 on a healthy bench run"))
             continue
+        if direction == "ceiling":
+            if nv > tol:
+                fails.append((key, nv, tol,
+                              "above absolute ceiling"))
+            continue
         rv = ref.get(key)
         if not isinstance(rv, (int, float)):
             continue  # no trajectory for this row yet
@@ -322,6 +342,9 @@ def _self_test() -> int:
             bad[key] = bad[key] * 0.80
         elif direction == "lower":
             bad[key] = bad[key] * 1.30
+    # ceiling rows need no trajectory: a blown budget must fail even
+    # against an empty reference
+    bad["serve_trace_overhead_pct"] = 5.0
     bad_fails = compare(bad, ref, baseline_rate())
     if not bad_fails:
         print("bench sentinel --check: synthetic 20% regression "

@@ -443,8 +443,8 @@ SCHED_GENS = 4
 #: the deterministic ``--sched`` trial names; ``SCHED_FAST_TRIALS`` is
 #: the queue-level subset cheap enough for tier-1 (tests/test_sched.py)
 SCHED_TRIALS = ("kill9", "freeze", "corrupt", "poison", "shards",
-                "platform")
-SCHED_FAST_TRIALS = ("freeze", "poison", "shards")
+                "platform", "trace")
+SCHED_FAST_TRIALS = ("freeze", "poison", "shards", "trace")
 
 _SCHED_CHILD = """
 import sys
@@ -485,7 +485,10 @@ class _SchedEnv:
 
     _VARS = {"PYABC_TPU_SERVE_MULTIPLEX": "1",
              "PYABC_TPU_SERVE_DURABLE": "1",
-             "PYABC_TPU_STORE_GENS": "1"}
+             "PYABC_TPU_STORE_GENS": "1",
+             # trace continuity is part of what the trials assert, so
+             # tracing is pinned on regardless of ambient config
+             "PYABC_TPU_SERVE_TRACE": "1"}
     _UNSET = ("PYABC_TPU_RUN_DIR", "PYABC_TPU_SERVE_DIR",
               "PYABC_TPU_FAULTS")
 
@@ -550,6 +553,40 @@ def _run_dead_child(root: str, worker_id: str, fault_plan: str,
         f"{proc.stderr[-2000:]}")
 
 
+def _assert_trace_continuity(serve_root: str, key: str) -> int:
+    """A bounced study's lifecycle is ONE continuous trace: the dead
+    worker's and the rescue worker's events share a single trace_id,
+    the ``claimed → requeued → claimed → rescued → published`` order
+    holds within it, both workers are visible, and the folded phase
+    segments are monotone and non-overlapping (the second queue wait
+    is its own segment, not a hole).  Returns the event count."""
+    from pyabc_tpu.telemetry.studytrace import StudyTrace, fold_segments
+    trace = StudyTrace.assemble(serve_root, key)
+    assert trace is not None and trace.trace_id, (
+        f"no assembled trace for {key}")
+    names = trace.event_names()
+    assert names.count("claimed") == 2, (
+        f"expected exactly two claims (one per worker): {names}")
+    order = ("claimed", "requeued", "claimed", "rescued", "published")
+    pos = 0
+    for want in order:
+        while pos < len(names) and names[pos] != want:
+            pos += 1
+        assert pos < len(names), (
+            f"lifecycle order {order} broken at {want!r}: {names}")
+        pos += 1
+    assert len(trace.workers) >= 2, (
+        f"bounce invisible in the trace: workers={trace.workers}")
+    segs = fold_segments(trace.events)
+    for a, b in zip(segs, segs[1:]):
+        assert a["t0_unix"] + a["dur_s"] <= b["t0_unix"] + 1e-6, (
+            f"overlapping phase segments: {a} / {b}")
+    waits = [s for s in segs if s["phase"] == "queue_wait_s"]
+    assert len(waits) == 2, (
+        f"expected two queue_wait segments (submit + bounce): {segs}")
+    return len(trace.events)
+
+
 def _corrupt_tail(path: str, n: int = 64):
     """Flip the last ``n`` bytes of a file — bit rot on the journal
     segment's newest frames; earlier frames still CRC-scan clean."""
@@ -580,6 +617,7 @@ def run_sched_trial(name: str, workdir: str, seed: int = 0) -> dict:
 
     if name in ("kill9", "corrupt"):
         with _SchedEnv():
+            queue = StudyQueue(root=root, lease_s=30.0)
             spec = _sched_spec(seed=100 + seed)
             ticket = queue.submit(spec)
             # visit 3 = generation 2's deposit (kill9: journal holds
@@ -641,6 +679,10 @@ def run_sched_trial(name: str, workdir: str, seed: int = 0) -> dict:
             assert stats["done"] == 1 and stats["failed"] == 0, (
                 f"exactly one completion expected: {stats}")
             report["lost"] = _sched_conservation(queue, 1)
+            # the SIGKILL'd attempt and the rescue are one continuous
+            # trace — events written by the dead child survive it
+            report["trace_events"] = _assert_trace_continuity(
+                root, ticket.id)
 
     elif name == "freeze":
         # partitioned host: heartbeats frozen (file exists, mtime never
@@ -872,6 +914,51 @@ def run_sched_trial(name: str, workdir: str, seed: int = 0) -> dict:
                     f"corrupt={corrupt}")
             finally:
                 platform.shutdown()
+
+    elif name == "trace":
+        # trace continuity across a worker death, QUEUE-level: the
+        # bounce runs through the real emitters (submit/claim/
+        # scheduler-requeue/claim) and the rescue worker's lifecycle
+        # is simulated via TraceLog directly — no study dispatched,
+        # so the trial rides the tier-1 fast subset.  The slow kill9
+        # trial proves the same continuity with a real SIGKILL'd
+        # worker process.
+        with _SchedEnv():
+            queue = StudyQueue(root=root, lease_s=30.0)
+            spec = _sched_spec(seed=600 + seed, pop=8)
+            ticket = queue.submit(spec)
+            t1 = queue.claim("w_first")
+            assert t1 is not None and t1.trace_id == ticket.trace_id, (
+                "trace id did not survive submit -> claim")
+            # w_first dies; its lease lapses; the scheduler requeues
+            _rewind_lease(queue, "w_first")
+            sched = Scheduler(run_dir=None, queue=queue, max_bounces=3)
+            t0 = _time.perf_counter()
+            rep = sched.tick()
+            report["reschedule_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3)
+            assert rep["requeued"] == [ticket.id], (
+                f"dead worker's claim not requeued: {rep}")
+            t2 = queue.claim("w_second")
+            assert t2 is not None and t2.trace_id == ticket.trace_id, (
+                "trace id did not survive the bounce")
+            # the rescue worker's serve-side emissions, minus the study
+            log = queue.trace
+            for event, fields in (
+                    ("batched", {"engine": "solo", "width": 1}),
+                    ("rescued", {"resumed_from_gen": 1}),
+                    ("dispatched", {"width": 1}),
+                    ("drained", {}),
+                    ("published", {"tier": "t1"})):
+                rec = log.emit(t2.trace_id, event, digest=t2.digest,
+                               ticket=t2.id, worker="w_second",
+                               **fields)
+                assert rec is not None, f"emit({event}) was dropped"
+            queue.complete(t2, wall_s=0.01, engine="solo")
+            report["trace_events"] = _assert_trace_continuity(
+                root, ticket.id)
+            report["lost"] = _sched_conservation(queue, 1)
+            report["recovered"] = True
 
     else:
         raise ValueError(f"unknown sched trial {name!r}")

@@ -1,0 +1,30 @@
+"""Planted event-discipline violations: queue transitions that move a
+ticket between states without emitting (or delegating toward) a
+lifecycle trace event — each one is a hole in the study trace."""
+
+import os
+import time
+
+
+class SilentQueue:
+    def submit(self, spec):
+        # a submission nobody will ever see in the trace
+        path = os.path.join("pending", f"{spec.digest}.json")
+        with open(path, "w") as f:
+            f.write("{}")
+        return path
+
+    def requeue(self, ticket, worker=None, error=None):
+        # the bounce vanishes: fold_phases charges the whole second
+        # wait to the first queue_wait segment
+        dest = os.path.join("pending", f"{ticket.id}.json")
+        os.rename(ticket.path, dest)
+        ticket.path = dest
+        return True
+
+    def _move(self, ticket, state, extra):
+        payload = dict(extra)
+        payload["moved_unix"] = time.time()
+        dest = os.path.join(state, f"{ticket.id}.json")
+        os.rename(ticket.path, dest)
+        return dest

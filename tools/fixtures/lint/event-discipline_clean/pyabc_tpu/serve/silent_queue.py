@@ -1,0 +1,42 @@
+"""The event-discipline_bad violations, silenced every sanctioned way:
+emit-in-body, delegation to an emitting transition, the ``# event-ok``
+marker, and the generic graftlint allow."""
+
+import os
+import time
+
+
+class TracedQueue:
+    def submit(self, spec):
+        # the real contract: the transition logs itself
+        path = os.path.join("pending", f"{spec.digest}.json")
+        with open(path, "w") as f:
+            f.write("{}")
+        self.trace.emit(spec.trace_id, "submitted", digest=spec.digest)
+        return path
+
+    def requeue(self, ticket, worker=None, error=None):
+        dest = os.path.join("pending", f"{ticket.id}.json")
+        os.rename(ticket.path, dest)
+        ticket.path = dest
+        self.trace.emit(ticket.trace_id, "requeued", worker=worker)
+        return True
+
+    def _move(self, ticket, state, extra):
+        payload = dict(extra)
+        payload["moved_unix"] = time.time()
+        dest = os.path.join(state, f"{ticket.id}.json")
+        os.rename(ticket.path, dest)
+        self.trace.emit(ticket.trace_id, "tombstoned", state=state)
+        return dest
+
+    def complete(self, ticket, wall_s=0.0):
+        # delegation: _move owns the tombstoned event
+        return self._move(ticket, "done", {"wall_s": wall_s})
+
+    def fail(self, ticket, error):  # event-ok
+        # event intentionally owned by the caller's batch emitter
+        return os.path.join("failed", f"{ticket.id}.json")
+
+    def quarantine(self, ticket, error):  # graftlint: allow(event-discipline)
+        return os.path.join("failed", f"{ticket.id}.json")

@@ -1,0 +1,112 @@
+"""Rule ``event-discipline``: every queue state transition in the
+serving/scheduling tier emits (or delegates to something that emits) a
+lifecycle trace event.
+
+The study trace (serve/tracing.py, docs/observability.md "Tracing a
+study") is only trustworthy if it is COMPLETE: a transition method
+that moves a ticket between queue states without appending its event
+leaves a hole in the critical path — ``fold_phases`` silently charges
+the missing span to the neighboring phase and every latency
+attribution downstream (tombstone breakdown, SLO burn ledger, Chrome
+export) is wrong in a way no test of the emitting paths can catch.
+The contract is therefore structural: a function named after a queue
+transition (``submit`` / ``claim`` / ``complete`` / ``fail`` /
+``requeue`` / ``requeue_worker`` / ``quarantine`` / ``_move``) defined
+under ``pyabc_tpu/serve/`` or ``pyabc_tpu/sched/`` must do one of:
+
+- call ``.emit(...)`` somewhere in its body (the transition logs
+  itself), or
+- call another transition method (delegation: ``complete`` →
+  ``_move`` — the callee owns the event), or
+- carry ``# event-ok`` on its ``def`` line — for transitions whose
+  event is intentionally owned elsewhere (e.g. a caller that batches
+  emissions), mirroring ``# claim-ok`` / ``# wire-ok``.
+
+The generic ``# graftlint: allow(event-discipline)`` works as
+everywhere else.  Scope matches ``claim-discipline``: only the two
+packages that own the queue's state machine; tests and tools move
+tickets without ceremony.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Rule, register
+
+#: queue state-transition method names the rule binds to
+TRANSITIONS = frozenset({
+    "submit", "claim", "complete", "fail", "requeue",
+    "requeue_worker", "quarantine", "_move"})
+
+EVENT_OK = "# event-ok"
+
+#: package-relative directory prefixes the rule applies to
+SCOPES = ("serve/", "sched/")
+
+
+def _call_attr(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _satisfied(func: ast.AST) -> bool:
+    """True when ``func`` emits a trace event or delegates to another
+    transition method (which then owns the emission)."""
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = _call_attr(node)
+        if attr == "emit":
+            return True
+        if attr in TRANSITIONS and attr != func.name:
+            return True
+    return False
+
+
+def check(files) -> List[tuple]:
+    """``files`` is an iterable of (rel, SourceFile) pairs scoped to
+    serve/ + sched/; returns ``[(rel, lineno, message), ...]``."""
+    violations = []
+    for rel, sf in files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        for func in ast.walk(tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if func.name not in TRANSITIONS:
+                continue
+            if EVENT_OK in sf.line(func.lineno):
+                continue
+            if _satisfied(func):
+                continue
+            violations.append((
+                rel, func.lineno,
+                f"transition `{func.name}` neither emits a lifecycle "
+                "event nor delegates to a transition that does — the "
+                "study trace loses this state change and phase "
+                "attribution silently absorbs the gap (call "
+                ".emit(...), delegate, or mark `# event-ok`)"))
+    violations.sort()
+    return violations
+
+
+@register
+class EventDisciplineRule(Rule):
+    id = "event-discipline"
+    description = ("queue transitions in serve/ and sched/ emit their "
+                   "lifecycle trace event (or delegate to a "
+                   "transition that does)")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        pairs = [(sf.rel, sf) for sf in tree.package_files()
+                 if sf.rel.startswith(SCOPES)]
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, msg)
+                for rel, lineno, msg in check(pairs)]

@@ -11,7 +11,12 @@ unbounded open loop that measures nothing but queue growth.
 Each client records per-study end-to-end latency (submit → settled
 tombstone) and the engine the study was served from (the tombstone's
 ``engine`` field: ``cache`` = tier-1, ``cache_t2`` = shared tier-2,
-``multiplex``/``solo`` = dispatched).  Shed responses
+``multiplex``/``solo`` = dispatched).  When tracing is on the
+tombstone also carries the server-attributed phase breakdown
+(``trace.phases``); the report compares client-observed latency
+against the server-attributed total and prints the gap explicitly —
+it is the tombstone-poll artifact (bounded by ``poll_s``), not
+hidden inside either number — plus queue-wait percentiles.  Shed responses
 (:class:`ServeOverloaded`) honor the computed ``retry_after_s``
 (capped) and count into the shed rate; quota/backpressure rejections
 retry after a short fixed pause.
@@ -91,6 +96,8 @@ class ClosedLoopLoadGen:
         self._lock = threading.Lock()
         self._submitted = 0
         self._lat_ms: List[float] = []
+        self._server_ms: List[float] = []
+        self._queue_wait_ms: List[float] = []
         self._engines: dict = {}
         self._sheds = 0
         self._shed_wait_s = 0.0
@@ -167,6 +174,12 @@ class ClosedLoopLoadGen:
                     self._lat_ms.append(lat_ms)
                     eng = str(tomb.get("engine", "unknown"))
                     self._engines[eng] = self._engines.get(eng, 0) + 1
+                    phases = (tomb.get("trace") or {}).get("phases")
+                    if phases:
+                        self._server_ms.append(
+                            float(phases.get("total_s", 0.0)) * 1e3)
+                        self._queue_wait_ms.append(
+                            float(phases.get("queue_wait_s", 0.0)) * 1e3)
                 done = len(self._lat_ms)
             if self.on_progress is not None:
                 self.on_progress(done)
@@ -188,6 +201,8 @@ class ClosedLoopLoadGen:
         wall_s = time.perf_counter() - t0
         with self._lock:
             lats = list(self._lat_ms)
+            server_ms = list(self._server_ms)
+            queue_wait_ms = list(self._queue_wait_ms)
             engines = dict(self._engines)
             sheds, rejects = self._sheds, self._rejects
             failed, timeouts = self._failed, self._timeouts
@@ -196,11 +211,25 @@ class ClosedLoopLoadGen:
         attempts = completed + failed + timeouts + sheds
         t1 = engines.get("cache", 0)
         t2 = engines.get("cache_t2", 0)
+        client_p50 = _percentile(lats, 0.50)
+        server_p50 = _percentile(server_ms, 0.50)
+        # Client-observed minus server-attributed at the median: the
+        # tombstone-poll artifact (bounded by poll_s plus scheduling
+        # jitter).  Reported, never folded into either latency.
+        gap_ms = client_p50 - server_p50 if server_ms else 0.0
         return {
             "studies_per_s": round(completed / wall_s, 3) if wall_s
             else 0.0,
-            "p50_ms": round(_percentile(lats, 0.50), 3),
+            "p50_ms": round(client_p50, 3),
             "p99_ms": round(_percentile(lats, 0.99), 3),
+            "server_p50_ms": round(server_p50, 3),
+            "server_p99_ms": round(_percentile(server_ms, 0.99), 3),
+            "client_server_gap_ms": round(gap_ms, 3),
+            "queue_wait_p50_ms": round(
+                _percentile(queue_wait_ms, 0.50), 3),
+            "queue_wait_p99_ms": round(
+                _percentile(queue_wait_ms, 0.99), 3),
+            "traced": len(server_ms),
             "shed_rate": round(sheds / attempts, 5) if attempts
             else 0.0,
             "cache_hit_tier1": round(t1 / completed, 5) if completed
