@@ -856,6 +856,132 @@ def bench_sched():
     }
 
 
+def bench_serve_cb():
+    """Continuous-batching A/B on the study axis (docs/serving.md
+    "Continuous batching"): the SAME Poisson mixed-duration unique-
+    study arrivals served twice by an in-process warm worker — once
+    with the static study axis (``PYABC_TPU_SERVE_CB=0``: every lane's
+    ticket settles at batch drain, so a short study waits O(longest
+    peer)) and once with windowed lane turnover (retire/publish/refill
+    at ``PYABC_TPU_SERVE_CB_WINDOW`` boundaries: O(own run + one
+    window)).  In-process so the lane-turnover/occupancy counters and
+    the XLA compile counter are read directly, not scraped.
+
+    Headline sentinel rows: ``serve_cb_p99_ms`` (fail-high — the tail
+    the windowing exists to cut) and ``serve_cb_recompiles``
+    (zero-tolerance — ≥3 consecutive lane turnovers at a fixed batch
+    shape must re-enter the pooled program, never re-trace it);
+    ``serve_cb_static_p99_ms`` rides along so the A/B is in the
+    record, and both shed rates are emitted (CB must not shed more)."""
+    import tempfile
+    import threading
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.autotune import (compile_counters,
+                                    install_compile_listener)
+    from pyabc_tpu.models import gaussian_model
+    from pyabc_tpu.serve import (ServeWorker, StudyBatch, StudyQueue,
+                                 StudySpec)
+    from pyabc_tpu.telemetry.metrics import REGISTRY
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from loadgen import ClosedLoopLoadGen
+
+    n_cb = max(int(os.environ.get("BENCH_SERVE_CB_STUDIES", "96")), 8)
+    root = tempfile.mkdtemp(prefix="bench_serve_cb_")
+
+    def cb_spec(seed, gens, tag):
+        # ONE batch_key (pop/prior/model are the program shape):
+        # duration and seed are per-lane operands, which is what lets
+        # the mixed pool share one compiled window program
+        return StudySpec(
+            model=gaussian_model,
+            prior=pt.Distribution(mu=pt.RV("norm", 0.0, 1.0)),
+            observed={"y": 0.1 * (seed % 5)}, population_size=100,
+            seed=seed, tenant=f"cb_{tag}", max_generations=gens)
+
+    def phase(cb_on, tag):
+        # 3 shorts : 1 long — the tail of the static profile is a
+        # short study stuck behind a 6x-longer peer in its batch
+        pool = [cb_spec(4 * i + j, 12 if j == 3 else 2, tag)
+                for i in range(n_cb // 4) for j in range(4)]
+        env = {"PYABC_TPU_SERVE_CB": "1" if cb_on else "0",
+               "PYABC_TPU_SERVE_MULTIPLEX": "8",
+               "PYABC_TPU_SERVE_CB_WINDOW": "2"}
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            queue = StudyQueue(root=os.path.join(root, tag),
+                               max_depth=4096, tenant_quota=4096)
+            worker = ServeWorker(root=queue.root,
+                                 worker_id=f"w_{tag}")
+            th = threading.Thread(
+                target=lambda: worker.run_forever(queue, poll_s=0.005),
+                daemon=True)
+            th.start()
+            gen = ClosedLoopLoadGen(
+                queue, pool, n_studies=len(pool), clients=16,
+                rate_hz=100.0, seed=5, unique=True,
+                study_timeout_s=300.0)
+            report = gen.run()
+            worker.drain()
+            th.join(timeout=60.0)
+            return report
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    static_rep = phase(False, "static")
+    turn0 = REGISTRY.counter("serve_cb_lane_turnovers_total").value
+    win0 = REGISTRY.counter("serve_cb_windows_total").value
+    cb_rep = phase(True, "cb")
+    turnovers = REGISTRY.counter(
+        "serve_cb_lane_turnovers_total").value - turn0
+    windows = REGISTRY.counter("serve_cb_windows_total").value - win0
+    occupancy = REGISTRY.gauge("serve_cb_occupancy").value
+
+    # the zero-tolerance row, measured as its own controlled segment:
+    # ≥3 consecutive admit/retire turnovers at a FIXED batch shape —
+    # compile delta after the first window must be exactly zero
+    install_compile_listener()
+    probe = StudyBatch([cb_spec(9000, 2, "probe"),
+                        cb_spec(9001, 2, "probe")],
+                       program_cache={}, window=1)
+    probe.step_window()
+    n0 = compile_counters()["n_compiles"]
+    waiting = [cb_spec(9000 + s, 2, "probe") for s in (2, 3, 4)]
+    for _ in range(64):
+        for slot in probe.step_window():
+            probe.retire(slot)
+            if waiting:
+                probe.admit(waiting.pop(0), slot=slot)
+        if not waiting and not probe.unfinished():
+            break
+    recompiles = compile_counters()["n_compiles"] - n0
+
+    return {
+        "serve_cb_p50_ms": cb_rep["p50_ms"],
+        "serve_cb_p99_ms": cb_rep["p99_ms"],
+        "serve_cb_static_p50_ms": static_rep["p50_ms"],
+        "serve_cb_static_p99_ms": static_rep["p99_ms"],
+        "serve_cb_p99_speedup": round(
+            static_rep["p99_ms"] / max(cb_rep["p99_ms"], 1e-9), 3),
+        "serve_cb_shed_rate": cb_rep["shed_rate"],
+        "serve_cb_static_shed_rate": static_rep["shed_rate"],
+        "serve_cb_studies": cb_rep["completed"],
+        "serve_cb_failed": cb_rep["failed"] + cb_rep["timeouts"]
+        + static_rep["failed"] + static_rep["timeouts"],
+        "serve_cb_lane_turnovers": int(turnovers),
+        "serve_cb_windows": int(windows),
+        "serve_cb_occupancy": round(occupancy, 4),
+        "serve_cb_recompiles": int(recompiles),
+    }
+
+
 def bench_serve_load():
     """Serving DATA-PLANE row: a ≥1e4-study closed-loop load run
     against ≥2 platform-managed workers — the fleet-scale mirror of
@@ -871,7 +997,10 @@ def bench_serve_load():
     (fail-low), ``serve_load_p99_ms`` and ``serve_load_shed_rate``
     (fail-high), plus the tier-1/tier-2 cache hit split — the two-tier
     contract (docs/serving.md "Data plane") priced end to end:
-    submit → partition → claim → serve → tombstone."""
+    submit → partition → claim → serve → tombstone.  The row also
+    carries :func:`bench_serve_cb`'s continuous-batching A/B
+    (``serve_cb_*``): the static-vs-windowed p99 step and the
+    zero-recompile lane-turnover contract."""
     import tempfile
     import threading
 
@@ -1016,6 +1145,9 @@ def bench_serve_load():
             report["client_server_gap_ms"],
         "serve_trace_events_total": trace_lines,
         "serve_trace_overhead_pct": round(overhead_pct, 4),
+        # continuous-batching A/B rides the serve_load row: same
+        # process, in-process worker, directly-read counters
+        **bench_serve_cb(),
     }
 
 

@@ -76,12 +76,13 @@ SITE_STORE_HYDRATE = "store.hydrate"
 SITE_MATERIALIZE = "history.materialize"
 SITE_JOURNAL = "journal.write"
 SITE_DRAIN = "run.drain"
+SITE_SERVE_WINDOW = "serve.window"
 
 #: every named fault site, for validation and docs
 SITES = (SITE_DISPATCH, SITE_FETCH, SITE_APPEND, SITE_HEARTBEAT,
          SITE_PREEMPT, SITE_STORE_DEPOSIT, SITE_STORE_SPILL,
          SITE_STORE_HYDRATE, SITE_MATERIALIZE, SITE_JOURNAL,
-         SITE_DRAIN)
+         SITE_DRAIN, SITE_SERVE_WINDOW)
 
 FAULTS_ENV = "PYABC_TPU_FAULTS"
 FAULT_SEED_ENV = "PYABC_TPU_FAULT_SEED"

@@ -60,6 +60,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 Array = jnp.ndarray
@@ -929,3 +930,37 @@ def build_onedispatch_run(
         return pop_f, ctl_out, bufs_f
 
     return onedispatch
+
+
+# ---------------------------------------------------------------------------
+# Per-lane carry surgery: window re-entry on a batched (vmapped) axis
+# ---------------------------------------------------------------------------
+#
+# A windowed dispatch (serve/multiplex.py's continuous-batching engine,
+# or any future batched re-entrant program) parks its whole state in a
+# pytree whose every leaf carries the batch axis first.  Between
+# dispatches the host retires and admits individual lanes, which is row
+# surgery on that tree: pull one lane's rows out (retire/publish), or
+# write one lane's rows in (admit a fresh study, transplant a live lane
+# into a differently-runged batch).  The math inside the program is
+# row-local, so a transplanted row re-enters bit-identically — these
+# helpers only move bytes, never compute.
+
+def lane_extract(carry, row: int):
+    """One lane's slice of a batched carry: ``leaf[row]`` for every
+    leaf, materialized on the host (``np.asarray``) so the result is
+    stable storage independent of any in-flight device buffer."""
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf)[row], carry)
+
+
+def lane_splice(carry, row: int, values):
+    """A new carry with ``values`` (one lane's rows, as produced by
+    :func:`lane_extract`) written at ``row`` of every leaf.  Leaves are
+    copied, never mutated in place — the input carry may still back a
+    dispatch in flight."""
+    def _set(leaf, val):
+        out = np.array(np.asarray(leaf), copy=True)
+        out[row] = val
+        return out
+    return jax.tree_util.tree_map(_set, carry, values)

@@ -22,7 +22,9 @@ programs warm across studies.  Five pieces:
   fleet's served p99 breach the configured SLO knobs
   (``PYABC_TPU_SERVE_SLO_DEPTH``, ``PYABC_TPU_SERVE_SLO_P99_MS``);
 - :mod:`pyabc_tpu.serve.multiplex` — the study axis: N small studies
-  vmapped into ONE fused program with per-study live-sentinel masking;
+  vmapped into ONE fused program with per-study live-sentinel masking,
+  dispatched in re-entrant windows so lanes retire/join continuously
+  (``PYABC_TPU_SERVE_CB*``);
 - :mod:`pyabc_tpu.serve.worker` — the persistent warm worker
   (``abc-serve``) pinning the AOT :class:`CompiledLadder` across
   studies and routing eligible ones through ``run_mode="onedispatch"``.
@@ -33,7 +35,8 @@ documented in ``docs/serving.md``.
 
 from .admission import AdmissionController, ServeOverloaded
 from .cache import SharedResultStore, StudyCache, TieredStudyCache
-from .multiplex import StudyBatch, lane_eligible, multiplex_eligible
+from .multiplex import (ShapeHysteresis, StudyBatch, lane_eligible,
+                        multiplex_eligible)
 from .queue import (QueueFull, SpecAuthError, StudyQueue,
                     TenantQuotaExceeded)
 from .spec import StudySpec, problem_key, study_digest
@@ -44,6 +47,7 @@ __all__ = [
     "QueueFull",
     "ServeOverloaded",
     "ServeWorker",
+    "ShapeHysteresis",
     "SharedResultStore",
     "SpecAuthError",
     "StudyBatch",

@@ -6,7 +6,7 @@ seed and stop budget.  Running them one-by-one pays a full dispatch
 (and its host↔device round-trips) per study; the multiplexer instead
 stacks eligible studies along a leading *study axis* and ``vmap``\\ s a
 self-contained ABC-SMC engine over it: one compiled program, one
-dispatch, ``S`` posteriors.
+dispatch per window, ``S`` posteriors.
 
 Eligibility (:func:`batch_key`) is what the compiled program shapes
 depend on: same model code, same prior config, same population size,
@@ -16,24 +16,44 @@ as per-study operands — tenants with different datasets DO batch.  The
 study count is padded to a power-of-two rung (dead slots carry
 ``live=False`` from step 0) so batch sizes 3, 5, 7 share one program.
 
+**Continuous batching.**  The compiled program is a *window*: a fixed
+``fori_loop`` of :data:`cb_window` generations over the batch carry,
+re-entered from the host between windows.  The window boundary is the
+join/leave point (the study axis's ``onedispatch_max_t`` analog): the
+worker retires lanes that stopped (their live-mask already isolates
+them bitwise), publishes their results immediately, and admits queued
+same-``batch_key`` studies into the freed slots — a fresh lane is
+marked by ``gens == 0`` and runs its generation-0 init *inside* the
+compiled window, so admission at any boundary re-enters the SAME
+program with zero new XLA compiles.  :class:`ShapeHysteresis` keeps a
+partially-empty batch on its current rung (refill beats recompile)
+and only shrinks after N consecutive underfilled windows.
+
 Determinism contract — the acceptance bar pinned by
 ``tests/test_serve.py``: every lane is **bit-identical** to the same
-study served through a batch of one.  Everything in the engine is
-study-local (``fold_in`` RNG chains, row-wise sort / cumsum /
-searchsorted / logsumexp, no cross-study reductions), the generation
-loop is a fixed-trip ``fori_loop`` with explicit ``live`` masking, and
-stopping never changes shapes — so the batched lanes and the solo lane
-trace to the same per-element op sequence.
+study served through a batch of one, and a lane admitted mid-batch is
+bit-identical to the same study in a fresh batch.  Everything in the
+engine is study-local (``fold_in`` RNG chains keyed by the lane's OWN
+generation counter, row-wise sort / cumsum / searchsorted / logsumexp,
+no cross-study reductions), the window body is an identity op for
+non-live lanes, and stopping never changes shapes — so windowed
+re-entry, lane turnover and solo lanes all trace the same per-element
+op sequence.
 
 Knobs: ``PYABC_TPU_SERVE_MULTIPLEX`` — max studies per batch
-(default 8; ``1`` disables multiplexing) and
+(default 8; ``1`` disables multiplexing),
 ``PYABC_TPU_SERVE_MULTIPLEX_MAX_POP`` — the largest population the
-study-axis engine accepts (default 4096).  The importance-weight
-kernel is O(pop²) per lane, so big studies belong on the warm solo
-one-dispatch engine; :func:`lane_eligible` is the routing predicate
-the worker applies to EVERY miss, batched or alone — the engine a
-study runs on is a function of the spec and the worker config, never
-of what else happened to be in the queue.
+study-axis engine accepts (default 4096), ``PYABC_TPU_SERVE_CB`` —
+the worker's continuous-batching loop (default on),
+``PYABC_TPU_SERVE_CB_WINDOW`` — generations per compiled window
+(default 8), and ``PYABC_TPU_SERVE_CB_SHRINK_AFTER`` — consecutive
+underfilled windows before the batch shrinks to a smaller rung
+(default 4).  The importance-weight kernel is O(pop²) per lane, so
+big studies belong on the warm solo one-dispatch engine;
+:func:`lane_eligible` is the routing predicate the worker applies to
+EVERY miss, batched or alone — the engine a study runs on is a
+function of the spec and the worker config, never of what else
+happened to be in the queue.
 """
 
 from __future__ import annotations
@@ -45,6 +65,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..sampler.fused import lane_extract, lane_splice
 from .spec import (StudySpec, _callable_fingerprint, _digest_of,
                    _prior_config)
 
@@ -54,8 +75,20 @@ MULTIPLEX_ENV = "PYABC_TPU_SERVE_MULTIPLEX"
 #: largest population_size routed onto the study axis
 MULTIPLEX_MAX_POP_ENV = "PYABC_TPU_SERVE_MULTIPLEX_MAX_POP"
 
+#: the worker's continuous-batching window loop (default on; "0"
+#: restores drain-at-batch-end static batching)
+CB_ENV = "PYABC_TPU_SERVE_CB"
+
+#: generations per compiled window — the lane join/leave granularity
+CB_WINDOW_ENV = "PYABC_TPU_SERVE_CB_WINDOW"
+
+#: consecutive underfilled windows before the batch shrinks its rung
+CB_SHRINK_AFTER_ENV = "PYABC_TPU_SERVE_CB_SHRINK_AFTER"
+
 _DEFAULT_MULTIPLEX = 8
 _DEFAULT_MAX_POP = 4096
+_DEFAULT_CB_WINDOW = 8
+_DEFAULT_CB_SHRINK_AFTER = 4
 
 #: rejection rounds per generation before a lane declares undershoot
 _MAX_ROUNDS = 16
@@ -84,6 +117,31 @@ def multiplex_max_pop() -> int:
                                       str(_DEFAULT_MAX_POP))), 1)
     except ValueError:
         return _DEFAULT_MAX_POP
+
+
+def cb_enabled() -> bool:
+    """``$PYABC_TPU_SERVE_CB`` — default ON."""
+    return os.environ.get(CB_ENV, "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+def cb_window() -> int:
+    """``$PYABC_TPU_SERVE_CB_WINDOW`` — generations per window."""
+    try:
+        return max(int(os.environ.get(CB_WINDOW_ENV,
+                                      str(_DEFAULT_CB_WINDOW))), 1)
+    except ValueError:
+        return _DEFAULT_CB_WINDOW
+
+
+def cb_shrink_after() -> int:
+    """``$PYABC_TPU_SERVE_CB_SHRINK_AFTER`` — hysteresis depth."""
+    try:
+        return max(int(os.environ.get(CB_SHRINK_AFTER_ENV,
+                                      str(_DEFAULT_CB_SHRINK_AFTER))),
+                   1)
+    except ValueError:
+        return _DEFAULT_CB_SHRINK_AFTER
 
 
 def lane_eligible(spec: StudySpec) -> bool:
@@ -159,29 +217,69 @@ def _flatten_observed(observed: Dict, layout) -> np.ndarray:
     return np.concatenate(cols) if cols else np.zeros((0,), np.float32)
 
 
+class ShapeHysteresis:
+    """Batch-shape hysteresis for the continuous-batching loop.
+
+    A lane retiring leaves the batch underfilled; recompiling (or even
+    pool-switching) to a narrower rung on the first empty slot would
+    thrash the compiled-program LRU every time occupancy crosses a
+    pow2 boundary.  The worker instead calls :meth:`observe` once per
+    window, AFTER attempting a refill: only when the occupancy has fit
+    a strictly smaller rung for ``shrink_after`` consecutive windows
+    (``PYABC_TPU_SERVE_CB_SHRINK_AFTER``) does it return True and the
+    batch shrinks — refilling the current shape always wins while the
+    queue still feeds it."""
+
+    def __init__(self, shrink_after: Optional[int] = None):
+        self.shrink_after = (cb_shrink_after() if shrink_after is None
+                             else max(int(shrink_after), 1))
+        self.streak = 0
+
+    def observe(self, occupied: int, rung: int) -> bool:
+        """Record one post-refill window; True == shrink now."""
+        if rung > 1 and occupied > 0 and _pow2_ceil(occupied) < rung:
+            self.streak += 1
+        else:
+            self.streak = 0
+        if self.streak >= self.shrink_after:
+            self.streak = 0
+            return True
+        return False
+
+
 class StudyBatch:
     """One batch of eligible studies compiled into a single vmapped
-    SMC program (see module docstring for the engine and determinism
-    contract).  Instances own their compiled function — serve-tier
-    state lives on objects, never at module level (the
+    windowed SMC program (see module docstring for the engine and
+    determinism contract).  Instances own their compiled function —
+    serve-tier state lives on objects, never at module level (the
     ``study-isolation`` lint rule enforces this for the package).
 
+    The unit of dispatch is a *window* (:attr:`window` generations);
+    the batch carry re-enters the same program each window, and lanes
+    are retired (:meth:`retire`) / admitted (:meth:`admit`) between
+    windows — the continuous-batching surface the worker drives.
+    :meth:`run` remains the static driver: admit everything up front,
+    loop windows until every lane stops, return all results.
+
     ``program_cache`` (optional, caller-owned — the worker passes its
-    LRU) maps :attr:`program_key` → the jitted batch function, so a
+    LRU) maps :attr:`program_key` → the jitted window function, so a
     warm worker re-serves a previously seen (batch shape, rung,
-    budget) without tracing or compiling anything new.  Reuse is sound
+    window) without tracing or compiling anything new.  Reuse is sound
     because the key embeds :func:`batch_key`: any two batches sharing
     it have fingerprint-identical models and config-identical priors,
-    so the cached closure computes the same program."""
+    so the cached closure computes the same program.  Generation
+    budgets are traced operands — they no longer shape the program."""
 
     def __init__(self, specs: Sequence[StudySpec],
                  max_rounds: int = _MAX_ROUNDS,
-                 program_cache: Optional[MutableMapping] = None):
+                 program_cache: Optional[MutableMapping] = None,
+                 window: Optional[int] = None):
         if not specs:
             raise ValueError("empty study batch")
         keys = {batch_key(s) for s in specs}
         if len(keys) > 1:
             raise ValueError("studies are not batch-eligible together")
+        self.key = keys.pop()
         self.specs = list(specs)
         spec = self.specs[0]
         self.model = spec.model
@@ -194,31 +292,50 @@ class StudyBatch:
         self.alpha = float(spec.alpha)
         self.max_rounds = int(max_rounds)
         self.rung = _pow2_ceil(len(self.specs))
-        # static generation budget: pow2 rung over the batch's largest
-        # ask, so nearby budgets share one program
-        self.max_t = _pow2_ceil(
-            max(max(int(s.max_generations), 1) for s in self.specs))
-        self.program_key = (keys.pop(), self.rung, self.max_t,
+        self.window = (cb_window() if window is None
+                       else max(int(window), 1))
+        # the largest generation budget admitted so far — the static
+        # driver's window-count bound (budgets are traced operands, so
+        # this never shapes the program)
+        self.max_t = max(max(int(s.max_generations), 1)
+                         for s in self.specs)
+        self.program_key = (self.key, self.rung, self.window,
                             self.max_rounds)
         self.program_cache_hit = False
         fn = (None if program_cache is None
               else program_cache.get(self.program_key))
         if fn is None:
-            fn = jax.jit(jax.vmap(self._one_study))
+            fn = jax.jit(jax.vmap(self._one_window))
             if program_cache is not None:
                 program_cache[self.program_key] = fn
         else:
             self.program_cache_hit = True
         self._fn = fn
+        # ---- lane state (host side): per-slot operands + batch carry
+        S = self.rung
+        self.slots: List[Optional[StudySpec]] = [None] * S
+        self._keys = np.zeros(
+            (S,) + np.asarray(jax.random.PRNGKey(0)).shape, np.uint32)
+        self._y_obs = np.zeros((S, self.k), np.float32)
+        self._min_eps = np.zeros((S,), np.float32)
+        self._t_limit = np.ones((S,), np.int32)
+        self._alive = np.zeros((S,), bool)
+        self._carry = self._zero_carry()
+        self.windows = 0
+        self.turnovers = 0
+        self.admitted = 0
+        for s in self.specs:
+            self.admit(s)
 
     def trace_info(self) -> dict:
         """The batch attributes a lifecycle ``batched`` event carries
         (serve/tracing.py): enough to explain, per study, which fused
         program it rode and whether that program was already warm."""
         return {
-            "batch_key": str(self.program_key[0])[:12],
-            "width": len(self.specs),
+            "batch_key": str(self.key)[:12],
+            "width": self.occupied(),
             "rung": self.rung,
+            "window": self.window,
             "program_cache_hit": self.program_cache_hit,
         }
 
@@ -292,26 +409,40 @@ class StudyBatch:
         return (success, eps_t, new_theta, new_w, new_dist,
                 jnp.sum(active_rounds))
 
-    def _one_study(self, key, y_obs, min_eps, t_limit, alive):
-        """Whole-study program for ONE lane.  Everything here is
-        study-local; ``vmap`` lifts it onto the study axis without
-        cross-lane math — the bit-identity contract."""
+    def _one_window(self, key, y_obs, min_eps, t_limit, alive, carry):
+        """One re-entrant WINDOW of the per-lane program.  Everything
+        here is study-local; ``vmap`` lifts it onto the study axis
+        without cross-lane math — the bit-identity contract.
+
+        A fresh lane (``gens == 0``) runs its generation-0 init here,
+        masked in per-lane: the init is computed unconditionally from
+        the lane's own key and selected with a scalar ``where``, so a
+        study admitted at ANY window boundary traces exactly the op
+        sequence of the same study in a fresh batch.  Retired / padded
+        lanes (``live == False``) ride the window body as an identity
+        op — extra windows never change their bits."""
         n = self.n
+        (theta, w, dist, eps, gens, live, code, acc_tot,
+         rounds_tot) = carry
         # generation 0: straight prior draw, uniform weights
+        fresh = alive & (gens == 0)
         k0 = jax.random.fold_in(key, 0)
         k_prior, k_model = jax.random.split(k0)
-        theta = self.prior.rvs_array(k_prior, n)
-        x0 = _flatten_stats(self.model(k_model, theta), self.layout, n)
-        dist = self._distance(x0, y_obs)
-        w = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
-        eps0 = jnp.asarray(jnp.inf, jnp.float32)
-
-        live0 = alive & (t_limit > 1)
-        code0 = jnp.where(alive,
-                          jnp.where(live0, STOP_RUNNING, STOP_BUDGET),
-                          STOP_BUDGET)
-        carry0 = (theta, w, dist, eps0, jnp.int32(1), live0,
-                  code0.astype(jnp.int32), jnp.int32(n), jnp.int32(0))
+        theta0 = self.prior.rvs_array(k_prior, n)
+        x0 = _flatten_stats(self.model(k_model, theta0), self.layout, n)
+        dist0 = self._distance(x0, y_obs)
+        w0 = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
+        live_f = fresh & (t_limit > 1)
+        code_f = jnp.where(live_f, STOP_RUNNING, STOP_BUDGET)
+        theta = jnp.where(fresh, theta0, theta)
+        w = jnp.where(fresh, w0, w)
+        dist = jnp.where(fresh, dist0, dist)
+        eps = jnp.where(fresh, jnp.asarray(jnp.inf, jnp.float32), eps)
+        gens = jnp.where(fresh, jnp.int32(1), gens)
+        live = jnp.where(fresh, live_f, live)
+        code = jnp.where(fresh, code_f, code).astype(jnp.int32)
+        acc_tot = jnp.where(fresh, jnp.int32(n), acc_tot)
+        rounds_tot = jnp.where(fresh, jnp.int32(0), rounds_tot)
 
         def body(i, carry):
             (theta, w, dist, eps, gens, live, code, acc_tot,
@@ -340,41 +471,144 @@ class StudyBatch:
             return (theta, w, dist, eps, gens, live,
                     code.astype(jnp.int32), acc_tot, rounds_tot)
 
+        carry = (theta, w, dist, eps, gens, live, code, acc_tot,
+                 rounds_tot)
+        return jax.lax.fori_loop(0, self.window, body, carry)
+
+    # ---- lane surgery (between windows) ---------------------------------
+
+    def _zero_carry(self):
+        S, n, d = self.rung, self.n, self.d
+        return (np.zeros((S, n, d), np.float32),   # theta
+                np.zeros((S, n), np.float32),      # w
+                np.zeros((S, n), np.float32),      # dist
+                np.zeros((S,), np.float32),        # eps
+                np.zeros((S,), np.int32),          # gens (0 == fresh)
+                np.zeros((S,), bool),              # live
+                np.zeros((S,), np.int32),          # stop code
+                np.zeros((S,), np.int32),          # accepted
+                np.zeros((S,), np.int32))          # rounds
+
+    def occupied(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def occupancy(self) -> float:
+        """Occupied fraction of the rung — the batch-utilization gauge."""
+        return self.occupied() / self.rung
+
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def unfinished(self) -> List[int]:
+        """Occupied slots that have not stopped yet (not dispatched,
+        or still live)."""
+        gens, live = self._carry[4], self._carry[5]
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and (gens[i] == 0 or live[i])]
+
+    def admit(self, spec: StudySpec,
+              slot: Optional[int] = None) -> int:
+        """Seat a study in a free lane: fresh per-lane RNG chain and
+        operands, carry rows zeroed so the next window runs its
+        generation-0 init in-program.  Returns the slot index."""
+        if batch_key(spec) != self.key:
+            raise ValueError("spec is not batch-eligible here")
+        if slot is None:
+            free = self.free_slots()
+            if not free:
+                raise ValueError("no free lane")
+            slot = free[0]
+        elif self.slots[slot] is not None:
+            raise ValueError(f"slot {slot} is occupied")
+        self.slots[slot] = spec
+        self._keys[slot] = np.asarray(jax.random.PRNGKey(int(spec.seed)))
+        self._y_obs[slot] = _flatten_observed(spec.observed, self.layout)
+        self._min_eps[slot] = float(spec.minimum_epsilon)
+        self._t_limit[slot] = max(int(spec.max_generations), 1)
+        self._alive[slot] = True
+        self.max_t = max(self.max_t, int(self._t_limit[slot]))
+        zero_row = jax.tree_util.tree_map(
+            lambda leaf: np.zeros_like(leaf[0]), self._carry)
+        self._carry = lane_splice(self._carry, slot, zero_row)
+        self.admitted += 1
+        return slot
+
+    def retire(self, slot: int) -> None:
+        """Free a finished lane (read :meth:`result` first — the carry
+        row is dead storage once another study is admitted here)."""
+        if self.slots[slot] is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        self.slots[slot] = None
+        self._alive[slot] = False
+        self.turnovers += 1
+
+    def step_window(self) -> List[int]:
+        """Dispatch ONE window and return the occupied slots that have
+        now stopped (retire or re-admit them before the next call to
+        keep the report meaning *newly* finished)."""
+        carry = tuple(jnp.asarray(x) for x in self._carry)
+        out = self._fn(jnp.asarray(self._keys),
+                       jnp.asarray(self._y_obs),
+                       jnp.asarray(self._min_eps),
+                       jnp.asarray(self._t_limit),
+                       jnp.asarray(self._alive), carry)
+        self._carry = tuple(np.asarray(x) for x in out)
+        self.windows += 1
+        gens, live = self._carry[4], self._carry[5]
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and gens[i] > 0 and not live[i]]
+
+    def result(self, slot: int) -> dict:
+        """One lane's result dict, sliced from the batch carry."""
+        if self.slots[slot] is None:
+            raise ValueError(f"slot {slot} is not occupied")
         (theta, w, dist, eps, gens, live, code, acc_tot,
-         rounds_tot) = jax.lax.fori_loop(0, self.max_t, body, carry0)
-        code = jnp.where(live, STOP_BUDGET, code)
+         rounds_tot) = lane_extract(self._carry, slot)
+        # a lane cut off while still live stopped on the driver's
+        # window budget, not its own — report it as a budget stop
+        code = np.int32(STOP_BUDGET) if live else code
         return {
             "theta": theta, "w": w, "dist": dist, "eps": eps,
             "gens": gens, "stop_code": code, "accepted": acc_tot,
             "rounds": rounds_tot,
         }
 
-    # ---- batch driver ----------------------------------------------------
+    def shrink(self, program_cache: Optional[MutableMapping] = None
+               ) -> Tuple["StudyBatch", Dict[int, int]]:
+        """A new batch at the pow2 rung of the current occupancy, every
+        occupied lane's carry transplanted row-by-row
+        (:func:`~pyabc_tpu.sampler.fused.lane_splice`) so in-flight
+        lanes re-enter mid-run.  Lane math is row-local, so a
+        transplanted lane computes the same values on the narrower
+        rung.  Returns ``(new_batch, {old_slot: new_slot})``."""
+        occ = [(i, s) for i, s in enumerate(self.slots)
+               if s is not None]
+        if not occ:
+            raise ValueError("nothing to shrink")
+        nb = StudyBatch([s for _i, s in occ],
+                        max_rounds=self.max_rounds,
+                        program_cache=program_cache,
+                        window=self.window)
+        slot_map: Dict[int, int] = {}
+        for j, (i, _s) in enumerate(occ):
+            nb._carry = lane_splice(nb._carry, j,
+                                    lane_extract(self._carry, i))
+            slot_map[i] = j
+        nb.windows = self.windows
+        nb.turnovers = self.turnovers
+        nb.admitted = self.admitted
+        return nb, slot_map
 
-    def _operands(self):
-        S, k = self.rung, self.k
-        keys = np.zeros((S,) + np.asarray(
-            jax.random.PRNGKey(0)).shape, np.uint32)
-        y_obs = np.zeros((S, k), np.float32)
-        min_eps = np.zeros((S,), np.float32)
-        t_limit = np.zeros((S,), np.int32)
-        alive = np.zeros((S,), bool)
-        for i, s in enumerate(self.specs):
-            keys[i] = np.asarray(jax.random.PRNGKey(int(s.seed)))
-            y_obs[i] = _flatten_observed(s.observed, self.layout)
-            min_eps[i] = float(s.minimum_epsilon)
-            t_limit[i] = max(int(s.max_generations), 1)
-            alive[i] = True
-        return (jnp.asarray(keys), jnp.asarray(y_obs),
-                jnp.asarray(min_eps), jnp.asarray(t_limit),
-                jnp.asarray(alive))
+    # ---- static batch driver --------------------------------------------
 
     def run(self) -> List[dict]:
-        """Dispatch the batch; returns one result dict per submitted
-        study (dead padding lanes are dropped)."""
-        out = self._fn(*self._operands())
-        out = jax.tree_util.tree_map(np.asarray, out)
-        results = []
-        for i, _s in enumerate(self.specs):
-            results.append({k: v[i] for k, v in out.items()})
-        return results
+        """Static driver: loop windows until every admitted lane stops;
+        returns one result dict per constructor study (dead padding
+        lanes are dropped).  Assumes no concurrent admit/retire — the
+        continuous-batching loop drives :meth:`step_window` itself."""
+        budget = (self.max_t + self.window - 1) // self.window + 1
+        for _ in range(budget):
+            self.step_window()
+            if not self.unfinished():
+                break
+        return [self.result(i) for i in range(len(self.specs))]

@@ -242,6 +242,15 @@ class Ticket:
         when tracing was off at submit)."""
         return (self._payload or {}).get("trace_id")
 
+    @property
+    def batch_key(self) -> Optional[str]:
+        """The spec's study-axis grouping key
+        (:func:`~pyabc_tpu.serve.multiplex.batch_key`), stamped at
+        submit so a keyed claim can filter candidates WITHOUT
+        unpickling specs.  ``None`` on pre-stamp tickets — they never
+        match a keyed claim, only plain ones."""
+        return (self._payload or {}).get("batch_key")
+
     def load_spec(self) -> StudySpec:
         """Reconstruct the spec.  Unpickling EXECUTES code: with no
         ``PYABC_TPU_SERVE_HMAC_KEY`` configured, submitters are
@@ -533,6 +542,7 @@ class StudyQueue:
                     retry_after_s=getattr(exc, "retry_after_s", None))
                 raise
         sid = f"{time.time_ns():019d}-{digest[:12]}-{uuid.uuid4().hex[:8]}"
+        from .multiplex import batch_key as _batch_key
         payload = {
             "id": sid,
             "digest": digest,
@@ -540,6 +550,10 @@ class StudyQueue:
             "priority": int(spec.priority),
             "submitted_unix": time.time(),
             "requeues": 0,
+            # the study-axis grouping key, in the clear: keyed claims
+            # (the continuous-batching refill) filter on it without
+            # unpickling the spec
+            "batch_key": _batch_key(spec),
             "spec_b64": base64.b64encode(
                 pickle.dumps(spec)).decode("ascii"),
         }
@@ -574,9 +588,19 @@ class StudyQueue:
 
     # ---- worker side -----------------------------------------------------
 
-    def claim(self, worker_id: Optional[str] = None) -> Optional[Ticket]:
+    def claim(self, worker_id: Optional[str] = None,
+              batch_key: Optional[str] = None) -> Optional[Ticket]:
         """Claim the highest aged-priority pending study (atomic
         rename; a lost race just moves on to the next candidate).
+
+        ``batch_key`` keys the claim: only tickets stamped with that
+        study-axis grouping key are candidates — the continuous-
+        batching refill path, which must not steal work it cannot seat
+        in the open batch.  The scan order (partition rotation), the
+        aged-priority order WITHIN the key, the lease stamp and the
+        ``claimed`` event are all identical to a plain claim; tickets
+        without a stamp (pre-stamp submitters) are skipped by keyed
+        claims and left for plain ones.
 
         The lease stamp travels WITH the rename: the pending file's
         mtime is refreshed *first*, then the rename moves it — so there
@@ -612,8 +636,12 @@ class StudyQueue:
         seen = set(scan)
         scan.extend(d for d in self._pending_dirs() if d not in seen)
         for dirpath in scan:
+            tickets = self._list_dir(dirpath)
+            if batch_key is not None:
+                tickets = [t for t in tickets
+                           if t.batch_key == batch_key]
             candidates = sorted(
-                self._list_dir(dirpath),
+                tickets,
                 key=lambda t: (-t.effective_priority(self.aging_s, now),
                                t.submitted_unix, t.id))
             for t in candidates:

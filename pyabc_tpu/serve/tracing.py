@@ -5,10 +5,12 @@ stamped into its ticket payload and carried for its whole life.  Each
 state transition — ``submitted``, ``shed``/``rejected``,
 ``queued(partition)``, ``claimed(worker, bounce)``,
 ``cache_hit(tier)``, ``batched(engine, batch_key, width)``,
-``dispatched``, ``drained``, ``published``, ``tombstoned``, plus the
-scheduler-driven ``requeued`` and the durable resume's
-``rescued(resumed_from_gen)`` — appends ONE structured JSON line to a
-per-partition, append-only event log under the serve root::
+``dispatched``, ``drained``, ``published``, ``tombstoned``, the
+continuous-batching lane markers ``lane_joined(slot, window)`` /
+``lane_retired(slot, windows)``, plus the scheduler-driven
+``requeued`` and the durable resume's ``rescued(resumed_from_gen)`` —
+appends ONE structured JSON line to a per-partition, append-only
+event log under the serve root::
 
     <serve root>/trace/p0000/<bucket>.jsonl
     <serve root>/trace/p0001/<bucket>.jsonl
@@ -87,7 +89,7 @@ TRACE_SUBDIR = "trace"
 EVENTS = frozenset({
     "submitted", "rejected", "shed", "queued", "claimed", "cache_hit",
     "batched", "dispatched", "drained", "published", "requeued",
-    "rescued", "tombstoned",
+    "rescued", "tombstoned", "lane_joined", "lane_retired",
 })
 
 

@@ -52,13 +52,14 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..resilience.faults import SITE_SERVE_WINDOW, fault_point
 from ..telemetry import studytrace
 from ..telemetry.metrics import REGISTRY
 from .admission import publish_latency_snapshot, slo_p99_ms_configured
 from .cache import StudyCache, TieredStudyCache
-from .multiplex import (STOP_NAMES, StudyBatch, batch_key,
-                        lane_eligible, multiplex_eligible,
-                        multiplex_width)
+from .multiplex import (STOP_NAMES, ShapeHysteresis, StudyBatch,
+                        batch_key, cb_enabled, lane_eligible,
+                        multiplex_eligible, multiplex_width)
 from .queue import StudyQueue, Ticket, default_worker_id, serve_root
 from .spec import StudySpec, problem_key, study_digest
 
@@ -342,15 +343,8 @@ class ServeWorker:
         self._emit(spec, "published", tier=tier or "t1")
         return summary
 
-    def _run_batch(self, group: Sequence[StudySpec],
-                   on_built=None) -> List[dict]:
-        """Dispatch one study-axis batch through the worker's compiled
-        program pool — a repeat (batch shape, rung, budget) reuses the
-        jitted function, so sequential eligible studies after the
-        first compile nothing."""
-        from ..autotune import install_compile_listener
-        install_compile_listener()
-        batch = StudyBatch(group, program_cache=self._batch_programs)
+    def _note_batch_program(self, batch: StudyBatch):
+        """Program-pool LRU bookkeeping for one resolved batch."""
         if batch.program_cache_hit:
             self._batch_programs.move_to_end(batch.program_key)
             REGISTRY.counter(
@@ -367,6 +361,17 @@ class ServeWorker:
             REGISTRY.counter(
                 "serve_batch_program_evictions_total",
                 "study-axis programs dropped by the pool LRU").inc()
+
+    def _run_batch(self, group: Sequence[StudySpec],
+                   on_built=None) -> List[dict]:
+        """Dispatch one study-axis batch through the worker's compiled
+        program pool — a repeat (batch shape, rung, window) reuses the
+        jitted function, so sequential eligible studies after the
+        first compile nothing."""
+        from ..autotune import install_compile_listener
+        install_compile_listener()
+        batch = StudyBatch(group, program_cache=self._batch_programs)
+        self._note_batch_program(batch)
         if on_built is not None:
             # the program is resolved (built or pool-warm): the trace's
             # compile phase ends here, the device phase starts with run
@@ -578,6 +583,210 @@ class ServeWorker:
                     spec, summary, time.perf_counter() - t0, engine)
         return [s for s in out if s is not None]
 
+    # ---- continuous batching (the windowed queue loop) -------------------
+
+    def _serve_static(self, queue: StudyQueue,
+                      loaded: Sequence[Tuple[Ticket, StudySpec]]):
+        """Serve one claimed batch statically (``serve_many``) and
+        settle every ticket at batch drain — the pre-CB data plane,
+        still the path for solo-routed work and ``PYABC_TPU_SERVE_CB=0``."""
+        t0 = time.perf_counter()
+        try:
+            summaries = self.serve_many([s for _tk, s in loaded])
+        except Exception as exc:
+            for tk, s in loaded:
+                queue.fail(tk, repr(exc), trace=self._trace_fold(s))
+            return
+        wall = time.perf_counter() - t0
+        for (tk, s), summary in zip(loaded, summaries):
+            queue.complete(tk, wall_s=wall,
+                           engine=summary.get("served_from", "solo"),
+                           trace=self._trace_fold(s))
+
+    def _serve_continuous(self, queue: StudyQueue,
+                          loaded: Sequence[Tuple[Ticket, StudySpec]]):
+        """Serve one claimed batch with continuous batching: every
+        lane-eligible miss joins a windowed ``StudyBatch`` session
+        (:meth:`_cb_session`) whose lanes retire, publish and refill at
+        window boundaries; cache hits, in-claim duplicates and
+        solo-routed work ride the static path unchanged."""
+        lanes: List[Tuple[Ticket, StudySpec, str]] = []
+        static: List[Tuple[Ticket, StudySpec]] = []
+        seen = set()
+        for tk, spec in loaded:
+            digest = study_digest(spec)
+            if not lane_eligible(spec) or digest in seen:
+                static.append((tk, spec))
+                continue
+            hit, tier = self._cache_lookup(
+                self._cache_key(digest, "multiplex"))
+            if hit is not None:
+                t0 = time.perf_counter()
+                self._emit(spec, "cache_hit",
+                           tier="t2" if tier == "cache_t2" else "t1")
+                summary = self._finish(
+                    spec, hit, time.perf_counter() - t0, tier)
+                queue.complete(tk, wall_s=time.perf_counter() - t0,
+                               engine=tier,
+                               trace=self._trace_fold(spec))
+                continue
+            seen.add(digest)
+            lanes.append((tk, spec, digest))
+        by_id = {id(s): (tk, d) for tk, s, d in lanes}
+        for group in multiplex_eligible([s for _tk, s, _d in lanes]):
+            self._cb_session(queue, [(by_id[id(s)][0], s,
+                                      by_id[id(s)][1])
+                                     for s in group])
+            if self.draining:
+                break
+        if static and not self.draining:
+            self._serve_static(queue, static)
+
+    def _cb_publish_lane(self, queue: StudyQueue, batch: StudyBatch,
+                         slot: int, tk: Ticket, spec: StudySpec,
+                         digest: str, t0: float):
+        """Retire one finished lane at its OWN window boundary: result
+        extracted, cached, trace-``published``, ticket tombstoned —
+        the early publish that takes a lane's client latency from
+        O(longest peer) to O(own run + one window)."""
+        res = batch.result(slot)
+        batch.retire(slot)
+        summary = self._batch_summary(spec, res, digest)
+        self._emit(spec, "drained")
+        tier = self.cache.put(
+            self._cache_key(digest, "multiplex"), summary)
+        self._emit(spec, "published", tier=tier or "t1")
+        self._emit(spec, "lane_retired", slot=slot,
+                   windows=batch.windows)
+        REGISTRY.counter(
+            "serve_multiplexed_studies_total",
+            "studies served fused on the study axis").inc()
+        REGISTRY.counter(
+            "serve_cb_lane_turnovers_total",
+            "lanes retired at a window boundary (continuous "
+            "batching)").inc()
+        wall = time.perf_counter() - t0
+        self._finish(spec, summary, wall, "multiplex")
+        queue.complete(tk, wall_s=wall, engine="multiplex",
+                       trace=self._trace_fold(spec))
+
+    def _cb_admit_lane(self, batch: StudyBatch, lanes: dict,
+                       tk: Ticket, spec: StudySpec, digest: str):
+        """Seat one study in a free lane and emit its join events."""
+        slot = batch.admit(spec)
+        lanes[slot] = (tk, spec, digest, time.perf_counter())
+        self._emit(spec, "batched", engine="multiplex",
+                   batch_key=batch.key[:12], width=batch.occupied())
+        self._emit(spec, "lane_joined", slot=slot,
+                   window=batch.windows)
+        self._emit(spec, "dispatched", **batch.trace_info())
+
+    def _cb_refill(self, queue: StudyQueue, batch: StudyBatch,
+                   lanes: dict) -> bool:
+        """Claim one same-``batch_key`` pending study into a free lane
+        (the keyed claim keeps incompatible work for other workers).
+        A claimed duplicate of an already-published digest completes
+        straight from the cache without burning a lane; a duplicate of
+        a still-running lane gets its own lane — bit-identity makes
+        the two results equal, so correctness never depends on dedup.
+        Returns False when no matching work is pending."""
+        tk = queue.claim(self.worker_id, batch_key=batch.key)
+        if tk is None:
+            return False
+        try:
+            spec = tk.load_spec()
+        except Exception as exc:  # poison ticket
+            queue.fail(tk, f"unpicklable spec: {exc!r}")
+            return True
+        digest = study_digest(spec)
+        self._trace_begin(queue, [(tk, spec)])
+        hit, tier = self._cache_lookup(
+            self._cache_key(digest, "multiplex"))
+        if hit is not None:
+            t0 = time.perf_counter()
+            self._emit(spec, "cache_hit",
+                       tier="t2" if tier == "cache_t2" else "t1")
+            self._finish(spec, hit, time.perf_counter() - t0, tier)
+            queue.complete(tk, wall_s=time.perf_counter() - t0,
+                           engine=tier, trace=self._trace_fold(spec))
+            return True
+        self._cb_admit_lane(batch, lanes, tk, spec, digest)
+        return True
+
+    def _cb_session(self, queue: StudyQueue,
+                    group: Sequence[Tuple[Ticket, StudySpec, str]]):
+        """One continuous-batching session: window dispatches over one
+        ``batch_key``'s compiled program, retiring finished lanes and
+        admitting queued same-key studies between windows — zero new
+        XLA compiles on lane turnover (the program pool key is
+        (batch_key, rung, window, rounds); budgets are operands).
+
+        Drain (SIGTERM) finishes the CURRENT window, publishes the
+        lanes that stopped, and leaves unfinished lanes claimed for
+        ``run_forever``'s requeue — retired lanes' publishes survive,
+        unfinished studies bounce whole.  A session that dies on an
+        exception fails every unfinished lane's ticket (retired lanes
+        keep their tombstones)."""
+        from ..autotune import install_compile_listener
+        install_compile_listener()
+        batch = StudyBatch([s for _tk, s, _d in group],
+                           program_cache=self._batch_programs)
+        self._note_batch_program(batch)
+        hyst = ShapeHysteresis()
+        lanes: dict = {}
+        now = time.perf_counter()
+        for slot, (tk, spec, digest) in enumerate(group):
+            lanes[slot] = (tk, spec, digest, now)
+            self._emit(spec, "batched", engine="multiplex",
+                       batch_key=batch.key[:12], width=len(group))
+            self._emit(spec, "lane_joined", slot=slot, window=0)
+            self._emit(spec, "dispatched", **batch.trace_info())
+        try:
+            while lanes:
+                finished = batch.step_window()
+                REGISTRY.counter(
+                    "serve_cb_windows_total",
+                    "continuous-batching window dispatches").inc()
+                for slot in finished:
+                    tk, spec, digest, t0 = lanes.pop(slot)
+                    self._cb_publish_lane(queue, batch, slot, tk,
+                                          spec, digest, t0)
+                # chaos hook: a kill here lands BETWEEN windows —
+                # after this window's publishes are durable, before
+                # the next refill/dispatch (tools/chaos_soak.py "cb")
+                fault_point(SITE_SERVE_WINDOW,
+                            data={"window": batch.windows})
+                if not lanes or self.draining:
+                    break
+                while batch.free_slots():
+                    if not self._cb_refill(queue, batch, lanes):
+                        break
+                if hyst.observe(batch.occupied(), batch.rung):
+                    batch, slot_map = batch.shrink(
+                        program_cache=self._batch_programs)
+                    self._note_batch_program(batch)
+                    lanes = {slot_map[i]: v for i, v in lanes.items()}
+                    REGISTRY.counter(
+                        "serve_cb_shrinks_total",
+                        "batch-shape shrinks after sustained "
+                        "underfill (hysteresis)").inc()
+                REGISTRY.gauge(
+                    "serve_cb_occupancy",
+                    "occupied fraction of the open batch's lanes"
+                ).set(round(batch.occupancy(), 4))
+        except Exception as exc:
+            for slot, (tk, spec, _digest, _t0) in list(lanes.items()):
+                queue.fail(tk, repr(exc),
+                           trace=self._trace_fold(spec))
+            lanes.clear()
+        finally:
+            # drained mid-run: unfinished lanes stay claimed; their
+            # tickets bounce via run_forever's requeue_worker and the
+            # local trace contexts are dropped (the rescue worker
+            # starts its own)
+            for slot, (tk, spec, _digest, _t0) in lanes.items():
+                self._trace_ctx.pop(id(spec), None)
+
     # ---- queue loop ------------------------------------------------------
 
     def drain(self):
@@ -692,21 +901,14 @@ class ServeWorker:
                 if not loaded:
                     continue
                 self._trace_begin(queue, loaded)
-                t0 = time.perf_counter()
-                try:
-                    summaries = self.serve_many(
-                        [s for _tk, s in loaded])
-                except Exception as exc:
-                    for tk, s in loaded:
-                        queue.fail(tk, repr(exc),
-                                   trace=self._trace_fold(s))
-                    continue
-                wall = time.perf_counter() - t0
-                for (tk, s), summary in zip(loaded, summaries):
-                    queue.complete(tk, wall_s=wall,
-                                   engine=summary.get("served_from",
-                                                      "solo"),
-                                   trace=self._trace_fold(s))
+                if cb_enabled():
+                    # continuous batching: lane-eligible misses join a
+                    # windowed batch that retires/publishes/refills at
+                    # window boundaries (claiming MORE same-key work
+                    # mid-batch); everything else rides the static path
+                    self._serve_continuous(queue, loaded)
+                else:
+                    self._serve_static(queue, loaded)
                 self._snapshot_gauges(queue)
                 if publisher is not None:
                     publisher.publish()

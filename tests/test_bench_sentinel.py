@@ -131,6 +131,21 @@ def test_median_of_three_resists_one_outlier(tmp_path):
     assert ref["value"] == 560000.0
 
 
+def test_cb_rows_guard_turnover_contract():
+    """Continuous-batching rows: recompiles on lane turnover are
+    zero-tolerance (no trajectory needed — the program-pool contract
+    is absolute), and the mixed-duration p99 fails high against its
+    trajectory with the wide in-process slack."""
+    mod = _load()
+    fails = mod.compare({"serve_cb_recompiles": 1}, {})
+    assert any(k == "serve_cb_recompiles" for k, *_ in fails)
+    assert mod.compare({"serve_cb_recompiles": 0}, {}) == []
+    ref = {"serve_cb_p99_ms": 1000.0, "serve_cb_shed_rate": 0.0}
+    assert mod.compare({"serve_cb_p99_ms": 1500.0}, ref) == []
+    fails = mod.compare({"serve_cb_p99_ms": 2500.0}, ref)
+    assert any(k == "serve_cb_p99_ms" for k, *_ in fails)
+
+
 def test_journal_mb_fails_high():
     """The spill journal's on-disk footprint is watched fail-high: an
     O(KB) wobble sits inside the absolute _MB_SLACK, a regression to

@@ -141,6 +141,19 @@ WATCHED = (
     # ceiling — a median of prior regressed captures must not launder
     # a budget blowout
     ("serve_trace_overhead_pct", "ceiling", 2.0),
+    # continuous batching (bench_serve_cb, rides the serve_load row):
+    # the client p99 under the Poisson mixed-duration profile is the
+    # tail the lane-turnover windowing exists to cut — fails high with
+    # wide slack (in-process CPU worker, polling noise), while a
+    # regression back to batch-drain settling roughly DOUBLES it
+    ("serve_cb_p99_ms", "lower", 1.00),
+    # ... and CB must never shed more than the static plane did on the
+    # same arrivals (reference ~0, the absolute floor carries the row)
+    ("serve_cb_shed_rate", "lower", 1.00),
+    # lane turnover at a fixed batch shape re-enters the pooled
+    # program: ≥3 consecutive admit/retire cycles with ANY new XLA
+    # compile is a broken program-pool key — ZERO tolerance
+    ("serve_cb_recompiles", "zero", 0.0),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

@@ -443,7 +443,7 @@ SCHED_GENS = 4
 #: the deterministic ``--sched`` trial names; ``SCHED_FAST_TRIALS`` is
 #: the queue-level subset cheap enough for tier-1 (tests/test_sched.py)
 SCHED_TRIALS = ("kill9", "freeze", "corrupt", "poison", "shards",
-                "platform", "trace")
+                "platform", "trace", "cb")
 SCHED_FAST_TRIALS = ("freeze", "poison", "shards", "trace")
 
 _SCHED_CHILD = """
@@ -461,7 +461,8 @@ sys.exit(0)
 """
 
 
-def _sched_spec(seed: int, pop: int = SCHED_POP):
+def _sched_spec(seed: int, pop: int = SCHED_POP,
+                gens: int = SCHED_GENS):
     """One serve-queue study spec for the scheduler trials.  The model
     lives in ``pyabc_tpu.models`` so BOTH sides of a subprocess trial
     (the submitting parent and the claiming child) unpickle it by
@@ -473,7 +474,7 @@ def _sched_spec(seed: int, pop: int = SCHED_POP):
         model=gaussian_model,
         prior=pt.Distribution(mu=pt.RV("norm", 0.0, 1.0)),
         observed={"y": 0.5}, population_size=pop, seed=seed,
-        max_generations=SCHED_GENS, tenant="chaos")
+        max_generations=gens, tenant="chaos")
 
 
 class _SchedEnv:
@@ -490,7 +491,8 @@ class _SchedEnv:
              # tracing is pinned on regardless of ambient config
              "PYABC_TPU_SERVE_TRACE": "1"}
     _UNSET = ("PYABC_TPU_RUN_DIR", "PYABC_TPU_SERVE_DIR",
-              "PYABC_TPU_FAULTS")
+              "PYABC_TPU_FAULTS", "PYABC_TPU_SERVE_CB",
+              "PYABC_TPU_SERVE_CB_WINDOW")
 
     def __enter__(self):
         keys = list(self._VARS) + list(self._UNSET)
@@ -533,7 +535,7 @@ def _sched_conservation(queue, n_submitted: int) -> int:
 
 
 def _run_dead_child(root: str, worker_id: str, fault_plan: str,
-                    workdir: str, slug: str):
+                    workdir: str, slug: str, extra_env=None):
     """Spawn a durable serve worker subprocess under a kill plan and
     assert it actually died by SIGKILL mid-study."""
     script = os.path.join(workdir, f"{slug}_worker.py")
@@ -544,6 +546,7 @@ def _run_dead_child(root: str, worker_id: str, fault_plan: str,
                PYABC_TPU_SERVE_MULTIPLEX="1",
                PYABC_TPU_SERVE_DURABLE="1",
                PYABC_TPU_STORE_GENS="1")
+    env.update(extra_env or {})
     env.pop("PYABC_TPU_RUN_DIR", None)  # lease lapse is the signal
     proc = subprocess.run(
         [sys.executable, script, root, worker_id], env=env,
@@ -958,6 +961,104 @@ def run_sched_trial(name: str, workdir: str, seed: int = 0) -> dict:
             report["trace_events"] = _assert_trace_continuity(
                 root, ticket.id)
             report["lost"] = _sched_conservation(queue, 1)
+            report["recovered"] = True
+
+    elif name == "cb":
+        # continuous batching under kill -9 BETWEEN windows: three
+        # same-batch_key studies share one windowed session; the
+        # plan's `serve.window` visit lands at the first window
+        # boundary, right after the short lane's early publish and
+        # before the next dispatch.  The retired lane's tombstone and
+        # tier-2 cache entry must survive the death, the unfinished
+        # lanes bounce whole (CB lanes are not journaled — re-serve,
+        # not resume), and zero studies are lost.  Per-lane trace
+        # continuity: the retired lane reads claimed -> batched ->
+        # published inside the dead worker's lifetime; each bounced
+        # lane reads claimed -> requeued -> claimed -> batched ->
+        # published across both workers under ONE trace id.
+        cb_env = {"PYABC_TPU_SERVE_MULTIPLEX": "4",
+                  "PYABC_TPU_SERVE_CB_WINDOW": "1"}
+        with _SchedEnv():
+            os.environ.update(cb_env)
+            queue = StudyQueue(root=root, lease_s=30.0)
+            short = _sched_spec(seed=700 + seed, gens=2)
+            peers = [_sched_spec(seed=710 + 16 * seed + i)
+                     for i in range(2)]
+            t_short = queue.submit(short)
+            t_peers = [queue.submit(p) for p in peers]
+            # at 1 generation/window the short lane (2-generation
+            # budget: masked gen-0 init + one step) retires at window
+            # 1 — serve.window visit 1 IS that boundary
+            _run_dead_child(root, "w_cbdead",
+                            "serve.window@1:sigkill", workdir,
+                            f"sched_cb_{seed}", extra_env=cb_env)
+            stats = queue.stats()
+            assert stats["done"] == 1, (
+                f"retired lane's early publish did not survive the "
+                f"kill: {stats}")
+            assert stats["claimed"] == 2, (
+                f"unfinished lanes should still be leased: {stats}")
+            # the dead worker's lease lapses; the scheduler bounces
+            # ONLY the unfinished lanes
+            _rewind_lease(queue, "w_cbdead")
+            sched = Scheduler(run_dir=None, queue=queue, max_bounces=3)
+            t0 = _time.perf_counter()
+            rep = sched.tick()
+            report["reschedule_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3)
+            assert sorted(rep["requeued"]) == sorted(
+                t.id for t in t_peers), (
+                    f"expected the two unfinished lanes requeued: "
+                    f"{rep}")
+            # a rescue worker re-serves the bounced lanes through a
+            # fresh CB session (the parent env has no fault plan)
+            from pyabc_tpu.serve.worker import ServeWorker
+            rescue = ServeWorker(root=root, worker_id="w_cbrescue")
+            served = rescue.run_forever(queue, once=True)
+            assert served == 2, f"rescue served {served} studies"
+            stats = queue.stats()
+            assert stats["done"] == 3 and stats["failed"] == 0, (
+                f"lost or failed lanes after rescue: {stats}")
+            report["lost"] = _sched_conservation(queue, 3)
+            # the dead child's publish is durable in the shared tier-2
+            # store — any worker can serve the duplicate from cache
+            from pyabc_tpu.serve.spec import study_digest as _dig
+            summary = rescue.cache.get(f"{_dig(short)}.multiplex")
+            assert summary is not None and summary["gens"] == 2, (
+                f"retired lane's cached result lost: {summary}")
+            # per-lane lifecycle continuity across the kill
+            from pyabc_tpu.telemetry.studytrace import StudyTrace
+
+            def _names(tid):
+                trace = StudyTrace.assemble(root, tid)
+                assert trace is not None and trace.trace_id, (
+                    f"no assembled trace for {tid}")
+                return trace.event_names()
+
+            def _subseq(names, want):
+                pos = 0
+                for w in want:
+                    while pos < len(names) and names[pos] != w:
+                        pos += 1
+                    assert pos < len(names), (
+                        f"lifecycle order {want} broken at {w!r}: "
+                        f"{names}")
+                    pos += 1
+
+            names = _names(t_short.id)
+            assert names.count("claimed") == 1, (
+                f"retired lane should never bounce: {names}")
+            _subseq(names, ("claimed", "batched", "lane_joined",
+                            "published", "lane_retired"))
+            n_events = len(names)
+            for t in t_peers:
+                names = _names(t.id)
+                assert names.count("claimed") == 2, (
+                    f"expected one claim per worker: {names}")
+                _subseq(names, ("claimed", "batched", "requeued",
+                                "claimed", "batched", "published"))
+                n_events += len(names)
+            report["trace_events"] = n_events
             report["recovered"] = True
 
     else:

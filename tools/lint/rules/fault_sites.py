@@ -72,6 +72,12 @@ MANIFEST = {
     # from the last durably-appended generation
     "SITE_DRAIN": ("smc.py",
                    ("SITE_DRAIN", "_fault_onedispatch_off")),
+    # the continuous-batching window boundary: every retired lane's
+    # summary is published durably (_cb_publish_lane) BEFORE the fault
+    # point fires, so a kill between windows loses nothing published
+    # and in-flight lanes bounce whole via the scheduler's lease requeue
+    "SITE_SERVE_WINDOW": ("serve/worker.py",
+                          ("SITE_SERVE_WINDOW", "_cb_publish_lane(")),
 }
 
 _CONST_RE = re.compile(r'^(SITE_[A-Z_]+)\s*=\s*"([^"]+)"', re.M)

@@ -77,16 +77,27 @@ class ClosedLoopLoadGen:
     between completions, so arrivals are Poisson at ``rate_hz`` when
     the fleet keeps up and gracefully throttle to fleet capacity when
     it does not (closed loop).  ``rate_hz=None`` disables think time
-    (max-pressure mode)."""
+    (max-pressure mode).
+
+    ``unique=True`` flips the draw to a seed-shuffled pass over the
+    pool without replacement (cycling if ``n_studies`` exceeds it):
+    every submission is a FRESH study, so the fleet's dispatch path —
+    not the cache tiers — is what gets priced.  That is the traffic
+    shape the continuous-batching A/B needs: lane work per arrival,
+    mixed durations, no dedup shortcut."""
 
     def __init__(self, queue: StudyQueue, specs: Sequence,
                  n_studies: int, clients: int = 8,
                  rate_hz: Optional[float] = None, seed: int = 0,
                  poll_s: float = 0.005, study_timeout_s: float = 120.0,
+                 unique: bool = False,
                  on_progress: Optional[Callable[[int], None]] = None):
         self.queue = queue
         self.specs = list(specs)
         self.n_studies = int(n_studies)
+        order = list(range(len(self.specs)))
+        random.Random(seed).shuffle(order)
+        self._order = order if unique else None
         self.clients = max(int(clients), 1)
         self.rate_hz = rate_hz
         self.seed = int(seed)
@@ -107,12 +118,15 @@ class ClosedLoopLoadGen:
 
     # ---- client loop -----------------------------------------------------
 
-    def _take_slot(self) -> bool:
+    def _take_slot(self) -> Optional[int]:
+        """Claim the next submission slot; its index drives the
+        without-replacement draw in ``unique`` mode."""
         with self._lock:
             if self._submitted >= self.n_studies:
-                return False
+                return None
+            slot = self._submitted
             self._submitted += 1
-            return True
+            return slot
 
     def _settled(self, ticket) -> Optional[dict]:
         """The ticket's tombstone payload once it reaches done/failed,
@@ -133,8 +147,14 @@ class ClosedLoopLoadGen:
         rng = random.Random((self.seed << 16) ^ idx)
         think_hz = (None if not self.rate_hz
                     else self.rate_hz / self.clients)
-        while self._take_slot():
-            spec = self.specs[rng.randrange(len(self.specs))]
+        while True:
+            slot = self._take_slot()
+            if slot is None:
+                break
+            if self._order is not None:
+                spec = self.specs[self._order[slot % len(self._order)]]
+            else:
+                spec = self.specs[rng.randrange(len(self.specs))]
             t0 = time.perf_counter()
             ticket = None
             deadline = time.monotonic() + self.study_timeout_s
