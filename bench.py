@@ -1154,7 +1154,8 @@ def bench_serve_load():
 SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
                "kernel", "lanes", "serve", "serve_load", "sched",
                "posterior_gate",
-               "lotka_volterra", "sir", "petab_ode", "sharded_mesh1",
+               "lotka_volterra", "sir", "fidelity", "petab_ode",
+               "sharded_mesh1",
                "ab_vec_sharded", "sharded_cpu8", "podstar")
 
 
@@ -1440,6 +1441,8 @@ def _run_sub(name: str) -> dict:
     if name == "sir":
         return _bench_problem(_sir_problem, SIR_POP,
                               f"sir_pop{SIR_POP // 1000}k")
+    if name == "fidelity":
+        return bench_fidelity()
     if name == "petab_ode":
         return bench_petab_ode()
     if name == "sharded_mesh1":
@@ -1549,7 +1552,7 @@ def main():
                                 "fused_northstar_", "seq_northstar_",
                                 "onedispatch_", "kernel_", "lanes_",
                                 "podstar_", "serve_", "sched_",
-                                "posterior_gate_",
+                                "posterior_gate_", "fidelity_",
                                 "telemetry_", "resilience_",
                                 "checkpoint_", "store_", "lint_"))
                and not isinstance(v, (list, dict))}
@@ -1631,6 +1634,164 @@ def _lv_problem():
 def _sir_problem():
     from pyabc_tpu.models import make_sir_problem
     return make_sir_problem()
+
+
+FID_POP = 50_000
+FID_WARMUP, FID_TIMED = 2, 3
+
+
+def _fid_problem(which: str):
+    """Screen-ELIGIBLE SIR/LV problems: plain time-invariant
+    ``PNormDistance`` (the `make_*_problem` factories return adaptive
+    distances, which exclude themselves from screening by design —
+    docs/fidelity.md)."""
+    import jax
+    import jax.numpy as jnp
+    import pyabc_tpu as pt
+    from pyabc_tpu.random_variables import RV, Distribution
+
+    if which == "sir":
+        from pyabc_tpu.models.sir import SIRTauLeap
+        model = SIRTauLeap()
+        prior = Distribution(log_beta=RV("uniform", -2.0, 3.0),
+                             log_gamma=RV("uniform", -3.0, 3.0))
+        theta_true = jnp.log(jnp.asarray([[0.8, 0.2]]))
+        obs_key = jax.random.PRNGKey(11)
+    else:
+        from pyabc_tpu.models.lotka_volterra import LotkaVolterraSDE
+        model = LotkaVolterraSDE()
+        prior = Distribution(log_a=RV("uniform", -1.0, 2.0),
+                             log_b=RV("uniform", -3.0, 2.0),
+                             log_c=RV("uniform", -2.0, 2.0),
+                             log_d=RV("uniform", -1.0, 2.0))
+        theta_true = jnp.log(jnp.asarray([[1.1, 0.4, 1.0, 0.4]]))
+        obs_key = jax.random.PRNGKey(7)
+    obs = model.simulate(obs_key, theta_true)
+    observed = {k: np.asarray(v[0]) for k, v in obs.items()}
+    return [model], [prior], pt.PNormDistance(p=2), observed
+
+
+def bench_fidelity():
+    """The multi-fidelity early-reject A/B (docs/fidelity.md): the
+    same simulation-bound SIR and LV rows with ``fidelity="off"`` vs
+    ``"screen"``, plus a host-side paired-sample audit of the screen.
+
+    Device counters give sims accounting (full-fidelity simulations
+    per accepted particle is what the cascade buys down); the audit
+    re-simulates the FINAL population through both model fidelities,
+    replays the calibrator's numpy mirror at the final eps, and reports
+    the realized screen-pass and false-reject rates — the latter is
+    the statistical debt the conservative quantile bound caps, pinned
+    fail-high by the sentinel."""
+    import jax
+    import jax.numpy as jnp
+    import pyabc_tpu as pt
+    from pyabc_tpu.fidelity import FidelityConfig, screen_threshold_np
+    from pyabc_tpu.telemetry import metrics as _metrics
+
+    # a leaner slot budget than the 0.5 default: the quarter-cost
+    # surrogates cap the sim-bound speedup at 1/(0.25 + full_fraction),
+    # so 0.15 slots target ~2.5x while sitting just above the
+    # steady-state survivor rate (no slot starvation); the larger ring
+    # keeps enough ACCEPTABLE pairs in view for the calibrator at the
+    # steep schedule's low acceptance rates (min_pairs stays 32)
+    cfg = FidelityConfig(full_fraction=0.15, cal_rows=4096)
+    out = {}
+    for which in ("sir", "lv"):
+        row = {}
+        for fid in ("off", "screen"):
+            _metrics.REGISTRY.reset()
+            models, priors, distance, observed = _fid_problem(which)
+            abc = pt.ABCSMC(
+                models, priors, distance,
+                population_size=FID_POP,
+                # pinned batch, same rationale as _bench_problem
+                sampler=pt.VectorizedSampler(min_batch_size=1 << 18,
+                                             max_batch_size=1 << 18),
+                fuse_generations=4,
+                stores_sum_stats=False,
+                # a steep schedule (alpha 0.15 vs the 0.5 default)
+                # holds the steady-state acceptance rate under the slot
+                # fraction — the deep-tail, simulation-bound regime the
+                # cascade exists for; both arms share it, the A/B stays
+                # fair
+                eps=pt.QuantileEpsilon(alpha=0.15),
+                seed=0, fidelity=(cfg if fid == "screen" else "off"))
+            abc.new("sqlite://", observed)
+            rate, s_per_gen, times, evals_ps, _tr = _timed_generations(
+                abc, FID_POP, FID_WARMUP, FID_TIMED)
+            reg = _metrics.REGISTRY.to_dict()
+            pops = abc.history.get_all_populations().sort_values("t")
+            accepted = FID_POP * (FID_WARMUP + FID_TIMED)
+            # full-fidelity sims per accepted particle: the screened
+            # run's counter, or every eval on the unscreened run
+            full_sims = (reg.get("abc_sims_full_total")
+                         or float(np.asarray(pops.samples).sum()))
+            row[fid] = {"rate": rate, "times": times,
+                        "sims_per_accepted": full_sims / accepted}
+            if fid != "screen":
+                continue
+            # ---- paired-sample audit at the final eps ----
+            eps_final = float(
+                pops[pops.t >= 0].epsilon.to_numpy()[-1])
+            df, _w = abc.history.get_distribution(m=0)
+            thetas = jnp.asarray(df.to_numpy()[:2048], jnp.float32)
+            model = models[0]
+            k_audit = jax.random.PRNGKey(1234)
+            s_full = model.simulate(k_audit, thetas)
+            s_lo = model.low_fidelity().simulate(k_audit, thetas)
+            obs_flat = np.concatenate(
+                [np.ravel(observed[k]) for k in sorted(observed)])
+
+            def _dist(stats):
+                arr = np.concatenate(
+                    [np.asarray(stats[k]).reshape(thetas.shape[0], -1)
+                     for k in sorted(stats)], axis=1)
+                return np.sqrt(
+                    ((arr - obs_flat[None, :]) ** 2).sum(axis=1))
+
+            d_full, d_lo = _dist(s_full), _dist(s_lo)
+            tau = screen_threshold_np(
+                d_lo, d_full, eps_final, q=cfg.false_reject_q,
+                margin=cfg.margin, min_corr=cfg.min_corr,
+                min_pairs=cfg.min_pairs)
+            acceptable = d_full <= eps_final
+            if np.isfinite(tau) and acceptable.any():
+                row["screen_rate"] = float(np.mean(d_lo <= tau))
+                row["false_reject"] = float(
+                    np.mean(d_lo[acceptable] > tau))
+            else:
+                # self-disabled screen passes everything: 0 debt
+                row["screen_rate"] = 1.0
+                row["false_reject"] = 0.0
+        out.update({
+            f"fidelity_{which}_accepted_per_s":
+                round(row["screen"]["rate"], 1),
+            f"fidelity_{which}_accepted_per_s_off":
+                round(row["off"]["rate"], 1),
+            f"fidelity_{which}_speedup":
+                round(row["screen"]["rate"]
+                      / max(row["off"]["rate"], 1e-9), 3),
+            f"fidelity_{which}_sims_per_accepted":
+                round(row["screen"]["sims_per_accepted"], 2),
+            f"fidelity_{which}_sims_per_accepted_off":
+                round(row["off"]["sims_per_accepted"], 2),
+            f"fidelity_{which}_screen_rate":
+                round(row["screen_rate"], 4),
+            f"fidelity_{which}_false_reject_rate":
+                round(row["false_reject"], 4),
+            f"fidelity_{which}_gen_times_s": row["screen"]["times"],
+        })
+    # headline rows the sentinel watches: throughput fail-low on the
+    # most simulation-bound row, statistical debt fail-high fleet-wide
+    out["fidelity_accepted_per_s"] = out["fidelity_sir_accepted_per_s"]
+    out["fidelity_sims_per_accepted"] = \
+        out["fidelity_sir_sims_per_accepted"]
+    out["fidelity_screen_rate"] = out["fidelity_sir_screen_rate"]
+    out["fidelity_false_reject_rate"] = max(
+        out["fidelity_sir_false_reject_rate"],
+        out["fidelity_lv_false_reject_rate"])
+    return out
 
 
 if __name__ == "__main__":

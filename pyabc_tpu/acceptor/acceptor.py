@@ -58,6 +58,16 @@ class Acceptor:
     #: state must run the sequential path.
     device_accept_ok = False
 
+    #: fidelity-cascade capability flag: True when the accept decision
+    #: is a deterministic threshold on the distance (d <= eps), so a
+    #: candidate screened out on its LOW-fidelity distance provably
+    #: could only have been accepted if the calibrated screen bound
+    #: failed — the quantity the calibrator controls.  Randomized
+    #: acceptors (the stochastic triple) stay False: their accept
+    #: probability depends on the exact density value, which the
+    #: low-fidelity surrogate does not reproduce.
+    device_screen_ok = False
+
     def initialize(self, t: int, get_weighted_distances: Optional[Callable],
                    distance_function=None, x_0=None):
         pass
@@ -122,6 +132,14 @@ class UniformAcceptor(Acceptor):
         needs the host ``_eps_history`` every generation, and a subclass
         may override :meth:`get_params` arbitrarily."""
         return type(self) is UniformAcceptor and not self.use_complete_history
+
+    @property
+    def device_screen_ok(self) -> bool:
+        """The deterministic d ≤ ε test is exactly the decision the
+        screening calibrator bounds; same subclass/history guards as
+        :attr:`device_accept_ok`."""
+        return (type(self) is UniformAcceptor
+                and not self.use_complete_history)
 
     def get_params(self, t: int, epsilon) -> dict:
         eps = float(epsilon(t))

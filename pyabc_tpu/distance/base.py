@@ -41,6 +41,17 @@ class Distance:
     #: pyabc/distance/distance.py:210-224)
     requires_all_sum_stats: bool = False
 
+    #: fidelity-cascade capability flag: True when low- and
+    #: full-fidelity distances computed with the SAME ``get_params``
+    #: pytree are directly comparable across a whole run — i.e. the
+    #: params are time-invariant and :meth:`compute` is a fixed metric
+    #: over the flat stat block, so the calibration pairs collected at
+    #: generation t-1 remain on the same scale as the screen applied at
+    #: t.  Consulted by ``ABCSMC._fidelity_eligible`` alongside the
+    #: acceptor's flag; default False (an adaptive/reweighted distance
+    #: moves the scale between generations and must not screen).
+    device_screen_ok: bool = False
+
     def __init__(self):
         self.spec: Optional[SumStatSpec] = None
 

@@ -131,6 +131,16 @@ class PNormDistance(Distance):
         # override get_params on top of this class
         return len(self.weights) <= 1 and super().params_time_invariant()
 
+    @property
+    def device_screen_ok(self) -> bool:
+        """A fixed-weight p-norm scores low- and full-fidelity stats on
+        one time-invariant scale, so screening calibration pairs stay
+        comparable across generations.  Time-indexed weight schedules
+        (and every subclass — notably ``AdaptivePNormDistance``, whose
+        per-generation refit moves the scale) stay False."""
+        return (type(self) is PNormDistance
+                and self.params_time_invariant())
+
     def get_params(self, t: int):
         w = self._weights_for(t)
         f = self.factors if self.factors is not None else np.ones_like(w)

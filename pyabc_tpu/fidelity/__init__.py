@@ -1,0 +1,27 @@
+"""Multi-fidelity early-reject cascade (docs/fidelity.md).
+
+Staged acceptance inside the fused rejection round: every candidate
+first runs its model's cheap :meth:`~pyabc_tpu.model.Model.low_fidelity`
+variant, the resulting distance is screened against a per-generation
+calibrated threshold (:mod:`pyabc_tpu.fidelity.calibrate`), and only
+survivors are re-simulated at full fidelity for the real accept test
+(:mod:`pyabc_tpu.fidelity.screen` owns the slot math).  Opt-in via
+``ABCSMC(fidelity="screen")`` / ``StudySpec.fidelity``; configuration
+in :mod:`pyabc_tpu.fidelity.config`.
+"""
+
+from .calibrate import (pearson_corr, pearson_corr_np, screen_threshold,
+                        screen_threshold_np)
+from .config import FidelityConfig
+from .screen import compact_survivors, scatter_back, screen_mask
+
+__all__ = [
+    "FidelityConfig",
+    "compact_survivors",
+    "pearson_corr",
+    "pearson_corr_np",
+    "scatter_back",
+    "screen_mask",
+    "screen_threshold",
+    "screen_threshold_np",
+]

@@ -1,0 +1,133 @@
+"""Screen-threshold calibration from paired (low, full) distances.
+
+THE calibration contract (docs/fidelity.md): every comparison between
+a low-fidelity and a full-fidelity distance routes through
+:func:`screen_threshold` — no other code path may derive a screening
+decision from the pair stream (the ``fidelity-discipline`` lint rule
+pins this).  The calibrator is deliberately one pure function so the
+device scan (sampler/fused.py) and host-side analysis (bench, tests)
+share the exact same math.
+
+Semantics, per generation ``t`` with threshold ``eps_t``:
+
+- *acceptable pairs* are calibration rows whose FULL-fidelity distance
+  would pass the current accept test (``d_full <= eps_t``) — the
+  population screening must not lose;
+- the screen threshold is ``margin x Q_{1-q}(d_lo | acceptable)``:
+  at most a ``q`` fraction of acceptable calibration pairs sit above
+  the quantile, so screening at it falsely rejects at most that
+  fraction of the would-be-accepted stream (empirically on the
+  calibration sample; ``margin > 1`` adds slack for drift between
+  generations);
+- *self-disable*: when fewer than ``min_pairs`` acceptable pairs
+  exist, or the low/full Pearson correlation over all valid pairs is
+  below ``min_corr``, the threshold is ``+inf`` — the screen passes
+  every candidate and the generation runs exactly as many full
+  simulations as the slot layout allows, with ZERO false rejects.
+  NaN ring rows (the empty-slot encoding, and the post-restart seed —
+  smc.py ``_fidelity_nan_seed``) never count as pairs, so a fresh or
+  recovered run always starts self-disabled.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+def pearson_corr(x: Array, y: Array, mask: Array) -> Array:
+    """Pearson correlation over ``mask``-selected rows (traceable).
+
+    Returns ``-inf`` when fewer than 2 rows are selected (correlation
+    undefined -> the caller's ``min_corr`` floor self-disables), and
+    ``0`` for a degenerate (zero-variance) selection.
+    """
+    mask = mask & jnp.isfinite(x) & jnp.isfinite(y)
+    n = jnp.sum(mask).astype(jnp.float32)
+    denom_n = jnp.maximum(n, 1.0)
+    xm = jnp.sum(jnp.where(mask, x, 0.0)) / denom_n
+    ym = jnp.sum(jnp.where(mask, y, 0.0)) / denom_n
+    dx = jnp.where(mask, x - xm, 0.0)
+    dy = jnp.where(mask, y - ym, 0.0)
+    cov = jnp.sum(dx * dy)
+    var = jnp.sqrt(jnp.sum(dx * dx) * jnp.sum(dy * dy))
+    corr = cov / jnp.maximum(var, 1e-30)
+    return jnp.where(n >= 2, corr, -jnp.inf)
+
+
+def screen_threshold(cal_lo: Array, cal_full: Array, eps,
+                     *, q: float, margin: float, min_corr: float,
+                     min_pairs: int) -> Array:
+    """Conservative low-fidelity screen threshold (traceable).
+
+    ``cal_lo``/``cal_full`` are the paired calibration rings (NaN =
+    empty slot); ``eps`` is THIS generation's accept threshold.
+    Returns a f32 scalar: candidates with low-fidelity distance
+    strictly above it are screened out before full simulation;
+    ``+inf`` means screening is self-disabled for this generation.
+    """
+    cal_lo = jnp.asarray(cal_lo, jnp.float32)
+    cal_full = jnp.asarray(cal_full, jnp.float32)
+    valid = jnp.isfinite(cal_lo) & jnp.isfinite(cal_full)
+    acceptable = valid & (cal_full <= eps)
+    n_acc = jnp.sum(acceptable.astype(jnp.int32))
+
+    # masked (1-q) upper quantile of acceptable low-fi distances: sort
+    # acceptable rows to the front (non-acceptable -> +inf) and index
+    # the ceil((1-q) * n_acc)-th smallest — a conservative (>=) take
+    # on the empirical quantile, so at most q * n_acc acceptable rows
+    # sit strictly above it
+    xs = jnp.where(acceptable, cal_lo, jnp.inf)
+    order = jnp.argsort(xs)  # graftlint: allow(sort-discipline)
+    xs_sorted = xs[order]
+    k = jnp.ceil((1.0 - q) * n_acc.astype(jnp.float32)).astype(jnp.int32)
+    idx = jnp.clip(k - 1, 0, cal_lo.shape[0] - 1)
+    quant = xs_sorted[idx]
+
+    corr = pearson_corr(cal_lo, cal_full, valid)
+    enabled = ((n_acc >= min_pairs)
+               & (corr >= min_corr)
+               & jnp.isfinite(quant))
+    return jnp.where(enabled, quant * jnp.float32(margin),
+                     jnp.float32(jnp.inf))
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy) mirrors — the tests' independent oracle for the device math
+# ---------------------------------------------------------------------------
+
+def pearson_corr_np(x, y, mask=None) -> float:
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    m = np.isfinite(x) & np.isfinite(y)
+    if mask is not None:
+        m &= np.asarray(mask, bool)
+    if m.sum() < 2:
+        return -np.inf
+    xv, yv = x[m], y[m]
+    dx, dy = xv - xv.mean(), yv - yv.mean()
+    var = np.sqrt((dx * dx).sum() * (dy * dy).sum())
+    if var <= 0:
+        return 0.0
+    return float((dx * dy).sum() / var)
+
+
+def screen_threshold_np(cal_lo, cal_full, eps, *, q, margin, min_corr,
+                        min_pairs) -> float:
+    """Independent numpy implementation of :func:`screen_threshold`
+    (select -> sort -> index, no masking tricks)."""
+    lo = np.asarray(cal_lo, np.float64)
+    full = np.asarray(cal_full, np.float64)
+    valid = np.isfinite(lo) & np.isfinite(full)
+    acc_lo = np.sort(lo[valid & (full <= eps)])
+    n_acc = acc_lo.size
+    corr = pearson_corr_np(lo, full, valid)
+    if n_acc < min_pairs or corr < min_corr:
+        return np.inf
+    k = int(np.ceil((1.0 - q) * n_acc))
+    quant = acc_lo[max(k - 1, 0)]
+    if not np.isfinite(quant):
+        return np.inf
+    return float(quant * margin)

@@ -1,0 +1,55 @@
+"""Screening ops: survivor compaction and scatter-back (pure, traced).
+
+The staged round (sampler/rounds.py ``staged_generation_round``) runs
+the cheap low-fidelity stage on the whole round batch ``B``, screens
+each candidate's low-fidelity distance against the calibrated
+threshold, compacts the first ``n_full`` survivors into a STATIC slot
+block for the expensive full-fidelity stage, and scatters the results
+back to batch shape.  These helpers own the index math; the slot
+layout is the same ``jnp.nonzero(size=, fill_value=)`` idiom as the
+fused refit's support gather (sampler/fused.py ``_refit_model``).
+
+Statistical note: slot truncation (more than ``n_full`` survivors in
+one round) drops candidates by ROW POSITION, which is independent of
+theta — rows of a round batch are exchangeable — so the accepted
+population stays unbiased; truncation only costs throughput, exactly
+like running a smaller batch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def screen_mask(d_lo: Array, tau, valid: Array) -> Array:
+    """Survival mask: screened out only on a CONFIRMED exceedance.
+
+    ``~(d_lo > tau)`` — a NaN low-fidelity distance survives to full
+    fidelity (the screen must never convert a low-fidelity simulation
+    failure into a rejection the full model would not have produced),
+    and ``tau = +inf`` (self-disabled) passes everything.
+    """
+    return valid & ~(d_lo > tau)
+
+
+def compact_survivors(survive: Array, n_full: int):
+    """First-``n_full`` survivor slots: ``(idx, slot_ok, idx_clamped)``.
+
+    ``idx[n_full]`` indexes into the round batch (``B`` = dropped fill
+    slot), ``slot_ok`` marks genuine survivors, ``idx_clamped`` is
+    gather-safe (fill slots re-read row B-1; their outputs are masked
+    by ``slot_ok`` / dropped by the scatter-back).
+    """
+    B = survive.shape[0]
+    idx = jnp.nonzero(survive, size=n_full, fill_value=B)[0]
+    slot_ok = idx < B
+    return idx, slot_ok, jnp.minimum(idx, B - 1)
+
+
+def scatter_back(idx: Array, values: Array, B: int, fill) -> Array:
+    """Survivor-slot results back at batch shape: ``out[idx[i]] =
+    values[i]`` with fill elsewhere; fill slots (``idx == B``) drop."""
+    out = jnp.full((B,) + tuple(values.shape[1:]), fill, values.dtype)
+    return out.at[idx].set(values, mode="drop")

@@ -47,6 +47,14 @@ class ModelResult:
 class Model:
     """A stochastic forward model over batches of parameters."""
 
+    #: set True (alongside :meth:`low_fidelity`) to declare that the
+    #: low-fidelity variant emits the IDENTICAL summary-statistic spec
+    #: (same keys, same shapes) as the full model — the contract that
+    #: lets the fidelity cascade reuse one distance/obs layout for both
+    #: stages (docs/fidelity.md; the ``fidelity-discipline`` lint rule
+    #: requires the declaration wherever ``low_fidelity`` is shipped)
+    screen_stats_compatible: bool = False
+
     def __init__(self, name: str = "model"):
         self.name = name
 
@@ -58,6 +66,24 @@ class Model:
     def sample(self, key, theta: Array):
         """Raw model output for ``theta[N, D]`` (batched, jit-safe)."""
         raise NotImplementedError
+
+    def low_fidelity(self) -> Optional["Model"]:
+        """A cheap surrogate of this model for the fidelity cascade's
+        screening stage (coarser integration steps, shorter horizon,
+        subset of observed coordinates), or ``None`` when the model has
+        no meaningful cheap variant — the default, which makes the run
+        ineligible for ``fidelity="screen"`` and falls back to the
+        exact unscreened path.
+
+        Contract: the returned model's :meth:`simulate` must produce
+        the same summary-statistic dict STRUCTURE as the full model
+        (declare it with ``screen_stats_compatible = True``); its
+        values only need to be correlated with the full model's, not
+        equal — the calibrator (pyabc_tpu/fidelity/calibrate.py)
+        measures that correlation each generation and self-disables
+        screening when it is too weak.
+        """
+        return None
 
     def summary_statistics(self, raw) -> Dict[str, Array]:
         """Reduce raw output to summary statistics (default: identity if

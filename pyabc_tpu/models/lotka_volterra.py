@@ -29,6 +29,10 @@ class LotkaVolterraSDE(Model):
     prior unbounded while rates stay positive).
     """
 
+    #: the low-fidelity variant keeps the exact summary-stat layout
+    #: (fidelity-cascade contract, docs/fidelity.md)
+    screen_stats_compatible = True
+
     def __init__(self, x0: float = 10.0, y0: float = 5.0,
                  t_max: float = 15.0, n_steps: int = 300,
                  sigma: float = 0.1, n_obs: int = 10,
@@ -66,6 +70,17 @@ class LotkaVolterraSDE(Model):
             "prey": jnp.moveaxis(obs[..., 0], 0, -1),      # [N, n_obs]
             "predator": jnp.moveaxis(obs[..., 1], 0, -1),  # [N, n_obs]
         }
+
+    def low_fidelity(self) -> "LotkaVolterraSDE":
+        """4x coarser Euler-Maruyama grid over the same horizon and
+        observation points — the oscillation phase/amplitude stays
+        correlated with the full integration, which is all the
+        screening calibrator requires."""
+        coarse = max(self.n_steps // 4, self.n_obs, 1)
+        return LotkaVolterraSDE(x0=self.x0, y0=self.y0, t_max=self.t_max,
+                                n_steps=coarse, sigma=self.sigma,
+                                n_obs=self.n_obs,
+                                name=self.name + "_lofi")
 
 
 def make_lotka_volterra_problem(key=None):

@@ -28,6 +28,10 @@ class ODEModel(Model):
     as ABC expects).
     """
 
+    #: the low-fidelity variant keeps the exact summary-stat layout
+    #: (fidelity-cascade contract, docs/fidelity.md)
+    screen_stats_compatible = True
+
     def __init__(self, rhs: Callable, y0, t_max: float, n_steps: int,
                  observe: Optional[Callable] = None,
                  obs_idx=None, noise_scale: float = 0.0,
@@ -66,3 +70,19 @@ class ODEModel(Model):
         # default: one stat per state dimension, [N, T_obs]
         return {f"y{i}": jnp.moveaxis(obs[..., i], 0, -1)
                 for i in range(obs.shape[-1])}
+
+    def low_fidelity(self) -> "ODEModel":
+        """4x coarser RK4 grid over the same horizon.  The observation
+        indices are rescaled onto the coarse grid with their COUNT
+        preserved, so the trajectory slice — and therefore every
+        downstream summary statistic — keeps its exact shape."""
+        import numpy as np
+        coarse = max(self.n_steps // 4, 1)
+        idx = np.asarray(self.obs_idx, dtype=np.float64)
+        scaled = np.clip(
+            np.round(idx * coarse / self.n_steps), 0, coarse - 1
+        ).astype(np.int32)
+        return ODEModel(rhs=self.rhs, y0=self.y0, t_max=self.t_max,
+                        n_steps=coarse, observe=self.observe,
+                        obs_idx=scaled, noise_scale=self.noise_scale,
+                        name=self.name + "_lofi")

@@ -30,6 +30,10 @@ class SIRTauLeap(Model):
     trajectory at ``n_obs`` time points, the peak size and peak time.
     """
 
+    #: the low-fidelity variant keeps the exact summary-stat layout
+    #: (fidelity-cascade contract, docs/fidelity.md)
+    screen_stats_compatible = True
+
     def __init__(self, n_pop: int = 1000, i0: int = 10,
                  t_max: float = 30.0, n_steps: int = 150,
                  n_obs: int = 10, name: str = "sir_tau_leap"):
@@ -71,6 +75,17 @@ class SIRTauLeap(Model):
         peak = jnp.max(i_traj, axis=0)
         peak_t = jnp.argmax(i_traj, axis=0).astype(jnp.float32) * dt
         return {"infected": obs, "peak": peak, "peak_time": peak_t}
+
+    def low_fidelity(self) -> "SIRTauLeap":
+        """4x coarser tau-leap over the same horizon: 1/4 the Poisson
+        scan steps, identical observation grid and stat shapes.  The
+        larger leap dt keeps the epidemic's peak/timing correlated
+        with the full model — exactly what the screening calibrator
+        needs, and all it needs."""
+        coarse = max(self.n_steps // 4, self.n_obs, 1)
+        return SIRTauLeap(n_pop=self.n_pop, i0=self.i0, t_max=self.t_max,
+                          n_steps=coarse, n_obs=self.n_obs,
+                          name=self.name + "_lofi")
 
 
 def make_sir_problem(key=None):

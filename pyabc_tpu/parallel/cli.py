@@ -226,6 +226,20 @@ def _render_top(run_dir) -> str:
         f"resilience: retries={tot['retries']} "
         f"degrades={tot['degrades']} checkpoints={tot['checkpoints']} "
         f"faults={tot['faults']} flight_dumps={tot['flights']}")
+    # multi-fidelity cascade (docs/fidelity.md): only rendered when at
+    # least one worker ran screened generations — unscreened fleets
+    # keep the exact pre-fidelity frame
+    sims_low = sum(int((s.get("metrics") or {}).get(
+        "abc_sims_low_total", 0)) for s in snaps)
+    if sims_low:
+        sims_full = sum(int((s.get("metrics") or {}).get(
+            "abc_sims_full_total", 0)) for s in snaps)
+        screen_pass = sum(int((s.get("metrics") or {}).get(
+            "abc_screen_pass_total", 0)) for s in snaps)
+        lines.append(
+            f"fidelity: sims_low={sims_low} sims_full={sims_full} "
+            f"full_frac={sims_full / sims_low:.2f} "
+            f"screen_rate={screen_pass / sims_low:.3f}")
     # the serving tier (serve/): studies totals from the same snapshots
     # (counters summed across workers, point-in-time gauges maxed) plus
     # the per-tenant attribution table

@@ -32,6 +32,10 @@ Fault sites (the constants below, one per chokepoint):
   into durable blobs (spill drain / reader hydration)
 - ``journal.write``   — every ``resilience.journal.SpillJournal``
   append (data hook: the framed record bytes)
+- ``fidelity.calibrate`` — block-carry seeding of the multi-fidelity
+  calibration rings (``ABCSMC._seed_block_carry``); a kill here lands
+  between durable generations, so recovery restarts with NaN rings and
+  the first screened generation self-disables (docs/fidelity.md)
 
 Plan grammar (semicolon-separated directives)::
 
@@ -77,12 +81,13 @@ SITE_MATERIALIZE = "history.materialize"
 SITE_JOURNAL = "journal.write"
 SITE_DRAIN = "run.drain"
 SITE_SERVE_WINDOW = "serve.window"
+SITE_FIDELITY_CALIBRATE = "fidelity.calibrate"
 
 #: every named fault site, for validation and docs
 SITES = (SITE_DISPATCH, SITE_FETCH, SITE_APPEND, SITE_HEARTBEAT,
          SITE_PREEMPT, SITE_STORE_DEPOSIT, SITE_STORE_SPILL,
          SITE_STORE_HYDRATE, SITE_MATERIALIZE, SITE_JOURNAL,
-         SITE_DRAIN, SITE_SERVE_WINDOW)
+         SITE_DRAIN, SITE_SERVE_WINDOW, SITE_FIDELITY_CALIBRATE)
 
 FAULTS_ENV = "PYABC_TPU_FAULTS"
 FAULT_SEED_ENV = "PYABC_TPU_FAULT_SEED"

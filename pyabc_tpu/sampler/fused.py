@@ -272,7 +272,8 @@ def _build_one_gen(
         stoch_cfg: Optional[dict] = None,
         summary_lanes: bool = False,
         eps_sketch: bool = False,
-        telemetry_lanes: bool = False):
+        telemetry_lanes: bool = False,
+        fidelity_cfg: Optional[dict] = None):
     """Shared per-generation body behind :func:`build_fused_generations`
     (which scans it K times) and :func:`build_onedispatch_run` (which
     wraps those scans in a device-side stopping ``while_loop``).
@@ -286,6 +287,17 @@ def _build_one_gen(
     post-stop iterations become true no-ops whose outputs the caller
     discards with a select, keeping live generations bit-identical to
     the fused path's.
+
+    ``fidelity_cfg`` (keys ``q``, ``margin``, ``min_corr``,
+    ``min_pairs``, ``cal_rows``, optional ``wire_pass``) switches the
+    round body to the multi-fidelity cascade (docs/fidelity.md):
+    ``raw_round`` must then be the STAGED round (returning
+    ``(RoundResult, (plo, pfull, npass))``), the carry grows NaN-seeded
+    ``cal_lo``/``cal_full`` calibration rings [``cal_rows`` f32], and
+    each generation's screen threshold is calibrated on device from the
+    ring before the rejection loop (``fidelity.screen_threshold``).
+    Mutually exclusive with ``adaptive_cfg``/``stoch_cfg`` (eligibility
+    enforces non-adaptive distance + deterministic acceptor).
     """
     from ..autotune.tuner import EWMA_ALPHA
     from ..wire.store import summary_wire_lanes as _summary_wire_lanes
@@ -295,8 +307,20 @@ def _build_one_gen(
     cap = n_target + B
     stoch = stoch_cfg is not None
     adaptive = adaptive_cfg is not None
+    fidelity = fidelity_cfg is not None
     if eps_mode == "temperature" and not stoch:
         raise ValueError("temperature eps_mode requires stoch_cfg")
+    if fidelity:
+        if adaptive or stoch:
+            raise ValueError("fidelity_cfg is mutually exclusive with "
+                             "adaptive_cfg/stoch_cfg")
+        from ..fidelity import screen_threshold
+        fid_q = float(fidelity_cfg["q"])
+        fid_margin = float(fidelity_cfg["margin"])
+        fid_min_corr = float(fidelity_cfg["min_corr"])
+        fid_min_pairs = int(fidelity_cfg["min_pairs"])
+        fid_cal_rows = int(fidelity_cfg["cal_rows"])
+        fid_wire_pass = bool(fidelity_cfg.get("wire_pass", False))
     if stoch:
         pdf_norm_c = jnp.float32(stoch_cfg["pdf_norm"])
         target_c = jnp.float32(stoch_cfg["target_rate"])
@@ -326,7 +350,7 @@ def _build_one_gen(
         tl_cost = phase_cost_model(
             B=B, n_target=n_target, d=d, s=s, M=M, eps_mode=eps_mode,
             support_rows=(support_cap if capped else n_target),
-            adaptive=adaptive)
+            adaptive=adaptive, fidelity=fidelity)
 
     def one_gen(carry, gen_key, final_flag=None, live=None):
         m0, theta0, lw0, dist0, count0, eps0 = (
@@ -416,6 +440,18 @@ def _build_one_gen(
                   "acceptor": acc_params,
                   "model_log_probs": model_log_probs,
                   "transition": trans}
+        if fidelity:
+            # calibrate THIS generation's screen threshold from the
+            # carried (low, full) pair ring against THIS generation's
+            # epsilon — a NaN-seeded ring (fresh run, restart) or a
+            # weakly-correlated surrogate yields tau = +inf, i.e. the
+            # screen self-disables and every candidate reaches full
+            # fidelity (docs/fidelity.md self-disable semantics)
+            tau = screen_threshold(
+                carry["cal_lo"], carry["cal_full"], eps_t,
+                q=fid_q, margin=fid_margin, min_corr=fid_min_corr,
+                min_pairs=fid_min_pairs)
+            params["fidelity"] = {"tau": tau}
 
         # in-scan rate adaptation: size this generation's round cap from
         # the carried EWMA acceptance-rate estimate (the host
@@ -424,7 +460,22 @@ def _build_one_gen(
         # cap adapts per generation with zero recompiles).  +1 round of
         # slack, floor 2, never beyond the static max_rounds ceiling.
         pred = jnp.maximum(rate0, 1e-6) * jnp.float32(rate_pred_factor)
-        need = jnp.ceil(jnp.float32(n_target) / (pred * B) * safety0) + 1.0
+        eff_B = B
+        if fidelity:
+            # staged-round output shapes (a sharded sampler stacks
+            # per-device slots) — also the slot supply for the round
+            # budget below
+            plo_a, pfull_a, _ = jax.eval_shape(
+                lambda k: raw_round(k, params)[1], gen_key)
+            # slot-capped acceptance: a screened round accepts at most
+            # `slots` candidates however good the proposals, so the
+            # first screened generation of a block (whose carried rate
+            # estimate is per-proposal, not per-slot) must budget
+            # rounds against the slots; cond() still exits the moment
+            # the population fills, so the extra headroom is free
+            eff_B = min(B, max(int(np.prod(plo_a.shape)), 1))
+        need = jnp.ceil(
+            jnp.float32(n_target) / (pred * eff_B) * safety0) + 1.0
         dyn_rounds = jnp.clip(need, rounds_lo, rounds_hi).astype(jnp.int32)
         if live is not None:
             # one-dispatch masking: a dead generation runs ZERO rounds,
@@ -447,6 +498,15 @@ def _build_one_gen(
         elif stoch:
             extras = {"rm": carry["rec_m"], "rtheta": carry["rec_theta"],
                       "rdist": carry["rec_dist"]}
+        elif fidelity:
+            # NaN-seeded pair buffers at the staged round's output
+            # shapes (computed above) — the last rejection round's
+            # pairs feed the next generation's calibration ring; npass
+            # accumulates across rounds
+            extras = {
+                "plo": jnp.full(plo_a.shape, jnp.nan, jnp.float32),
+                "pfull": jnp.full(pfull_a.shape, jnp.nan, jnp.float32),
+                "npass": jnp.int32(0)}
         else:
             extras = {}
 
@@ -457,7 +517,10 @@ def _build_one_gen(
         def body(st):
             key, b, count, rounds, ex = st
             key, sub = jax.random.split(key)
-            rr = raw_round(sub, params)
+            if fidelity:
+                rr, (plo_r, pfull_r, npass_r) = raw_round(sub, params)
+            else:
+                rr = raw_round(sub, params)
             acc = rr.accepted
             pos = count + jnp.cumsum(acc.astype(jnp.int32)) - 1
             idx = jnp.where(acc & (pos < cap), pos, cap)
@@ -479,6 +542,10 @@ def _build_one_gen(
                 # semantics of the host temperature scheme)
                 ex = {"rm": rr.m[:R], "rtheta": rr.theta[:R],
                       "rdist": rr.distance[:R]}
+            elif fidelity:
+                ex = {"plo": plo_r.astype(jnp.float32),
+                      "pfull": pfull_r.astype(jnp.float32),
+                      "npass": ex["npass"] + jnp.sum(npass_r)}
             return key, b, count, rounds + 1, ex
 
         _, bufs, count1, rounds1, extras = lax.while_loop(
@@ -567,6 +634,14 @@ def _build_one_gen(
             new_carry["rec_theta"] = extras["rtheta"]
             new_carry["rec_dist"] = extras["rdist"]
             new_carry["rec_loggen"] = log_den_q[n_target:]
+        if fidelity:
+            # calibration ring update: the LAST rejection round's pairs
+            # push in at the front, oldest rows fall off — the next
+            # generation's threshold sees the freshest annealing stage
+            new_carry["cal_lo"] = jnp.concatenate(
+                [extras["plo"], carry["cal_lo"]])[:fid_cal_rows]
+            new_carry["cal_full"] = jnp.concatenate(
+                [extras["pfull"], carry["cal_full"]])[:fid_cal_rows]
 
         # narrow wire entry (the shared encoder — device_loop.narrow_wire)
         valid1 = jnp.arange(n_target) < count1
@@ -590,6 +665,11 @@ def _build_one_gen(
             # population data
             from ..telemetry.lanes import phase_wire_lanes
             wire.update(phase_wire_lanes(rounds1, B, tl_cost))
+        if fidelity and fid_wire_pass:
+            # screen-survivor count (one i32/generation under the tl_*
+            # egress prefix) — only wired when the driver opts in, so a
+            # lanes-off program stays bit-identical to pre-lanes
+            wire["tl_screen_pass"] = extras["npass"]
         return new_carry, wire
 
     return one_gen
@@ -645,7 +725,8 @@ def build_fused_generations(
         stoch_cfg: Optional[dict] = None,
         summary_lanes: bool = False,
         eps_sketch: bool = False,
-        telemetry_lanes: bool = False):
+        telemetry_lanes: bool = False,
+        fidelity_cfg: Optional[dict] = None):
     """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
     for K generations.  ``carry`` = the previous generation's accepted
     population on device: dict(m[i32 n], theta[f32 n,d], log_weight
@@ -695,7 +776,8 @@ def build_fused_generations(
         raw_round, support_cap=support_cap,
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
         stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
-        eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes)
+        eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes,
+        fidelity_cfg=fidelity_cfg)
     stoch = stoch_cfg is not None
 
     def one_generation(carry, xs):
@@ -742,6 +824,7 @@ def build_onedispatch_run(
         summary_lanes: bool = False,
         eps_sketch: bool = False,
         telemetry_lanes: bool = False,
+        fidelity_cfg: Optional[dict] = None,
         progress: bool = False):
     """Whole-run driver with DEVICE-side stopping: a ``lax.while_loop``
     over K-generation ``lax.scan`` blocks of the same per-generation
@@ -792,7 +875,8 @@ def build_onedispatch_run(
         raw_round, support_cap=support_cap,
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
         stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
-        eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes)
+        eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes,
+        fidelity_cfg=fidelity_cfg)
     if progress:
         from ..telemetry.lanes import device_progress_update
     M = kernel.M

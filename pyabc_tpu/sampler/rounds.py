@@ -118,6 +118,36 @@ class RoundKernel:
     def _eps_hint(self, acceptor_params: dict) -> Array:
         return acceptor_params.get("eps", jnp.float32(jnp.inf))
 
+    def low_models(self):
+        """Per-model low-fidelity variants for the fidelity cascade,
+        built once and cached; ``None`` entries mean the model ships no
+        cheap surrogate (the orchestrator's eligibility check then
+        keeps the run on the exact unscreened path)."""
+        cached = getattr(self, "_low_models", None)
+        if cached is None:
+            # construction may run jnp ops (observation grids etc.); the
+            # first call can land inside a jit trace, so force concrete
+            # evaluation — the cached variants must not capture tracers
+            with jax.ensure_compile_time_eval():
+                cached = [model.low_fidelity() for model in self.models]
+            self._low_models = cached
+        return cached
+
+    def _simulate_all_low(self, key, theta: Array, m: Array):
+        """Low-fidelity sibling of :meth:`_simulate_all`: every model's
+        cheap variant on the full batch, masked selection.  The
+        variants' sum-stat spec is identical by the cascade contract
+        (``Model.low_fidelity``), so the same flatten/obs layout
+        serves both stages; no early-reject channel — screening IS the
+        early rejection here."""
+        B = theta.shape[0]
+        stats = jnp.zeros((B, self.spec.total_size), dtype=jnp.float32)
+        for j, model in enumerate(self.low_models()):
+            kj = jax.random.fold_in(key, j)
+            s_j = self.spec.flatten(model.simulate(kj, theta[:, :self.priors[j].dim]))
+            stats = jnp.where((m == j)[:, None], s_j, stats)
+        return stats
+
     def _replicated_evaluate(self, ksim, kacc, theta: Array, m: Array,
                              params: dict, all_accepted: bool = False):
         """K-replicate simulate + distance + accept (reference
@@ -266,9 +296,12 @@ class RoundKernel:
             model_log_probs[:, None] + log_jump, axis=0)     # [B]
         return log_mix + lp_target
 
-    def generation_round(self, key, params: dict, B: int,
-                         with_proposal: bool = True) -> RoundResult:
-        km, kj, kth, ksim, kacc = jax.random.split(key, 5)
+    def _propose(self, km, kj, kth, params: dict, B: int):
+        """Steps 1-3 of the generation round: model jump, transition
+        draw, prior validity.  Factored so :meth:`generation_round` and
+        :meth:`staged_generation_round` share EXACTLY the same proposal
+        stream (same keys, same ops) — with screening off the two rounds
+        propose bit-identical candidates."""
         model_log_probs = params["model_log_probs"]          # [M]
         trans_params = params["transition"]                  # tuple per model
 
@@ -287,6 +320,12 @@ class RoundKernel:
         # 3. prior validity (replaces resample-until-positive, smc.py:654)
         log_prior = self._log_prior(m, theta)
         valid = jnp.isfinite(log_prior)
+        return m, theta, log_prior, valid
+
+    def generation_round(self, key, params: dict, B: int,
+                         with_proposal: bool = True) -> RoundResult:
+        km, kj, kth, ksim, kacc = jax.random.split(key, 5)
+        m, theta, log_prior, valid = self._propose(km, kj, kth, params, B)
 
         # 4. simulate + distance + accept, K replicates per parameter
         # (smc.py:664-724); +inf distances reject too (for stochastic
@@ -322,3 +361,101 @@ class RoundKernel:
 
     # flag read by samplers (via the bound method) to decide deferral
     generation_round.supports_deferred_proposal = True
+
+    # ---- staged (multi-fidelity) generation round ------------------------
+
+    def staged_generation_round(self, key, params: dict, B: int,
+                                full_fraction: float = 0.5,
+                                with_proposal: bool = True):
+        """Two-stage round: cheap low-fidelity screen, then full fidelity
+        on the survivors only (docs/fidelity.md).
+
+        Same proposal stream as :meth:`generation_round` (shared
+        :meth:`_propose`), then:
+
+        1. every candidate runs its model's ``low_fidelity()`` variant;
+        2. the low-fidelity distance is screened against the calibrated
+           threshold ``params["fidelity"]["tau"]`` (computed by
+           ``pyabc_tpu.fidelity.screen_threshold`` in the fused scan —
+           never here; the round only CONSUMES tau);
+        3. the first ``n_full = ceil(B * full_fraction)`` survivors are
+           compacted into static slots, re-simulated at FULL fidelity,
+           and put through the real accept test;
+        4. results scatter back to batch shape — screened-out rows carry
+           ``distance=+inf, log_weight=-inf, accepted=False``.
+
+        Returns ``(RoundResult, (plo[n_full], pfull[n_full], npass[1]))``
+        where the pair arrays are the round's paired (low, full) distance
+        samples (NaN in unused slots) feeding next generation's
+        calibration, and ``npass`` is the survivor count ([1]-shaped i32
+        so the sharded sampler can stack it across devices).
+
+        ``full_fraction`` is static: it fixes the full-fidelity slot
+        count per (possibly per-device) batch ``B``.  Requires K == 1
+        (``ABCSMC._fidelity_eligible`` enforces this).
+        """
+        if self.K != 1:
+            raise ValueError(
+                "staged_generation_round requires nr_samples_per_parameter"
+                f" == 1, got K={self.K}")
+        from ..fidelity import compact_survivors, scatter_back, screen_mask
+        from ..fidelity.config import FidelityConfig
+
+        km, kj, kth, ksim, kacc = jax.random.split(key, 5)
+        m, theta, log_prior, valid = self._propose(km, kj, kth, params, B)
+
+        # low-fidelity stage on the whole batch
+        klow, kfull = jax.random.split(ksim)
+        stats_lo = self._simulate_all_low(klow, theta, m)
+        d_lo = self.distance.compute(stats_lo, self.obs_flat,
+                                     params["distance"])
+        tau = params["fidelity"]["tau"]
+        survive = screen_mask(d_lo, tau, valid)
+
+        # compact survivors into the static full-fidelity slots
+        n_full = FidelityConfig.static_n_full(B, full_fraction)
+        idx, slot_ok, idx_c = compact_survivors(survive, n_full)
+        theta_f = theta[idx_c]
+        m_f = m[idx_c]
+
+        # full-fidelity stage on survivors only
+        eps = self._eps_hint(params.get("acceptor", {}))
+        stats_f, early_f = self._simulate_all(kfull, theta_f, m_f, eps)
+        d_f = self.distance.compute(stats_f, self.obs_flat,
+                                    params["distance"])
+        acc_f, acc_w_f = self.acceptor.accept(kacc, d_f, params["acceptor"])
+        accepted_f = (acc_f & ~early_f & jnp.isfinite(d_f) & slot_ok)
+        log_acc_f = jnp.log(jnp.maximum(acc_w_f, 1e-38))
+
+        # importance weight on the compacted rows (same math as
+        # generation_round step 5, restricted to survivors)
+        log_prior_f = log_prior[idx_c]
+        if with_proposal:
+            log_denom_f = self.proposal_log_density(m_f, theta_f, params)
+            lw_f = log_prior_f + log_acc_f - log_denom_f
+            log_proposal = scatter_back(idx, log_denom_f, B, jnp.nan)
+        else:
+            lw_f = log_prior_f + log_acc_f
+            log_proposal = jnp.full((B,), jnp.nan)
+        lw_f = jnp.where(accepted_f, lw_f, -jnp.inf)
+
+        # scatter back to batch shape; theta/m stay the original [B]
+        # arrays (only accepted rows — all survivor slots — are read)
+        distance = scatter_back(idx, d_f, B, jnp.float32(jnp.inf))
+        log_weight = scatter_back(idx, lw_f, B, jnp.float32(-jnp.inf))
+        accepted = scatter_back(idx, accepted_f, B, False)
+        stats = scatter_back(idx, stats_f, B, jnp.float32(0.0))
+
+        # calibration pairs: paired (low, full) distances of genuine
+        # survivor slots; NaN elsewhere (the calibrator masks non-finite)
+        plo = jnp.where(slot_ok, d_lo[idx_c], jnp.nan)
+        pfull = jnp.where(slot_ok, d_f, jnp.nan)
+        npass = jnp.sum(survive).astype(jnp.int32)[None]
+
+        rr = RoundResult(m=m, theta=theta, distance=distance,
+                         accepted=accepted, log_weight=log_weight,
+                         stats=stats, valid=valid,
+                         log_proposal=log_proposal)
+        return rr, (plo, pfull, npass)
+
+    staged_generation_round.supports_deferred_proposal = True

@@ -69,9 +69,20 @@ class StudySpec:
     max_generations: int = 8
     min_acceptance_rate: float = 0.0
     seed: int = 0
+    #: multi-fidelity screening mode: ``"off"`` (exact unscreened
+    #: program) or ``"screen"`` (docs/fidelity.md) — digest-bearing in
+    #: BOTH digests: screening changes the traced program AND the
+    #: accepted sample, so a screened study must never alias an
+    #: unscreened one in any cache
+    fidelity: str = "off"
     tenant: str = "default"
     priority: int = 0
     name: Optional[str] = None
+
+    def __post_init__(self):
+        if self.fidelity not in ("off", "screen"):
+            raise ValueError(f"fidelity must be 'off' or 'screen' "
+                             f"(got {self.fidelity!r})")
 
 
 def _callable_fingerprint(fn: Callable) -> str:
@@ -129,6 +140,7 @@ def study_digest(spec: StudySpec) -> str:
         "max_generations": int(spec.max_generations),
         "min_acceptance_rate": float(spec.min_acceptance_rate),
         "seed": int(spec.seed),
+        "fidelity": str(spec.fidelity),
     })
 
 
@@ -146,4 +158,5 @@ def problem_key(spec: StudySpec) -> str:
         "observed": _observed_canonical(spec.observed),
         "population_size": int(spec.population_size),
         "min_acceptance_rate": float(spec.min_acceptance_rate),
+        "fidelity": str(spec.fidelity),
     })

@@ -258,7 +258,11 @@ class ServeWorker:
             # one-dispatch eligibility needs fused blocks; 4 matches
             # the bench one-dispatch rows
             fuse_generations=4,
-            seed=int(spec.seed))
+            seed=int(spec.seed),
+            # SimpleModel ships no low_fidelity(), so "screen" only
+            # engages for model classes that do — the flag still enters
+            # the engine's compile-cache identity via FidelityConfig
+            fidelity=getattr(spec, "fidelity", "off"))
 
     def _engine_for(self, spec: StudySpec, db: str = "sqlite://"):
         """Warm :class:`ABCSMC` for this spec's problem, renewed for

@@ -31,6 +31,7 @@ from .autotune import (compile_counters as _compile_counters,
 from .autotune import occupancy as _occupancy
 from .distance import Distance, PNormDistance, StochasticKernel, to_distance
 from .epsilon import Epsilon, MedianEpsilon, TemperatureBase
+from .fidelity import FidelityConfig as _FidelityConfig
 from .model import Model, SimpleModel
 from .parallel.health import stop_requested
 from .population import Population
@@ -145,6 +146,7 @@ class ABCSMC:
                  checkpoint_every_rounds: Optional[int] = None,
                  history_mode: Optional[str] = None,
                  run_mode: Optional[str] = None,
+                 fidelity=None,
                  seed: int = 0):
         if not isinstance(models, (list, tuple)):
             models = [models]
@@ -253,6 +255,19 @@ class ABCSMC:
         #: currently behaves as classic (the device-stop program is
         #: opt-in while it hardens).  None defers to $PYABC_TPU_RUN_MODE.
         self.run_mode = run_mode
+        #: multi-fidelity early-reject cascade (pyabc_tpu/fidelity/,
+        #: docs/fidelity.md): None/"off" keeps every program bit-
+        #: identical to pre-fidelity builds (the staged path is never
+        #: even traced); "screen"/True/FidelityConfig opts the fused and
+        #: one-dispatch engines into the staged round WHEN the
+        #: configuration is screen-eligible (_fidelity_eligible) —
+        #: ineligible configurations silently run the exact unscreened
+        #: program, like every other capability gate.  The resolved
+        #: config is digest-bearing (FidelityConfig.digest_key enters
+        #: every compile-cache key; StudySpec.fidelity enters the study
+        #: digest).  $PYABC_TPU_FIDELITY=off is the operational kill
+        #: switch (it never turns screening ON).
+        self.fidelity = _FidelityConfig.resolve(fidelity)
         #: program-shape knob for the one-dispatch run: the device
         #: while-loop writes into egress buffers sized for at most this
         #: many generations per dispatch (the CompiledLadder keys whole-
@@ -842,6 +857,59 @@ class ABCSMC:
             return False
         return self._device_chain_eligible()
 
+    def _fidelity_eligible(self) -> bool:
+        """Route device blocks through the staged multi-fidelity round
+        (sampler/rounds.py:staged_generation_round, docs/fidelity.md)?
+
+        Opt-in via ``fidelity=`` on top of the device-computable chain,
+        PLUS the screen-specific capability flags: the distance and the
+        acceptor must both declare ``device_screen_ok`` (comparable
+        low/full distances on a run-invariant scale; deterministic
+        threshold accept), every model must ship a ``low_fidelity()``
+        variant that declares ``screen_stats_compatible``, and the
+        adaptive/stochastic chains are excluded (their per-generation
+        scale/temperature state is exactly what screening must not
+        perturb).  ``nr_samples_per_parameter == 1`` is already a
+        device-chain precondition.  Ineligible configurations silently
+        run the exact unscreened program — same posture as every other
+        capability gate here."""
+        if self.fidelity is None:
+            return False
+        mode = self._block_mode()
+        if mode["adaptive"] or mode["stoch"]:
+            return False
+        if not getattr(self.distance_function, "device_screen_ok",
+                       False):
+            return False
+        if not getattr(self.acceptor, "device_screen_ok", False):
+            return False
+        for m in self.models:
+            if m.low_fidelity() is None:
+                return False
+            if not getattr(m, "screen_stats_compatible", False):
+                return False
+        return self._device_chain_eligible()
+
+    def _fidelity_block_cfg(self, wire_pass: bool = False) -> dict:
+        """The ``fidelity_cfg`` dict a device block builder consumes
+        (sampler/fused.py:_build_one_gen).  ``wire_pass`` adds the
+        ``tl_screen_pass`` egress lane — only the one-dispatch driver
+        sets it (under the telemetry-lanes gate), so fused-block
+        programs keep their exact wire layout."""
+        fid = self.fidelity
+        return {"q": fid.false_reject_q, "margin": fid.margin,
+                "min_corr": fid.min_corr, "min_pairs": fid.min_pairs,
+                "cal_rows": fid.cal_rows, "wire_pass": bool(wire_pass)}
+
+    def _fidelity_full_slots(self, B: int) -> int:
+        """Full-fidelity simulations per rejection round at batch ``B``
+        (the sims_full accounting numerator).  A sharded sampler
+        compacts per device, so the slot count is per-shard × shards."""
+        nd = int(getattr(self.sampler, "n_devices", 1) or 1)
+        if nd > 1:
+            return self.fidelity.n_full(max(B // nd, 1)) * nd
+        return self.fidelity.n_full(B)
+
     def _note_sequential_gen_s(self, wall_s: float, compile_s: float = 0.0):
         """Record a sequential generation's steady-state seconds as the
         engine probe's baseline (compile time excluded — the fused
@@ -1040,7 +1108,36 @@ class ABCSMC:
                 carry_in["rec_dist"] = jnp.full((R,), jnp.nan,
                                                 jnp.float32)
                 carry_in["rec_loggen"] = jnp.zeros((R,), jnp.float32)
+        if self._fidelity_eligible():
+            # the calibration-assembly fault site: a kill here (chaos
+            # plan ``fidelity.calibrate``) dies with the previous
+            # generations already durable — the restart re-enters this
+            # method, takes the NaN-seed branch below, and the first
+            # screened generation self-disables (tau = +inf); zero
+            # generations lost, posterior gate-clean (docs/resilience.md)
+            _faults.fault_point(_faults.SITE_FIDELITY_CALIBRATE,
+                                data={"t": t})
+            rows = self.fidelity.cal_rows
+            if ("cal_lo" in carry and "cal_full" in carry
+                    and carry["cal_lo"].shape[0] == rows):
+                carry_in["cal_lo"] = carry["cal_lo"]
+                carry_in["cal_full"] = carry["cal_full"]
+            else:
+                carry_in["cal_lo"], carry_in["cal_full"] = \
+                    self._fidelity_nan_seed(rows)
         return carry_in
+
+    @staticmethod
+    def _fidelity_nan_seed(rows: int):
+        """Fresh (all-NaN) calibration rings — the fidelity cascade's
+        RECOVERY BOUNDARY: a fresh run, a restart after ``kill -9``, or
+        any carry that cannot prove its rings match the current config
+        starts here, and ``fidelity.screen_threshold`` maps an all-NaN
+        ring to a +inf threshold (screening self-disabled) until real
+        paired samples accumulate.  Conservative by construction: the
+        degraded state is the exact unscreened accept test."""
+        nan = jnp.full((rows,), jnp.nan, jnp.float32)
+        return nan, jnp.full((rows,), jnp.nan, jnp.float32)
 
     def _block_max_rounds(self, n: int, B: int,
                           rate_est: Optional[float] = None) -> int:
@@ -1055,16 +1152,32 @@ class ABCSMC:
         ``min_acceptance_rate`` budget then CLAMPS below the ceiling:
         past ``ceil(n / (min_rate * B))`` evaluations the sequential
         loop would have stopped anyway, so rounds beyond that only burn
-        device time on a generation the ingest will discard."""
+        device time on a generation the ingest will discard.
+
+        Screened blocks (docs/fidelity.md) budget against the
+        full-fidelity SLOT supply instead of the proposal batch: a
+        round can accept at most ``n_full`` candidates (worst case the
+        self-disabled ``tau = +inf`` screen, where every valid
+        candidate competes for the slots), so a small
+        ``full_fraction`` needs proportionally more rounds — without
+        this the first screened block after a restart undershoots and
+        bounces the run to the sequential (unscreened) path.  The
+        ceiling scales the same way; a screened round costs a fraction
+        of an unscreened one, so the device-time bound is unchanged."""
         hi = 16
+        hi_cap = 64
+        B_eff = B
+        if self._fidelity_eligible():
+            B_eff = self._fidelity_full_slots(B)
+            hi_cap = 64 * max(1, int(round(B / max(B_eff, 1))))
         if rate_est is not None and rate_est > 0:
             need = int(np.ceil(
-                n / (max(float(rate_est), 1e-6) * B) * 4.0)) + 1
-            while hi < need and hi < 64:
+                n / (max(float(rate_est), 1e-6) * B_eff) * 4.0)) + 1
+            while hi < need and hi < hi_cap:
                 hi *= 2
         if self.min_acceptance_rate > 0:
             return int(np.clip(
-                np.ceil(n / (self.min_acceptance_rate * B)), 1, hi))
+                np.ceil(n / (self.min_acceptance_rate * B_eff)), 1, hi))
         return hi
 
     def _lazy_gen_fetch(self, t0: int, n: int):
@@ -1141,14 +1254,17 @@ class ABCSMC:
             norms = self.acceptor.pdf_norms
             pdf_norm = float(norms.get(t, norms[max(norms)]
                                        if norms else 0.0))
+        fid_on = self._fidelity_eligible()
+        fid_key = self.fidelity.digest_key() if fid_on else None
         # samp._uid: the compiled fn closes over the sampler's round
         # builder (for ShardedSampler that bakes in mesh + axis), so a
         # swapped sampler must never be served a stale program
-        cache_key = ("fused3", self._kernel._uid, samp._uid, B,
+        cache_key = ("fused4", self._kernel._uid, samp._uid, B,
                      n, K, d, s_width, eps_mode, alpha, mult, weighted,
                      eps_sketch, wire_stats, wire_m_bits, max_rounds,
                      sup_cap, mode["adaptive"], mode["stoch"],
-                     record_rows, pdf_norm, bool(summary), eff_donate)
+                     record_rows, pdf_norm, bool(summary), eff_donate,
+                     fid_key)
 
         def build():
             from .distance.kernel import SCALE_LIN
@@ -1173,14 +1289,24 @@ class ABCSMC:
                                   == SCALE_LIN),
                     "record_rows": record_rows,
                 }
+            fidelity_cfg = None
+            round_fn = self._kernel.generation_round
+            round_kwargs = {}
+            if fid_on:
+                # the staged screen-then-verify round; full_fraction is
+                # a static kwarg so a sharded sampler applies it to its
+                # per-device batch (sampler/rounds.py)
+                fidelity_cfg = self._fidelity_block_cfg(wire_pass=False)
+                round_fn = self._kernel.staged_generation_round
+                round_kwargs = {
+                    "full_fraction": self.fidelity.full_fraction}
             return jit_compile(build_fused_generations(
                 kernel=self._kernel,
                 # the sampler's round builder: a ShardedSampler hands
                 # back the shard_mapped round, so the fused scan SPMDs
                 # over the mesh like the per-generation loop
                 raw_round=samp._raw_round(
-                    self._kernel.generation_round, B,
-                    with_proposal=False),
+                    round_fn, B, with_proposal=False, **round_kwargs),
                 bandwidth_selectors=[tr.bandwidth_selector
                                      for tr in self.transitions],
                 scalings=[tr.scaling for tr in self.transitions],
@@ -1203,7 +1329,8 @@ class ABCSMC:
                 rate_pred_factor=(alpha if eps_mode == "quantile"
                                   else 1.0),
                 adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
-                summary_lanes=bool(summary), eps_sketch=eps_sketch),
+                summary_lanes=bool(summary), eps_sketch=eps_sketch,
+                fidelity_cfg=fidelity_cfg),
                 **({"donate_argnums": (0,)} if eff_donate else {}))
 
         # block programs live in the sampler's CompiledLadder (one
@@ -1428,6 +1555,7 @@ class ABCSMC:
                 self._decide_engine(
                     (block_dt - cc_delta["compile_s"]) / written)
             engine_lbl = self._engine_choice if at_scale else None
+            fid_on = self._fidelity_eligible()
             for k in range(written):
                 self.generation_wall_clock[t + k] = block_dt / written
                 self.generation_transfer[t + k] = {
@@ -1451,7 +1579,11 @@ class ABCSMC:
                     engine=engine_lbl)
                 _metrics.record_generation(
                     evals_k, count_k, count_k / max(evals_k, 1),
-                    rounds=rounds_k, wall_s=block_dt / written)
+                    rounds=rounds_k, wall_s=block_dt / written,
+                    **(dict(sims_low=rounds_k * B,
+                            sims_full=(rounds_k
+                                       * self._fidelity_full_slots(B)))
+                       if fid_on else {}))
                 samp.observe_generation(
                     count_k, evals_k, rounds=rounds_k,
                     compute_s=tr_delta["compute_s"] / written,
@@ -1527,13 +1659,15 @@ class ABCSMC:
             pdf_norm = float(norms.get(t, norms[max(norms)]
                                        if norms else 0.0))
         lanes_on = bool(self.telemetry_lanes)
-        cache_key = ("onedispatch4", self._kernel._uid, samp._uid, B,
+        fid_on = self._fidelity_eligible()
+        fid_key = self.fidelity.digest_key() if fid_on else None
+        cache_key = ("onedispatch5", self._kernel._uid, samp._uid, B,
                      n, K, max_T, d, s_width, eps_mode, alpha, mult,
                      weighted, eps_sketch, wire_stats, wire_m_bits,
                      max_rounds, sup_cap, mode["adaptive"],
                      mode["stoch"], record_rows, pdf_norm,
                      single_model_stop, bool(summary),
-                     self._donate_carry, lanes_on)
+                     self._donate_carry, lanes_on, fid_key)
 
         def build():
             from .autotune.ladder import aot_compile, avals_like
@@ -1559,11 +1693,20 @@ class ABCSMC:
                                   == SCALE_LIN),
                     "record_rows": record_rows,
                 }
+            fidelity_cfg = None
+            round_fn = self._kernel.generation_round
+            round_kwargs = {}
+            if fid_on:
+                fidelity_cfg = self._fidelity_block_cfg(
+                    wire_pass=lanes_on)
+                round_fn = self._kernel.staged_generation_round
+                round_kwargs = {
+                    "full_fraction": self.fidelity.full_fraction}
             fn = jit_compile(build_onedispatch_run(
                 kernel=self._kernel,
                 raw_round=samp._raw_round(
-                    self._kernel.generation_round, B,
-                    with_proposal=False),
+                    round_fn, B,
+                    with_proposal=False, **round_kwargs),
                 bandwidth_selectors=[tr.bandwidth_selector
                                      for tr in self.transitions],
                 scalings=[tr.scaling for tr in self.transitions],
@@ -1583,7 +1726,8 @@ class ABCSMC:
                                   else 1.0),
                 adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
                 summary_lanes=bool(summary), eps_sketch=eps_sketch,
-                telemetry_lanes=lanes_on, progress=lanes_on),
+                telemetry_lanes=lanes_on, progress=lanes_on,
+                fidelity_cfg=fidelity_cfg),
                 **self._donate_jit_kwargs())
             if aot_args is not None:
                 try:
@@ -1940,6 +2084,7 @@ class ABCSMC:
             # the rounds gets 10x the wall), uniform otherwise — the
             # pre-lanes behaviour
             rounds_sum = float(sum(gm[3] for gm in gen_meta))
+            fid_on = self._fidelity_eligible()
             for k in range(written):
                 rounds_k = gen_meta[k][3]
                 share = (rounds_k / rounds_sum if rounds_sum > 0
@@ -1969,9 +2114,18 @@ class ABCSMC:
                     compile_s=(cc_delta["compile_s"] if k == 0 else 0.0),
                     n_compiles=(cc_delta["n_compiles"] if k == 0 else 0),
                     engine="onedispatch", phases=phases_k)
+                fid_kwargs = {}
+                if fid_on:
+                    fid_kwargs = dict(
+                        sims_low=rounds_k * B,
+                        sims_full=(rounds_k
+                                   * self._fidelity_full_slots(B)))
+                    if tl_k is not None and "tl_screen_pass" in tl_k:
+                        fid_kwargs["screen_pass"] = int(
+                            np.asarray(tl_k["tl_screen_pass"]).sum())
                 _metrics.record_generation(
                     evals_k, count_k, count_k / max(evals_k, 1),
-                    rounds=rounds_k, wall_s=wall_k)
+                    rounds=rounds_k, wall_s=wall_k, **fid_kwargs)
                 samp.observe_generation(
                     count_k, evals_k, rounds=rounds_k,
                     compute_s=tr_delta["compute_s"] * share,
@@ -2422,7 +2576,13 @@ class ABCSMC:
                         engine=engine_lbl)
                     _metrics.record_generation(
                         evals_k, count_k, count_k / max(evals_k, 1),
-                        rounds=rounds_k, wall_s=block_dt / written)
+                        rounds=rounds_k, wall_s=block_dt / written,
+                        **(dict(sims_low=rounds_k * blk["B"],
+                                sims_full=(rounds_k
+                                           * self._fidelity_full_slots(
+                                               blk["B"])))
+                           if (blk["kind"] == "block"
+                               and self._fidelity_eligible()) else {}))
                     if blk["kind"] == "block":
                         # seq-kind entries already fed the tuner inside
                         # sample_until_n_accepted — don't double-count

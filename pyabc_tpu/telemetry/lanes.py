@@ -61,10 +61,13 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-#: phases of one fused generation, in program order.  ``simulate`` and
-#: ``distance`` scale with the rejection rounds; ``eps_solve`` /
-#: ``refit`` / ``resample`` are once-per-generation adaptation work.
-PHASES = ("simulate", "distance", "eps_solve", "refit", "resample")
+#: phases of one fused generation, in program order.  ``simulate``,
+#: ``distance`` and ``screen`` scale with the rejection rounds
+#: (``screen`` is the multi-fidelity cascade's low-fidelity stage,
+#: zero-cost when screening is off); ``eps_solve`` / ``refit`` /
+#: ``resample`` are once-per-generation adaptation work.
+PHASES = ("simulate", "distance", "screen", "eps_solve", "refit",
+          "resample")
 
 #: wire-lane prefix; the store/drain exclude ``tl_*`` lanes from
 #: population decode exactly like the ``sm_*`` summary lanes
@@ -95,7 +98,8 @@ def poll_interval_s() -> float:
 
 def phase_cost_model(*, B: int, n_target: int, d: int, s: int, M: int,
                      eps_mode: str, support_rows: int,
-                     adaptive: bool) -> Dict[str, float]:
+                     adaptive: bool,
+                     fidelity: bool = False) -> Dict[str, float]:
     """Static per-phase cost factors for one generation, derived from
     the program shape (batch ``B``, population ``n_target``, parameter
     dim ``d``, summary-stat width ``s``, ``M`` models, the epsilon mode
@@ -109,6 +113,12 @@ def phase_cost_model(*, B: int, n_target: int, d: int, s: int, M: int,
         "simulate": {"per_round": float(B) * max(s, 1), "fixed": 0.0},
         # distance kernel over the candidate stats per round
         "distance": {"per_round": float(B) * max(s, 1), "fixed": 0.0},
+        # multi-fidelity low-fidelity stage + threshold screen per
+        # round; an unscreened program carries a zero-cost row so the
+        # lane layout (and egress size) is mode-independent
+        "screen": {"per_round": (float(B) * max(s, 1) if fidelity
+                                 else 0.0),
+                   "fixed": 0.0},
         # weighted quantile: O(n log n) sort (or O(n) sketch, but the
         # ratio distinction is below attribution noise); temperature:
         # bisection over the record ring; constant: free

@@ -221,8 +221,18 @@ _STARTED_AT = time.time()
 
 def record_generation(evals: int, accepted: int, acc_rate: float,
                       rounds: Optional[int] = None,
-                      wall_s: Optional[float] = None):
-    """One call per completed SMC generation, from any run path."""
+                      wall_s: Optional[float] = None,
+                      sims_low: Optional[int] = None,
+                      sims_full: Optional[int] = None,
+                      screen_pass: Optional[int] = None):
+    """One call per completed SMC generation, from any run path.
+
+    ``sims_low``/``sims_full``/``screen_pass`` are set only by
+    fidelity-screened runs (docs/fidelity.md): low-fidelity candidate
+    simulations, full-fidelity survivor simulations, and screen
+    survivors — their ratio is the realized screen rate surfaced in
+    ``abc-top`` and the fleet rollup.
+    """
     REGISTRY.counter("abc_generations_total",
                      "completed SMC generations").inc()
     REGISTRY.counter("abc_evaluations_total",
@@ -237,6 +247,23 @@ def record_generation(evals: int, accepted: int, acc_rate: float,
     if wall_s is not None:
         REGISTRY.histogram("abc_generation_seconds",
                            "wall time per generation").observe(wall_s)
+    if sims_low is not None:
+        REGISTRY.counter("abc_sims_low_total",
+                         "low-fidelity screening simulations").inc(
+                             sims_low)
+    if sims_full is not None:
+        REGISTRY.counter("abc_sims_full_total",
+                         "full-fidelity survivor simulations").inc(
+                             sims_full)
+    if screen_pass is not None:
+        REGISTRY.counter("abc_screen_pass_total",
+                         "candidates surviving the fidelity screen").inc(
+                             screen_pass)
+        if sims_low:
+            REGISTRY.gauge(
+                "abc_screen_rate",
+                "fidelity-screen survival rate of latest generation"
+            ).set(screen_pass / max(sims_low, 1))
 
 
 def heartbeat_summary() -> dict:

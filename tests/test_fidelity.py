@@ -1,0 +1,475 @@
+"""Multi-fidelity early-reject cascade (pyabc_tpu/fidelity/,
+docs/fidelity.md).
+
+Pins the subsystem's statistical contract end to end:
+
+- the device calibrator (``screen_threshold``) against its numpy
+  mirror, the conservative false-reject quantile bound (property
+  test), and every self-disable trigger (weak correlation, too few
+  pairs, NaN rings, non-finite quantile);
+- the screening kernels: static-slot survivor compaction and the
+  scatter back to the round batch;
+- ``FidelityConfig`` resolution (opt-in semantics, kill switch,
+  digest identity);
+- orchestrator integration: eligibility gating, ``fidelity="off"``
+  bit-identity with pre-PR programs, staged/plain rounds sharing one
+  proposal stream, and the screened fused run's posterior agreeing
+  with the unscreened run;
+- resilience: a ``kill -9`` mid-calibration (``fidelity.calibrate``
+  fault site) loses zero durable generations; the recovery process
+  resumes with NaN-seeded rings, i.e. screening self-disabled;
+- 4-seed posterior gates on SIR and LV (slow).
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.fidelity import (FidelityConfig, compact_survivors,
+                                pearson_corr_np, scatter_back,
+                                screen_mask, screen_threshold,
+                                screen_threshold_np)
+from pyabc_tpu.models.lotka_volterra import LotkaVolterraSDE
+from pyabc_tpu.models.sir import SIRTauLeap
+from pyabc_tpu.random_variables import RV, Distribution
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     os.pardir))
+
+KW = dict(q=0.02, margin=1.25, min_corr=0.2, min_pairs=32)
+
+
+def _paired(n=512, noise=0.1, seed=0):
+    """Correlated (low, full) distance pairs, strictly positive."""
+    rng = np.random.default_rng(seed)
+    d_full = rng.gamma(2.0, 1.0, n).astype(np.float32)
+    d_lo = (d_full * (1.0 + noise * rng.standard_normal(n))
+            + 0.05).astype(np.float32)
+    return d_lo, d_full
+
+
+# ---------------------------------------------------------------------------
+# calibrator
+# ---------------------------------------------------------------------------
+
+def test_threshold_matches_numpy_mirror():
+    d_lo, d_full = _paired()
+    eps = float(np.median(d_full))
+    tau_dev = float(screen_threshold(jnp.asarray(d_lo),
+                                     jnp.asarray(d_full),
+                                     jnp.float32(eps), **KW))
+    tau_np = screen_threshold_np(d_lo, d_full, eps, **KW)
+    assert tau_dev == pytest.approx(tau_np, rel=1e-5)
+    assert np.isfinite(tau_dev)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("q", [0.02, 0.1, 0.25])
+def test_false_reject_bound_is_conservative(seed, q):
+    """At margin=1, the fraction of ACCEPTABLE pairs (d_full <= eps)
+    whose low-fidelity distance exceeds tau is at most q — the ceil'd
+    quantile index makes the empirical bound hold exactly, not just in
+    expectation.  The shipped margin > 1 only loosens it further."""
+    d_lo, d_full = _paired(n=1024, noise=0.3, seed=seed)
+    eps = float(np.quantile(d_full, 0.3))
+    acceptable = d_full <= eps
+    for margin in (1.0, 1.25):
+        tau = screen_threshold_np(d_lo, d_full, eps, q=q, margin=margin,
+                                  min_corr=0.0, min_pairs=8)
+        assert np.isfinite(tau)
+        false_reject = float(np.mean(d_lo[acceptable] > tau))
+        assert false_reject <= q + 1e-9, (margin, false_reject)
+    tau1 = screen_threshold_np(d_lo, d_full, eps, q=q, margin=1.0,
+                               min_corr=0.0, min_pairs=8)
+    tau2 = screen_threshold_np(d_lo, d_full, eps, q=q, margin=1.5,
+                               min_corr=0.0, min_pairs=8)
+    assert tau2 >= tau1
+
+
+def test_weak_correlation_self_disables():
+    rng = np.random.default_rng(3)
+    d_lo = rng.gamma(2.0, 1.0, 512).astype(np.float32)   # independent
+    d_full = rng.gamma(2.0, 1.0, 512).astype(np.float32)
+    eps = float(np.median(d_full))
+    tau = float(screen_threshold(jnp.asarray(d_lo), jnp.asarray(d_full),
+                                 jnp.float32(eps), q=0.02, margin=1.25,
+                                 min_corr=0.9, min_pairs=32))
+    assert tau == np.inf
+    # sanity: the correlation really is below the floor
+    acc = d_full <= eps
+    assert pearson_corr_np(d_lo[acc], d_full[acc]) < 0.9
+
+
+def test_nan_rings_and_min_pairs_self_disable():
+    nan = jnp.full((128,), jnp.nan, jnp.float32)
+    assert float(screen_threshold(nan, nan, jnp.float32(1.0),
+                                  **KW)) == np.inf
+    d_lo, d_full = _paired(n=16)
+    tau = float(screen_threshold(jnp.asarray(d_lo), jnp.asarray(d_full),
+                                 jnp.float32(np.median(d_full)),
+                                 q=0.02, margin=1.25, min_corr=0.0,
+                                 min_pairs=32))
+    assert tau == np.inf  # 16 pairs < min_pairs
+
+
+def test_threshold_is_traceable():
+    d_lo, d_full = _paired()
+    fn = jax.jit(lambda lo, fu, e: screen_threshold(lo, fu, e, **KW))
+    tau = float(fn(jnp.asarray(d_lo), jnp.asarray(d_full),
+                   jnp.float32(np.median(d_full))))
+    assert tau == pytest.approx(
+        screen_threshold_np(d_lo, d_full, float(np.median(d_full)),
+                            **KW), rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# screening kernels
+# ---------------------------------------------------------------------------
+
+def test_screen_mask_nan_and_inf_semantics():
+    d_lo = jnp.asarray([0.5, 2.0, jnp.nan, 1.0], jnp.float32)
+    valid = jnp.asarray([True, True, True, False])
+    # finite tau: NaN low distances SURVIVE (cannot screen on garbage),
+    # invalid proposals never survive
+    m = np.asarray(screen_mask(d_lo, jnp.float32(1.0), valid))
+    assert m.tolist() == [True, False, True, False]
+    # self-disabled (tau = +inf): every valid candidate survives
+    m = np.asarray(screen_mask(d_lo, jnp.float32(jnp.inf), valid))
+    assert m.tolist() == [True, True, True, False]
+
+
+def test_compact_scatter_roundtrip():
+    survive = jnp.asarray([False, True, False, True, True, False])
+    idx, slot_ok, idx_c = compact_survivors(survive, n_full=2)
+    # only the first n_full survivors get slots, theta-independently
+    assert np.asarray(idx).tolist()[:2] == [1, 3]
+    assert np.asarray(slot_ok).tolist() == [True, True]
+    vals = jnp.asarray([10.0, 30.0], jnp.float32)
+    out = np.asarray(scatter_back(idx, vals, 6, jnp.float32(jnp.inf)))
+    assert out.tolist() == [np.inf, 10.0, np.inf, 30.0, np.inf, np.inf]
+    # more slots than survivors: overflow slots are dead
+    idx, slot_ok, idx_c = compact_survivors(survive, n_full=5)
+    assert np.asarray(slot_ok).sum() == 3
+    assert np.asarray(idx_c).max() < 6
+
+
+# ---------------------------------------------------------------------------
+# config resolution
+# ---------------------------------------------------------------------------
+
+def test_config_resolution_and_digest(monkeypatch):
+    assert FidelityConfig.resolve(None) is None
+    assert FidelityConfig.resolve(False) is None
+    assert FidelityConfig.resolve("off") is None
+    cfg = FidelityConfig.resolve("screen")
+    assert isinstance(cfg, FidelityConfig)
+    assert FidelityConfig.resolve(True) == cfg
+    assert FidelityConfig.resolve(cfg) is cfg
+    with pytest.raises(ValueError):
+        FidelityConfig.resolve("turbo")
+    with pytest.raises(TypeError):
+        FidelityConfig.resolve(3.14)
+    # the kill switch disables even an explicit request, never enables
+    monkeypatch.setenv("PYABC_TPU_FIDELITY", "off")
+    assert FidelityConfig.resolve("screen") is None
+    assert FidelityConfig.resolve(cfg) is None
+    monkeypatch.delenv("PYABC_TPU_FIDELITY")
+    # env knobs reach from_env and the digest sees them
+    monkeypatch.setenv("PYABC_TPU_FIDELITY_Q", "0.1")
+    cfg2 = FidelityConfig.resolve("screen")
+    assert cfg2.false_reject_q == 0.1
+    assert cfg2.digest_key() != cfg.digest_key()
+    assert FidelityConfig().n_full(256) == 128
+    assert FidelityConfig.static_n_full(7, 0.5) == 4
+    assert FidelityConfig.static_n_full(8, 1e-9) == 1
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        FidelityConfig(full_fraction=0.0)
+    with pytest.raises(ValueError):
+        FidelityConfig(margin=0.5)
+    with pytest.raises(ValueError):
+        FidelityConfig(cal_rows=8, min_pairs=32)
+
+
+# ---------------------------------------------------------------------------
+# orchestrator integration (fused CPU runs, small)
+# ---------------------------------------------------------------------------
+
+def _sir_problem(n_steps=40, n_obs=8):
+    model = SIRTauLeap(n_steps=n_steps, n_obs=n_obs)
+    prior = Distribution(
+        log_beta=RV("uniform", -2.0, 3.0),
+        log_gamma=RV("uniform", -3.0, 3.0),
+    )
+    obs = model.simulate(jax.random.PRNGKey(11),
+                         jnp.log(jnp.asarray([[0.8, 0.2]])))
+    observed = {k: np.asarray(v[0]) for k, v in obs.items()}
+    return [model], [prior], pt.PNormDistance(p=2), observed
+
+
+def _lv_problem(n_steps=80, n_obs=8):
+    model = LotkaVolterraSDE(n_steps=n_steps, n_obs=n_obs)
+    prior = Distribution(
+        log_a=RV("uniform", -1.0, 2.0),
+        log_b=RV("uniform", -3.0, 2.0),
+        log_c=RV("uniform", -2.0, 2.0),
+        log_d=RV("uniform", -1.0, 2.0),
+    )
+    obs = model.simulate(jax.random.PRNGKey(7),
+                         jnp.log(jnp.asarray([[1.1, 0.4, 1.0, 0.4]])))
+    observed = {k: np.asarray(v[0]) for k, v in obs.items()}
+    return [model], [prior], pt.PNormDistance(p=2), observed
+
+
+def _run_sir(fidelity, seed=0, pop=200, gens=4, fuse=3, **kw):
+    models, priors, distance, observed = _sir_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    sampler=pt.VectorizedSampler(),
+                    fuse_generations=fuse, seed=seed,
+                    fidelity=fidelity, **kw)
+    abc.new("sqlite://", observed)
+    h = abc.run(max_nr_populations=gens)
+    return abc, h
+
+
+def test_eligibility_gating():
+    models, priors, distance, observed = _sir_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=128,
+                    sampler=pt.VectorizedSampler(), fuse_generations=3,
+                    fidelity="screen")
+    abc.new("sqlite://", observed)
+    assert abc._fidelity_eligible()
+    # off / unset -> never eligible
+    abc_off = pt.ABCSMC(models, priors, distance, population_size=128,
+                        sampler=pt.VectorizedSampler(),
+                        fuse_generations=3)
+    abc_off.new("sqlite://", observed)
+    assert abc_off.fidelity is None
+    assert not abc_off._fidelity_eligible()
+    # adaptive distances self-exclude (their refit consumes every
+    # candidate's stats; screening would bias the scale estimate)
+    abc_ad = pt.ABCSMC(models, priors, pt.AdaptivePNormDistance(p=2),
+                       population_size=128,
+                       sampler=pt.VectorizedSampler(),
+                       fuse_generations=3, fidelity="screen")
+    abc_ad.new("sqlite://", observed)
+    assert not abc_ad._fidelity_eligible()
+    # a model without a surrogate keeps the run unscreened
+    from pyabc_tpu.models import make_two_gaussians_problem
+    m2, p2, d2, o2, _ = make_two_gaussians_problem()
+    abc_nl = pt.ABCSMC(m2, p2, d2, population_size=128,
+                       sampler=pt.VectorizedSampler(),
+                       fuse_generations=3, fidelity="screen")
+    abc_nl.new("sqlite://", o2)
+    assert not abc_nl._fidelity_eligible()
+
+
+def test_low_fidelity_contract():
+    for model in (SIRTauLeap(), LotkaVolterraSDE()):
+        lo = model.low_fidelity()
+        assert lo is not None
+        assert type(lo).screen_stats_compatible
+        key = jax.random.PRNGKey(0)
+        theta = jnp.zeros((3, 4), jnp.float32)[:, :2] \
+            if isinstance(model, SIRTauLeap) \
+            else jnp.zeros((3, 4), jnp.float32)
+        full = model.simulate(key, theta)
+        cheap = lo.simulate(key, theta)
+        assert set(full) == set(cheap)
+        for k in full:
+            assert full[k].shape == cheap[k].shape, k
+
+
+def test_fidelity_off_is_bit_identical():
+    """fidelity='off' (and the env kill switch) run the exact pre-PR
+    program: populations, weights and the eps schedule match the
+    default run bit for bit."""
+    _, h_a = _run_sir(None, seed=5)
+    _, h_b = _run_sir("off", seed=5)
+    pops_a, pops_b = h_a.get_all_populations(), h_b.get_all_populations()
+    np.testing.assert_array_equal(pops_a.epsilon.to_numpy(),
+                                  pops_b.epsilon.to_numpy())
+    for t in range(4):
+        df_a, w_a = h_a.get_distribution(m=0, t=t)
+        df_b, w_b = h_b.get_distribution(m=0, t=t)
+        np.testing.assert_array_equal(df_a.to_numpy(), df_b.to_numpy())
+        np.testing.assert_array_equal(w_a, w_b)
+
+
+def test_staged_round_shares_proposal_stream():
+    """Plain and staged rounds draw IDENTICAL candidates for the same
+    key — screening only ever changes which candidates get the full
+    simulation, never which are proposed."""
+    abc, h = _run_sir("screen", seed=2, gens=3)
+    t = h.max_t
+    pop_prev = h.get_population(t - 1)
+    abc._fit_transitions(t, population=pop_prev)
+    probs = abc._model_probabilities(t - 1)
+    with np.errstate(divide="ignore"):
+        log_probs = np.log(np.maximum(probs, 1e-300)).astype(np.float32)
+    params = {"model_log_probs": jnp.asarray(log_probs),
+              "transition": abc._trans_params,
+              "distance": abc.distance_function.get_params(t),
+              "acceptor": abc.acceptor.get_params(t, abc.eps)}
+    key = jax.random.PRNGKey(123)
+    rr_plain = abc._kernel.generation_round(key, params, 256)
+    params_f = dict(params, fidelity={"tau": jnp.float32(jnp.inf)})
+    rr_staged, (plo, pfull, npass) = abc._kernel.staged_generation_round(
+        key, params_f, 256, full_fraction=0.5)
+    np.testing.assert_array_equal(np.asarray(rr_plain.theta),
+                                  np.asarray(rr_staged.theta))
+    np.testing.assert_array_equal(np.asarray(rr_plain.m),
+                                  np.asarray(rr_staged.m))
+    np.testing.assert_array_equal(np.asarray(rr_plain.valid),
+                                  np.asarray(rr_staged.valid))
+    # tau=+inf (self-disabled): every valid candidate survives the
+    # screen; full-fidelity slots cap the re-simulated subset
+    assert int(npass[0]) == int(np.asarray(rr_plain.valid).sum())
+    assert np.asarray(rr_staged.accepted).sum() <= 128
+    # pairs carry finite calibration samples only for filled slots
+    filled = np.isfinite(np.asarray(pfull))
+    assert filled.sum() == min(128, int(npass[0]))
+    assert np.isfinite(np.asarray(plo)[filled]).all()
+
+
+def test_screened_run_posterior_and_metrics():
+    """One screened fused run: sims accounting lands in the registry,
+    the screened posterior stays near the unscreened one, and every
+    generation keeps its full population."""
+    from pyabc_tpu.telemetry import metrics as _m
+    _m.REGISTRY.reset()
+    _, h_off = _run_sir(None, seed=0)
+    mu_off = _posterior_mean(h_off)
+    _m.REGISTRY.reset()
+    abc, h = _run_sir("screen", seed=0)
+    d = _m.REGISTRY.to_dict()
+    assert d["abc_sims_low_total"] > 0
+    assert d["abc_sims_full_total"] > 0
+    assert d["abc_sims_full_total"] <= d["abc_sims_low_total"]
+    counts = h.get_nr_particles_per_population()
+    assert all(counts[t] == 200 for t in range(4))
+    mu = _posterior_mean(h)
+    assert np.all(np.abs(mu - mu_off) < 0.6), (mu, mu_off)
+
+
+def _posterior_mean(h, m=0):
+    df, w = h.get_distribution(m=m)
+    return (df.to_numpy() * np.asarray(w)[:, None]).sum(axis=0)
+
+
+# ---------------------------------------------------------------------------
+# resilience: kill -9 mid-calibration (site "fidelity.calibrate")
+# ---------------------------------------------------------------------------
+
+_CHILD = """
+import sys
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pyabc_tpu as pt
+from pyabc_tpu.models.sir import SIRTauLeap
+from pyabc_tpu.random_variables import RV, Distribution
+
+model = SIRTauLeap(n_steps=40, n_obs=8)
+prior = Distribution(log_beta=RV("uniform", -2.0, 3.0),
+                     log_gamma=RV("uniform", -3.0, 3.0))
+obs = model.simulate(jax.random.PRNGKey(11),
+                     jnp.log(jnp.asarray([[0.8, 0.2]])))
+observed = {k: np.asarray(v[0]) for k, v in obs.items()}
+abc = pt.ABCSMC([model], [prior], pt.PNormDistance(p=2),
+                population_size=128, sampler=pt.VectorizedSampler(),
+                fuse_generations=2, seed=11, fidelity="screen",
+                history_mode="eager")
+abc.new(sys.argv[1], observed)
+abc.run(max_nr_populations=5)
+sys.exit(0)
+"""
+
+
+def test_calibrate_kill9_recovers_with_screening_self_disabled(tmp_path):
+    """kill -9 at the second visit of the ``fidelity.calibrate`` fault
+    site — i.e. while seeding the THIRD fused block's calibration
+    rings (generation 0 runs sequentially, so blocks seed at t=1 and
+    t=3), after the first block's generations are durable.  The
+    recovery process loads the DB, finds the completed generations
+    intact (zero lost), and reruns the remainder: its fresh carry has
+    NaN rings, so its first screened generation self-disables by
+    construction — the recovery boundary docs/fidelity.md pins."""
+    db = tmp_path / "fid_chaos.db"
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO,
+               PYABC_TPU_FAULTS="fidelity.calibrate@2:sigkill",
+               PYABC_TPU_FAULT_SEED="0")
+    proc = subprocess.run(
+        [sys.executable, str(script), "sqlite:///" + str(db)], env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == -9, (
+        f"expected SIGKILL death, got rc={proc.returncode}: "
+        f"{proc.stderr[-2000:]}")
+
+    models, priors, distance, observed = _sir_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=128,
+                    sampler=pt.VectorizedSampler(), fuse_generations=2,
+                    seed=12, fidelity="screen", history_mode="eager")
+    abc.load("sqlite:///" + str(db))
+    done = abc.history.max_t + 1
+    # the kill fired BETWEEN blocks: every generation the dead process
+    # had harvested (t = 0..2) is durable, none lost
+    assert done == 3, f"lost generations: only {done} durable"
+    # fresh carry -> NaN rings -> the next screened generation's
+    # threshold is +inf (self-disabled), exactly the reseed branch
+    lo, full = abc._fidelity_nan_seed(abc.fidelity.cal_rows)
+    assert float(screen_threshold(
+        lo, full, jnp.float32(1.0),
+        q=abc.fidelity.false_reject_q, margin=abc.fidelity.margin,
+        min_corr=abc.fidelity.min_corr,
+        min_pairs=abc.fidelity.min_pairs)) == np.inf
+    h = abc.run(max_nr_populations=5 - done)
+    counts = h.get_nr_particles_per_population()
+    assert sorted(t for t in counts.index if t >= 0) == [0, 1, 2, 3, 4]
+    assert all(counts[t] == 128 for t in range(5))
+    eps = h.get_all_populations()
+    eps = eps[eps.t >= 0].epsilon.to_numpy()
+    assert np.all(np.diff(eps) < 0)
+    abc.history.close()
+
+
+# ---------------------------------------------------------------------------
+# 4-seed posterior gates (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("problem", ["sir", "lv"])
+def test_four_seed_posterior_gate(problem):
+    """Across 4 seeds, the screened posterior mean must track the
+    unscreened one within Monte-Carlo noise on both benchmark models —
+    the 'gate-identical accepted posterior' claim of the conservative
+    calibration defaults."""
+    make = _sir_problem if problem == "sir" else _lv_problem
+    diffs = []
+    for seed in range(4):
+        models, priors, distance, observed = make()
+        mus = {}
+        for fid in (None, "screen"):
+            abc = pt.ABCSMC(models, priors, distance,
+                            population_size=256,
+                            sampler=pt.VectorizedSampler(),
+                            fuse_generations=3, seed=seed,
+                            fidelity=fid)
+            abc.new("sqlite://", observed)
+            h = abc.run(max_nr_populations=5)
+            mus[fid] = _posterior_mean(h)
+        diffs.append(np.abs(mus[None] - mus["screen"]))
+    # per-seed runs stay close; the seed-averaged posterior means agree
+    # tightly (systematic bias would survive averaging, MC noise not)
+    assert np.all(np.mean(diffs, axis=0) < 0.35), np.mean(diffs, axis=0)
+    assert np.all(np.max(diffs, axis=0) < 0.8), np.max(diffs, axis=0)

@@ -129,9 +129,11 @@ def test_lanes_bit_identical_populations_and_counters():
 
 def test_telemetry_egress_is_labeled_and_tiny():
     """Satellite of the PR-2 egress invariant: the lane drain books its
-    bytes under the ``telemetry`` subsystem (24 B/generation — one i32
-    + five f32), and every d2h byte the ledger counts during the run is
-    still attributed to exactly one subsystem."""
+    bytes under the ``telemetry`` subsystem (28 B/generation — one i32
+    + six f32, the ``screen`` phase row included even for unscreened
+    programs so the lane layout is mode-independent), and every d2h
+    byte the ledger counts during the run is still attributed to
+    exactly one subsystem."""
     base = transfer.egress_breakdown()
     total0 = REGISTRY.to_dict().get("wire_d2h_bytes_total", 0)
     abc = _abc(pop=200, batch=2048)
@@ -142,7 +144,7 @@ def test_telemetry_egress_is_labeled_and_tiny():
     gens = len([r for r in abc.timeline.to_rows()
                 if r["path"] == "onedispatch"])
     assert gens == 3
-    assert delta["telemetry"] == 24 * gens
+    assert delta["telemetry"] == 28 * gens
     assert delta["population"] > 0
     assert total - total0 > 0
     assert sum(delta.values()) == total - total0
@@ -347,10 +349,10 @@ def test_flight_dump_embeds_progress_word(tmp_path):
 
 def test_attribute_phases_normalizes_onto_wall():
     out = lanes.attribute_phases(
-        np.array([1.0, 1.0, 0.0, 0.0, 2.0], dtype=np.float32), 4.0)
-    assert out == {"simulate": 1.0, "distance": 1.0, "eps_solve": 0.0,
-                   "refit": 0.0, "resample": 2.0}
-    zero = lanes.attribute_phases(np.zeros(5, dtype=np.float32), 2.0)
+        np.array([1.0, 1.0, 0.0, 0.0, 0.0, 2.0], dtype=np.float32), 4.0)
+    assert out == {"simulate": 1.0, "distance": 1.0, "screen": 0.0,
+                   "eps_solve": 0.0, "refit": 0.0, "resample": 2.0}
+    zero = lanes.attribute_phases(np.zeros(6, dtype=np.float32), 2.0)
     assert zero["simulate"] == 2.0
     assert sum(zero.values()) == 2.0
 

@@ -52,13 +52,14 @@ def test_repo_tree_is_clean():
 
 
 def test_ten_rules_registered():
-    assert len(ALL_RULES) == 16
+    assert len(ALL_RULES) == 17
     assert set(ALL_RULES) == {
         "wire-chokepoint", "no-inline-jit", "retry-sites",
         "fused-eligibility", "span-pairs", "fault-sites",
         "host-sync", "lock-discipline", "prng-keys", "env-drift",
         "sort-discipline", "precision-policy", "collective-discipline",
-        "study-isolation", "claim-discipline", "event-discipline"}
+        "study-isolation", "claim-discipline", "event-discipline",
+        "fidelity-discipline"}
 
 
 # ---------------------------------------------------------------------------

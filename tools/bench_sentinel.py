@@ -154,6 +154,18 @@ WATCHED = (
     # program: ≥3 consecutive admit/retire cycles with ANY new XLA
     # compile is a broken program-pool key — ZERO tolerance
     ("serve_cb_recompiles", "zero", 0.0),
+    # multi-fidelity cascade (bench_fidelity, pyabc_tpu/fidelity/):
+    # screened accepted/s on the simulation-bound SIR row fails LOW —
+    # a drop means the screen stopped carrying the row (calibrator
+    # self-disabling in steady state, eligibility silently lost, or
+    # the low-fidelity path billing full-cost sims)
+    ("fidelity_accepted_per_s", "higher", 0.15),
+    # ... and the statistical debt is a CONTRACT, not a trajectory:
+    # the realized false-reject rate on the paired-sample audit must
+    # stay under an absolute ceiling (the calibrator targets q=0.02;
+    # 0.05 absorbs audit-sample noise) — a regressed median must not
+    # launder a biased screen
+    ("fidelity_false_reject_rate", "ceiling", 0.05),
     ("telemetry_compile_s_per_gen", "lower", 0.50),
     # steady-state population egress (wire/store.py lazy History):
     # lower is better — a jump back toward full-population d2h means

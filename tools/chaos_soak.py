@@ -118,6 +118,9 @@ _RAISE_BY_SITE = {
     "store.hydrate": "OSError",
     "history.materialize": "OperationalError",
     "journal.write": "OSError",
+    "run.drain": "OSError",
+    "serve.window": "OSError",
+    "fidelity.calibrate": "OSError",
 }
 
 
@@ -433,6 +436,126 @@ def run_trial(trial: Trial, workdir: str, seed: int = 0) -> dict:
         check_bit_identity(db, clean_run_db(workdir, evict=trial.evict),
                            trial.plan)
     return report
+
+
+# -------------------------------------------------------- fidelity suite
+#
+# The generic matrix above runs the two-gaussians child, which ships no
+# low-fidelity surrogate — a randomized ``fidelity.calibrate`` row there
+# degrades (must_fire=False) to a clean-run trial.  This suite is the
+# real thing: a screen-eligible SIR child killed -9 mid-calibration,
+# with the recovery contract docs/fidelity.md pins (zero lost
+# generations; the resumed process reseeds NaN rings, so its first
+# screened generation self-disables).  The tier-1 twin lives in
+# tests/test_fidelity.py; this entry point exists for soak runs.
+
+FID_POP = 128
+FID_GENS = 5
+
+_FID_CHILD = """
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pyabc_tpu as pt
+from pyabc_tpu.models.sir import SIRTauLeap
+from pyabc_tpu.random_variables import RV, Distribution
+
+model = SIRTauLeap(n_steps=40, n_obs=8)
+prior = Distribution(log_beta=RV("uniform", -2.0, 3.0),
+                     log_gamma=RV("uniform", -3.0, 3.0))
+obs = model.simulate(jax.random.PRNGKey(11),
+                     jnp.log(jnp.asarray([[0.8, 0.2]])))
+observed = {k: np.asarray(v[0]) for k, v in obs.items()}
+abc = pt.ABCSMC([model], [prior], pt.PNormDistance(p=2),
+                population_size=%(pop)d,
+                sampler=pt.VectorizedSampler(), fuse_generations=2,
+                seed=%(seed)d, fidelity="screen", history_mode="eager")
+abc.new(sys.argv[1], observed)
+abc.run(max_nr_populations=%(gens)d)
+sys.exit(0)
+"""
+
+
+def run_fidelity_trial(workdir: str, seed: int = 0) -> dict:
+    """kill -9 the screened SIR child at the second visit of the
+    ``fidelity.calibrate`` site (the second fused block's ring seeding,
+    t=3 with fuse=2 — generation 0 runs sequentially, so blocks seed
+    at t=1 and t=3), then recover and check the cascade's restart
+    semantics end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.fidelity import screen_threshold
+    from pyabc_tpu.models.sir import SIRTauLeap
+    from pyabc_tpu.random_variables import RV, Distribution
+
+    plan = "fidelity.calibrate@2:sigkill"
+    db = os.path.join(workdir, "fidelity_calibrate.db")
+    script = os.path.join(workdir, "fidelity_child.py")
+    with open(script, "w") as f:
+        f.write(_FID_CHILD % {"pop": FID_POP, "seed": SEED,
+                              "gens": FID_GENS})
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO,
+               PYABC_TPU_FAULTS=plan, PYABC_TPU_FAULT_SEED=str(seed))
+    proc = subprocess.run(
+        [sys.executable, script, "sqlite:///" + db], env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == -9, (
+        f"expected SIGKILL death, got rc={proc.returncode}: "
+        f"{proc.stderr[-2000:]}")
+    report = {"plan": plan, "kind": "subproc", "outcome": "rc=-9",
+              "recovered": True}
+
+    model = SIRTauLeap(n_steps=40, n_obs=8)
+    prior = Distribution(log_beta=RV("uniform", -2.0, 3.0),
+                         log_gamma=RV("uniform", -3.0, 3.0))
+    obs = model.simulate(jax.random.PRNGKey(11),
+                         jnp.log(jnp.asarray([[0.8, 0.2]])))
+    observed = {k: np.asarray(v[0]) for k, v in obs.items()}
+    abc = pt.ABCSMC([model], [prior], pt.PNormDistance(p=2),
+                    population_size=FID_POP,
+                    sampler=pt.VectorizedSampler(), fuse_generations=2,
+                    seed=RECOVER_SEED, fidelity="screen",
+                    history_mode="eager")
+    abc.load("sqlite:///" + db)
+    done = abc.history.max_t + 1
+    assert done == 3, f"lost generations: only {done} durable"
+    # fresh carry -> NaN rings -> the resumed process's first screened
+    # generation self-disables (threshold +inf) by construction
+    lo, full = abc._fidelity_nan_seed(abc.fidelity.cal_rows)
+    tau = float(screen_threshold(
+        lo, full, jnp.float32(1.0), q=abc.fidelity.false_reject_q,
+        margin=abc.fidelity.margin, min_corr=abc.fidelity.min_corr,
+        min_pairs=abc.fidelity.min_pairs))
+    assert tau == float("inf"), (
+        f"restart must self-disable screening, got tau={tau}")
+    h = abc.run(max_nr_populations=FID_GENS - done)
+    counts = h.get_nr_particles_per_population()
+    assert sorted(t for t in counts.index if t >= 0) == list(
+        range(FID_GENS)), f"generation set broken: {counts}"
+    assert all(counts[t] == FID_POP for t in range(FID_GENS)), (
+        f"short population after recovery: {counts}")
+    eps = h.get_all_populations()
+    eps = eps[eps.t >= 0].epsilon.to_numpy()
+    assert np.all(np.diff(eps) < 0), f"epsilon not decreasing: {eps}"
+    abc.history.close()
+    return report
+
+
+def fidelity_soak(workdir=None, seed: int = 0, verbose: bool = True):
+    """Run the fidelity chaos trial; returns the report dicts."""
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="chaos_fid_")
+    if verbose:
+        print("[fidelity 1/1] fidelity.calibrate@2:sigkill (subproc)",
+              flush=True)
+    reports = [run_fidelity_trial(workdir, seed=seed)]
+    if verbose:
+        print(f"    -> {reports[0]['outcome']} (recovered)", flush=True)
+    return reports
 
 
 # ------------------------------------------------------- scheduler suite
@@ -1122,7 +1245,23 @@ def main(argv=None) -> int:
                          " resume-not-restart, partitioned host, poison"
                          " quarantine) instead of the store/journal "
                          "matrix")
+    ap.add_argument("--fidelity", action="store_true",
+                    help="run the fidelity chaos trial (screen-eligible"
+                         " SIR child killed -9 mid-calibration; resume"
+                         " self-disables screening, zero lost "
+                         "generations) instead of the store/journal "
+                         "matrix")
     args = ap.parse_args(argv)
+
+    if args.fidelity:
+        try:
+            reports = fidelity_soak(workdir=args.workdir,
+                                    seed=args.seed)
+        except AssertionError as err:
+            print(f"FIDELITY CHAOS SOAK FAILED: {err}", file=sys.stderr)
+            return 1
+        print(f"fidelity chaos soak: {len(reports)} trial(s) passed")
+        return 0
 
     if args.sched:
         try:

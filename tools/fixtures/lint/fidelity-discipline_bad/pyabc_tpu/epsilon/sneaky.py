@@ -1,0 +1,9 @@
+"""Planted violation: a second calibrator — low/full distance
+comparison outside pyabc_tpu/fidelity/ and the fused scan builder."""
+
+from ..fidelity import screen_threshold
+
+
+def my_own_threshold(cal_lo, cal_full, eps):
+    return screen_threshold(cal_lo, cal_full, eps, q=0.5, margin=1.0,
+                            min_corr=0.0, min_pairs=1)

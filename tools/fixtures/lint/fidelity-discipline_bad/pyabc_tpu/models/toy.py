@@ -1,0 +1,11 @@
+"""Planted violation: ships a surrogate without declaring the
+summary-stat contract the eligibility gate trusts."""
+
+
+class ToyModel:
+
+    def simulate(self, key, theta):
+        return {"x": theta}
+
+    def low_fidelity(self):
+        return ToyModel()

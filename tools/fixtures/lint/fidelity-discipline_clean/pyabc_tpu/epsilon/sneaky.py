@@ -1,0 +1,9 @@
+"""Clean twin: the out-of-band call is justified inline (debug
+tooling replaying the calibrator's own function is legitimate)."""
+
+from ..fidelity import screen_threshold
+
+
+def replay_threshold(cal_lo, cal_full, eps):
+    return screen_threshold(cal_lo, cal_full, eps, q=0.5, margin=1.0,  # graftlint: allow(fidelity-discipline)
+                            min_corr=0.0, min_pairs=1)

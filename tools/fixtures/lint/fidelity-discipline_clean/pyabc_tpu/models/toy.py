@@ -1,0 +1,12 @@
+"""Clean twin: the surrogate shipper declares its stat contract."""
+
+
+class ToyModel:
+
+    screen_stats_compatible = True
+
+    def simulate(self, key, theta):
+        return {"x": theta}
+
+    def low_fidelity(self):
+        return ToyModel()

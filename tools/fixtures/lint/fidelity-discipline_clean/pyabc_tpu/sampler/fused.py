@@ -1,0 +1,10 @@
+"""Clean twin: the allowlisted caller — the fused scan builder
+computes tau once per generation from the carried rings."""
+
+from ..fidelity import screen_threshold
+
+
+def one_gen(carry, eps_t):
+    return screen_threshold(carry["cal_lo"], carry["cal_full"], eps_t,
+                            q=0.02, margin=1.25, min_corr=0.2,
+                            min_pairs=32)

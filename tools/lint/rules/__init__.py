@@ -1,5 +1,5 @@
 """graftlint rule modules — importing this package registers all
-sixteen rules with :data:`tools.lint.core.RULES` (registration order
+seventeen rules with :data:`tools.lint.core.RULES` (registration order
 is the default run order: the six ported gates first, then the new
 analyzers)."""
 
@@ -19,3 +19,4 @@ from . import collective_discipline  # noqa: F401
 from . import study_isolation    # noqa: F401
 from . import claim_discipline   # noqa: F401
 from . import event_discipline   # noqa: F401
+from . import fidelity_discipline  # noqa: F401

@@ -78,6 +78,13 @@ MANIFEST = {
     # and in-flight lanes bounce whole via the scheduler's lease requeue
     "SITE_SERVE_WINDOW": ("serve/worker.py",
                           ("SITE_SERVE_WINDOW", "_cb_publish_lane(")),
+    # the fidelity calibrator's boundary: calibration rings are carry
+    # state seeded BETWEEN durable generations, and a restart that
+    # finds no ring reseeds NaN rings — the first screened generation
+    # then self-disables (threshold +inf), so a kill here loses nothing
+    "SITE_FIDELITY_CALIBRATE": ("smc.py",
+                                ("SITE_FIDELITY_CALIBRATE",
+                                 "_fidelity_nan_seed")),
 }
 
 _CONST_RE = re.compile(r'^(SITE_[A-Z_]+)\s*=\s*"([^"]+)"', re.M)

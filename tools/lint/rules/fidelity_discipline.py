@@ -1,0 +1,191 @@
+"""Rule ``fidelity-discipline``: the multi-fidelity cascade's
+statistical contract stays enforced by structure, not convention.
+
+The early-reject cascade (pyabc_tpu/fidelity/, docs/fidelity.md) is
+only unbiased because two invariants hold:
+
+1. **Declared compatibility** — a model that ships a ``low_fidelity()``
+   surrogate promises the surrogate emits the SAME summary-stat layout
+   (``screen_stats_compatible = True``); the orchestrator's
+   ``_fidelity_eligible`` gate trusts that flag.  A model file that
+   defines ``def low_fidelity(`` without declaring the flag ships a
+   surrogate the eligibility check silently ignores — or worse, a
+   later edit flips the default and an incompatible surrogate screens.
+2. **One calibrator** — the screen threshold is derived from paired
+   (low, full) distances in exactly one place
+   (``fidelity/calibrate.py:screen_threshold``), consumed by the fused
+   scan builder, and delivered to the round kernel as data
+   (``params["fidelity"]["tau"]``).  A second call site comparing low
+   against full distances outside the calibrator would fork the
+   false-reject accounting the conservative quantile bound pins.
+
+Checks:
+
+- every file under ``pyabc_tpu/`` (except the ``Model`` base class
+  file, which declares the default) whose source defines
+  ``def low_fidelity(`` also sets ``screen_stats_compatible = True``;
+- ``screen_threshold(`` is called only inside ``pyabc_tpu/fidelity/``
+  and the fused scan builder (``CALLER_ALLOWLIST``) — numpy mirror
+  included;
+- the round kernel (``sampler/rounds.py``) consumes the threshold as
+  ``params["fidelity"]`` and never imports the calibrator;
+- ``ABCSMC._fidelity_eligible`` still consults the
+  ``device_screen_ok`` capability flags and the models'
+  ``screen_stats_compatible`` declaration (drift guard, same shape as
+  the ``fused-eligibility`` rule).
+
+Suppression: ``# graftlint: allow(fidelity-discipline)`` on the
+offending line (file-level findings are not suppressible — fix the
+manifest instead).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from ..core import Finding, Rule, default_package_root, register
+
+#: files OUTSIDE pyabc_tpu/fidelity/ allowed to call screen_threshold(
+#: — the fused scan builder computes tau once per generation inside
+#: the scan; everyone else receives it as data
+CALLER_ALLOWLIST = {"sampler/fused.py"}
+
+#: the Model base class file: declares the flag's default (False) and
+#: the low_fidelity() -> None default, so it is exempt from check 1
+BASE_MODEL_FILE = "model.py"
+
+ROUNDS_FILE = "sampler/rounds.py"
+SMC_FILE = "smc.py"
+ELIGIBLE_FN = "_fidelity_eligible"
+SUPPRESS = "# graftlint: allow(fidelity-discipline)"
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def _function_segment(text: str, name: str):
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return None, 0
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name):
+            lines = text.splitlines()
+            seg = "\n".join(lines[node.lineno - 1:node.end_lineno])
+            return seg, node.lineno
+    return None, 0
+
+
+def _py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                yield os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def check(root: str = None) -> list:
+    """Returns ``[(relpath, lineno, message), ...]`` violations
+    (empty = clean).  Files absent from ``root`` are skipped so
+    planted-tree tests can cover subsets."""
+    root = _package_root(root)
+    violations = []
+    for rel in _py_files(root):
+        path = os.path.join(root, rel.replace("/", os.sep))
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        # 1. declared compatibility at every surrogate shipper
+        if rel != BASE_MODEL_FILE and "def low_fidelity(" in text:
+            if "screen_stats_compatible = True" not in text:
+                lineno = next(
+                    (i for i, ln in enumerate(text.splitlines(), 1)
+                     if "def low_fidelity(" in ln), 0)
+                violations.append((
+                    rel, lineno,
+                    "defines low_fidelity() without declaring "
+                    "'screen_stats_compatible = True' — the surrogate "
+                    "is invisible to _fidelity_eligible (or screens "
+                    "with an undeclared stat layout)"))
+        # 2. one calibrator: screen_threshold call sites
+        if rel.startswith("fidelity/"):
+            continue
+        for i, line in enumerate(text.splitlines(), 1):
+            if "screen_threshold(" not in line or SUPPRESS in line:
+                continue
+            if line.lstrip().startswith("#"):
+                continue
+            if rel not in CALLER_ALLOWLIST:
+                violations.append((
+                    rel, i,
+                    "calls screen_threshold() outside the fidelity "
+                    "calibrator and the fused scan builder — low/full "
+                    "distance comparison must stay in one place"))
+    # 3. the round kernel consumes tau as data
+    rounds_path = os.path.join(root, ROUNDS_FILE.replace("/", os.sep))
+    if os.path.exists(rounds_path):
+        with open(rounds_path, encoding="utf-8") as f:
+            text = f.read()
+        if "staged_generation_round" in text:
+            if 'params["fidelity"]' not in text:
+                violations.append((
+                    ROUNDS_FILE, 0,
+                    "staged round no longer reads the threshold from "
+                    "params['fidelity'] — tau must arrive as data from "
+                    "the scan's calibrator"))
+            if "screen_threshold(" in text:
+                violations.append((
+                    ROUNDS_FILE, 0,
+                    "round kernel calls screen_threshold — the "
+                    "calibrator runs in the scan builder, not per "
+                    "round"))
+    # 4. eligibility drift guard
+    smc_path = os.path.join(root, SMC_FILE)
+    if os.path.exists(smc_path):
+        with open(smc_path, encoding="utf-8") as f:
+            text = f.read()
+        seg, lineno = _function_segment(text, ELIGIBLE_FN)
+        if seg is None:
+            violations.append((SMC_FILE, 0,
+                               f"{ELIGIBLE_FN}() not found"))
+        else:
+            for marker in ("device_screen_ok", "screen_stats_compatible",
+                           "low_fidelity"):
+                if marker not in seg:
+                    violations.append((
+                        SMC_FILE, lineno,
+                        f"{ELIGIBLE_FN}() no longer consults "
+                        f"{marker!r}"))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("fidelity discipline: clean (surrogates declare their "
+              "stat contract; one calibrator; tau travels as data)")
+        return 0
+    print("fidelity-discipline violations:")
+    for rel, lineno, msg in violations:
+        loc = f"pyabc_tpu/{rel}" + (f":{lineno}" if lineno else "")
+        print(f"  {loc}: {msg}")
+    return 1
+
+
+@register
+class FidelityDisciplineRule(Rule):
+    id = "fidelity-discipline"
+    description = ("low-fidelity surrogates declare their stat "
+                   "contract; the screen threshold has one calibrator "
+                   "and travels as data")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, msg)
+                for rel, lineno, msg in check(tree.package_root)]
