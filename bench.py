@@ -72,6 +72,11 @@ is one-off).
   sharded_cpu8; ``podstar_pop1e7_population`` records the measured
   population); ``dispatches_per_run`` must read 1 PER HOST with the
   stop chain resolving on-fabric
+- ``podstar_pop1e8_*``     — the HBM-ladder pod row (docs/performance.md
+  "The HBM ladder"): the same rig under a DISCRIMINATING budget the
+  unplanned f32 run provably cannot fit (``capacity_violations`` pins
+  the CapacityError + compressed-plan contract at 0) plus the
+  predicted-vs-measured peak slope pin (``peak_err_pct`` <= 15)
 
 Every row times its generations individually (5-8 on the headline
 primary/north-star rows, 3 elsewhere) and reports the MEDIAN, with the
@@ -1156,7 +1161,8 @@ SUB_BENCHES = ("kde_1e6", "northstar", "fused_northstar", "onedispatch",
                "posterior_gate",
                "lotka_volterra", "sir", "fidelity", "petab_ode",
                "sharded_mesh1",
-               "ab_vec_sharded", "sharded_cpu8", "podstar")
+               "ab_vec_sharded", "sharded_cpu8", "podstar",
+               "podstar_pop1e8")
 
 
 def bench_ab_vec_vs_sharded():
@@ -1409,6 +1415,237 @@ def bench_podstar():
     }
 
 
+#: nominal target of the HBM-ladder pod row (the CPU rig underneath
+#: measures a scaled population, exactly like podstar_pop1e7)
+PODSTAR_POP1E8_NOMINAL = 100_000_000
+
+PODSTAR_LADDER_PROGRAM = """
+import json, os, time
+
+import jax
+os.environ["PYABC_TPU_CARRY_PRECISION"] = "auto"
+import pyabc_tpu as pt
+from pyabc_tpu.autotune import compile_counters, compile_delta
+from pyabc_tpu.capacity import CapacityError
+from pyabc_tpu.capacity import model as _cap
+from pyabc_tpu.models import make_sir_problem
+
+pop = int(os.environ["PODSTAR_POP"])
+gens = int(os.environ["PODSTAR_GENS"])
+models, priors, distance, observed = make_sir_problem()
+abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                eps=pt.MedianEpsilon(),
+                run_mode="onedispatch", history_mode="lazy",
+                fuse_generations=4, stores_sum_stats=False, seed=0)
+abc.new("sqlite:///" + os.environ["POD_DB"], observed)
+
+# The discriminating budget: strictly below the cheapest f32 geometry,
+# at or above the cheapest bf16 one -- an UNPLANNED f32 run provably
+# cannot fit this budget at ANY (batch, K, max_T), while the planned
+# compressed run can.  Every host derives the same value from the same
+# deterministic inputs, so the pod stays in SPMD lockstep.
+samp = abc.sampler
+B = samp.choose_batch(pop)
+kw = abc._capacity_kwargs("onedispatch", pop, B)
+shape = dict(batch=B, K=4, max_T=abc.onedispatch_max_t,
+             round_to_batch=getattr(samp, "_round_to_valid_batch", None))
+os.environ["PYABC_TPU_HBM_BUDGET"] = "1"
+mins = {}
+for prec in ("f32", "bf16"):
+    try:
+        _cap.plan(carry_precision=prec, **shape, **kw)
+        mins[prec] = 0   # fits a 1-byte budget: arithmetic is broken
+    except CapacityError as err:
+        mins[prec] = int(err.predicted)
+budget = (mins["f32"] + mins["bf16"]) // 2
+os.environ["PYABC_TPU_HBM_BUDGET"] = str(budget)
+f32_infeasible = False
+try:
+    _cap.plan(carry_precision="f32", **shape, **kw)
+except CapacityError:
+    f32_infeasible = True
+
+cc0 = compile_counters()
+t0 = time.perf_counter()
+abc.run(max_nr_populations=1 + gens)
+wall = time.perf_counter() - t0
+cc = compile_delta(cc0)
+od_gens = sum(1 for r in abc.timeline.to_rows()
+              if r.get("path") == "onedispatch")
+cap = abc.timeline.capacity or {}
+with open(os.environ["CLUSTER_TEST_OUT"], "w") as f:
+    json.dump({"process_index": jax.process_index(),
+               "process_count": jax.process_count(),
+               "n_devices": len(jax.devices()),
+               "dispatches": int(abc.run_dispatches),
+               "stop": abc.timeline.stop_reason,
+               "generations": od_gens,
+               "wall_s": wall,
+               "compile_s": cc["compile_s"],
+               "budget_bytes": budget,
+               "f32_infeasible": f32_infeasible,
+               "carry_precision": cap.get("precision"),
+               "plan_note": cap.get("note"),
+               "predicted_bytes": int(cap.get("predicted_bytes") or 0),
+               "measured_bytes": int(cap.get("measured_bytes") or 0)}, f)
+"""
+
+PODSTAR_PROBE_PROGRAM = """
+import json, os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PYABC_TPU_CAPACITY_MEASURE"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import pyabc_tpu as pt
+from pyabc_tpu.models import make_sir_problem
+
+rows = []
+for pop in json.loads(os.environ["PROBE_POPS"]):
+    models, priors, distance, observed = make_sir_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    eps=pt.MedianEpsilon(),
+                    run_mode="onedispatch", history_mode="lazy",
+                    fuse_generations=4, stores_sum_stats=False, seed=0)
+    abc.new("sqlite://", observed)
+    abc.run(max_nr_populations=2)
+    cap = abc.timeline.capacity or {}
+    rows.append({"pop": pop,
+                 "predicted_bytes": int(cap.get("predicted_bytes") or 0),
+                 "measured_bytes": int(cap.get("measured_bytes") or 0)})
+with open(os.environ["PROBE_OUT"], "w") as f:
+    json.dump(rows, f)
+"""
+
+
+def bench_podstar_pop1e8():
+    """The HBM-ladder pod row — the pop-1e8 one-dispatch deployment
+    (docs/performance.md "The HBM ladder"), exercised end-to-end on the
+    same 2-process CPU-federated rig as ``bench_podstar``:
+
+    - every worker computes the DISCRIMINATING budget (below the
+      cheapest f32 plan, above the cheapest bf16 one), proves the
+      unplanned f32 run cannot fit it (``CapacityError`` at every
+      geometry), then completes the run under the planned compressed
+      carry — ``podstar_pop1e8_capacity_violations`` must be 0;
+    - the capacity model's prediction is pinned against XLA's own
+      ``memory_analysis()`` on a single-process two-population probe:
+      ``podstar_pop1e8_peak_err_pct`` is the error of the
+      population-PROPORTIONAL slope (footprint delta between the two
+      pops), which differences away the backend's fixed temp overhead
+      the per-device HBM model never claimed to count — the sentinel
+      holds it under an absolute 15 % ceiling;
+    - ``podstar_pop1e8_measured_peak_mb`` fails high on trajectory so
+      compressed-carry footprint regressions surface.
+
+    The key prefix carries the config's nominal target (pop 1e8);
+    ``podstar_pop1e8_population`` records the scaled stand-in actually
+    measured (``PODSTAR_POP1E8`` env to override; a real TPU slice
+    runs the nominal population with the same worker program)."""
+    import socket
+    import subprocess
+    import tempfile
+
+    pop = int(os.environ.get("PODSTAR_POP1E8", "16384"))
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    # --- single-process probe: the predicted-vs-measured slope pin ---
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "probe_prog.py")
+        with open(script, "w") as f:
+            f.write(PODSTAR_PROBE_PROGRAM)
+        probe_out = os.path.join(td, "probe_out.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here,
+                   PROBE_POPS=json.dumps([pop // 4, pop]),
+                   PROBE_OUT=probe_out)
+        proc = subprocess.run([sys.executable, script], env=env,
+                              capture_output=True, timeout=900)
+        if proc.returncode != 0:
+            raise RuntimeError("pop1e8 probe failed: "
+                               f"{proc.stderr.decode()[-500:]}")
+        with open(probe_out) as f:
+            probe = json.load(f)
+    d_pred = probe[1]["predicted_bytes"] - probe[0]["predicted_bytes"]
+    d_meas = probe[1]["measured_bytes"] - probe[0]["measured_bytes"]
+    err_pct = (abs(d_pred - d_meas) / d_meas * 100.0
+               if d_meas > 0 else None)
+
+    # --- the 2-process pod run under the discriminating budget ---
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "ladder_prog.py")
+        with open(script, "w") as f:
+            f.write(PODSTAR_LADDER_PROGRAM)
+        procs, outs = [], []
+        for i in range(PODSTAR_HOSTS):
+            out = os.path.join(td, f"ladder_out_{i}.json")
+            outs.append(out)
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS="cpu",
+                PYTHONPATH=here,
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PODSTAR_POP=str(pop),
+                PODSTAR_GENS=str(PODSTAR_GENS),
+                POD_DB=os.path.join(td, f"ladder_h{i}.db"),
+                CLUSTER_TEST_OUT=out,
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "pyabc_tpu.parallel.cli",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--num-processes", str(PODSTAR_HOSTS),
+                 "--process-id", str(i), script],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        errs = [p.communicate(timeout=1500)[1] for p in procs]
+        for p, se in zip(procs, errs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"pop1e8 worker failed: {se.decode()[-500:]}")
+        infos = []
+        for out in outs:
+            with open(out) as f:
+                infos.append(json.load(f))
+
+    gens = infos[0]["generations"]
+    steady = max(max(i["wall_s"] - i["compile_s"], 0.0) for i in infos)
+    spg = steady / gens if gens else None
+    lead = infos[0]
+    # the acceptance contract, as one counter the sentinel pins at 0:
+    # the unplanned f32 run must be provably infeasible on EVERY host,
+    # the planned run must have resolved to a compressed carry, and the
+    # plan must actually sit under the budget it claimed to fit
+    violations = (
+        sum(1 for i in infos if not i["f32_infeasible"])
+        + sum(1 for i in infos if i["carry_precision"]
+              in (None, "f32"))
+        + sum(1 for i in infos
+              if i["predicted_bytes"] > i["budget_bytes"]))
+    return {
+        "podstar_pop1e8_population": pop,
+        "podstar_pop1e8_dispatches_per_run": max(
+            i["dispatches"] for i in infos),
+        "podstar_pop1e8_s_per_gen": (None if spg is None
+                                     else round(spg, 2)),
+        "podstar_pop1e8_accepted_per_s": (
+            None if not spg else round(pop * gens / steady, 1)),
+        "podstar_pop1e8_carry_precision": lead["carry_precision"],
+        "podstar_pop1e8_plan_note": lead["plan_note"],
+        "podstar_pop1e8_budget_mb": round(
+            lead["budget_bytes"] / 2**20, 3),
+        "podstar_pop1e8_predicted_peak_mb": round(
+            lead["predicted_bytes"] / 2**20, 3),
+        "podstar_pop1e8_measured_peak_mb": round(
+            lead["measured_bytes"] / 2**20, 3),
+        "podstar_pop1e8_capacity_violations": violations,
+        "podstar_pop1e8_peak_err_pct": (
+            None if err_pct is None else round(err_pct, 1)),
+        "podstar_pop1e8_stop_parity": len(
+            {i["stop"] for i in infos}) == 1,
+    }
+
+
 def _run_sub(name: str) -> dict:
     if name == "kde_1e6":
         return bench_kde_1e6()
@@ -1456,6 +1693,8 @@ def _run_sub(name: str) -> dict:
         return bench_sharded(POP, "sharded_cpu8")
     if name == "podstar":
         return bench_podstar()
+    if name == "podstar_pop1e8":
+        return bench_podstar_pop1e8()
     raise ValueError(name)
 
 
@@ -1795,6 +2034,17 @@ def bench_fidelity():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "bench_podstar":
+        # direct invocation of the pod rows:
+        #   bench.py bench_podstar             -> the pop-1e7 row
+        #   bench.py bench_podstar --pop 1e8   -> the HBM-ladder row
+        pop = "1e7"
+        if "--pop" in sys.argv:
+            pop = sys.argv[sys.argv.index("--pop") + 1]
+        _enable_compilation_cache()
+        sub = ("podstar_pop1e8" if float(pop) >= 1e8 else "podstar")
+        print(json.dumps(_run_sub(sub)))
+        sys.exit(0)
     if len(sys.argv) == 3 and sys.argv[1] == "--sub":
         if sys.argv[2] == "sharded_cpu8":
             # the TPU plugin's sitecustomize pins JAX_PLATFORMS at

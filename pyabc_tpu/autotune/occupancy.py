@@ -153,20 +153,29 @@ class OccupancyTuner:
         return K * n / cost
 
     def propose(self, n: int, rate: float, B0: int,
-                round_to_rung) -> Tuple[int, int, int]:
+                round_to_rung, feasible=None) -> Tuple[int, int, int]:
         """The jointly-best (K, max_T, B) for a block targeting ``n``.
 
         ``B0``: the rung the independent tuner would pick (the search
         explores it and its pow2 neighbors); ``round_to_rung``: the
-        sampler's ladder clamp.  Falls back to (1, smallest feasible
-        max_T, B0) when nothing fits — the caller's sequential path
-        semantics are preserved."""
+        sampler's ladder clamp.  ``feasible(K, max_T, B) -> bool``, when
+        given, is the HBM capacity model's admissibility predicate
+        (``ABCSMC._capacity_feasible``): candidates outside the budget
+        are never scored, so the tuner cannot propose a shape the
+        device would OOM on — a tight budget shrinks the chosen rung
+        instead.  Falls back to (1, smallest feasible max_T, B0 clamped
+        through shrinking rungs) when nothing fits — the caller's
+        sequential-path semantics (or its capacity consult's
+        CapacityError) are preserved."""
         rungs = sorted({round_to_rung(B0 * f) for f in (0.5, 1.0, 2.0)})
         best, best_score = None, 0.0
         incumbent = self._shape
         for K in range(1, self.k_max + 1):
             for B in rungs:
                 for max_T in self.t_choices:
+                    if feasible is not None and \
+                            not feasible(K, max_T, B):
+                        continue
                     s = self.score(n, rate, K, max_T, B)
                     if s is None:
                         continue
@@ -175,9 +184,30 @@ class OccupancyTuner:
                     if s > best_score:
                         best, best_score = (K, max_T, B), s
         if best is None:
-            return 1, self.t_choices[-1], B0
+            K_f, T_f = 1, self.t_choices[-1]
+            if feasible is not None:
+                # clamp the fallback through shrinking rungs until the
+                # capacity model admits the minimal shape; if even the
+                # smallest rung is out of budget, return it anyway —
+                # the caller's own consult raises CapacityError with
+                # the full ledger
+                B_f = int(B0)
+                for _ in range(8):
+                    if feasible(K_f, T_f, B_f):
+                        break
+                    nxt = int(round_to_rung(max(B_f // 2, 1)))
+                    if nxt >= B_f:
+                        break
+                    B_f = nxt
+                return K_f, T_f, B_f
+            return K_f, T_f, B0
         if incumbent is not None and incumbent != best:
-            inc_score = self.score(n, rate, *_shape_args(incumbent))
+            # an incumbent outside the budget's feasible set cannot be
+            # kept, whatever its score says
+            inc_ok = (feasible is None
+                      or feasible(*_shape_args(incumbent)))
+            inc_score = (self.score(n, rate, *_shape_args(incumbent))
+                         if inc_ok else None)
             if inc_score is not None and \
                     best_score < inc_score * self.HYSTERESIS:
                 return incumbent

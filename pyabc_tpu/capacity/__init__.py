@@ -1,0 +1,22 @@
+"""HBM capacity planning: plan-then-compile instead of try-then-OOM.
+
+``capacity.model`` predicts the per-device peak bytes of a run shape
+before anything is traced, so the orchestrator can pick the
+(shard, block K, batch rung, at-rest precision) point that fits the
+budget — or raise a :class:`CapacityError` carrying the full ledger
+when nothing does.
+"""
+
+from .model import (  # noqa: F401
+    HBM_BUDGET_ENV,
+    HBM_HEADROOM_ENV,
+    ROUND_HEADROOM,
+    CapacityError,
+    CapacityPlan,
+    detect_hbm_bytes,
+    ledger,
+    parse_bytes,
+    plan,
+    predict_peak_bytes,
+    resolved_budget_bytes,
+)

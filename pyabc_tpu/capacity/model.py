@@ -1,0 +1,391 @@
+"""The HBM capacity model: a pure ledger from run shape to peak bytes.
+
+Nothing in here traces or compiles.  Every entry point is arithmetic
+over the run's shape parameters, so the orchestrator (``smc.py``) and
+the platform factory can consult it BEFORE the first ``jit`` — at pop
+1e8 the f32 carry alone is tens of GB and the failure mode without a
+model is an XLA OOM minutes into compilation.
+
+The ledger names the population- and batch-proportional device
+allocations of one engine step.  It is deliberately a first-order
+model: per-component constants are chosen to match how the fused
+programs actually allocate (verified against XLA's own
+``memory_analysis()`` by the ``podstar_pop1e8`` bench row, which pins
+``|predicted - measured| / measured <= 15%``), and every constant is a
+named column in the ledger so a ``CapacityError`` shows WHERE the bytes
+went, not just that they overflowed.
+
+Budget resolution, in order:
+
+- ``PYABC_TPU_HBM_BUDGET``  — explicit budget, used verbatim
+  (suffixes ``K``/``M``/``G``/``T``, e.g. ``12G``; plain = bytes).
+- ``jax.devices()[0].memory_stats()['bytes_limit']`` scaled by
+  ``1 - PYABC_TPU_HBM_HEADROOM`` (default headroom 0.1) — the real-TPU
+  auto-detect path.
+- CPU rigs report no limit: budget 0 = unconstrained, every plan fits,
+  zero behavioural drift for the test suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..ops.precision import CARRY_ITEMSIZE, resolve_carry_precision
+
+HBM_BUDGET_ENV = "PYABC_TPU_HBM_BUDGET"
+HBM_HEADROOM_ENV = "PYABC_TPU_HBM_HEADROOM"
+
+#: the at-rest precision ladder ``carry_precision=auto`` descends —
+#: widest (exact) mode first, so a fitting f32 plan always wins
+AUTO_LADDER = ("f32", "bf16", "int8")
+
+#: round-budget headroom for the completability constraint: a fused /
+#: one-dispatch generation proposes ``batch`` rows per device round and
+#: stops at ``max_T`` rounds, so a geometry with
+#: ``ceil(headroom * population / batch) > max_T`` cannot fill the
+#: population — the block undershoots and the run bounces to the
+#: per-generation path (which a multi-process pod cannot take at all).
+#: The headroom multiplies the perfect-acceptance round count to absorb
+#: the quantile schedule's ~alpha per-generation acceptance (~0.5) plus
+#: in-block decay; plan() never emits a geometry below it.
+ROUND_HEADROOM = 4.0
+
+_SUFFIX = {"k": 1024, "m": 1024 ** 2, "g": 1024 ** 3, "t": 1024 ** 4}
+
+
+def parse_bytes(text) -> int:
+    """``'12G' -> 12884901888``; accepts K/M/G/T (binary), optional
+    trailing ``b``/``ib``, or a plain byte count (int or float)."""
+    if isinstance(text, (int, float)):
+        return int(text)
+    raw = str(text).strip().lower()
+    if not raw:
+        return 0
+    for tail in ("ib", "b"):
+        if raw.endswith(tail) and len(raw) > len(tail):
+            raw = raw[: -len(tail)]
+            break
+    mult = 1
+    if raw and raw[-1] in _SUFFIX:
+        mult = _SUFFIX[raw[-1]]
+        raw = raw[:-1]
+    try:
+        return int(float(raw) * mult)
+    except ValueError:
+        raise ValueError(
+            f"{HBM_BUDGET_ENV}: cannot parse {text!r} as a byte count "
+            f"(expected e.g. '12G', '900M', or plain bytes)") from None
+
+
+def detect_hbm_bytes() -> int:
+    """Physical per-device HBM bytes, or 0 when the backend does not
+    report one (CPU rigs, older runtimes)."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return 0
+    if not stats:
+        return 0
+    return int(stats.get("bytes_limit", 0) or 0)
+
+
+def resolved_budget_bytes() -> int:
+    """The effective per-device budget: explicit env verbatim, else
+    detected HBM scaled by the headroom fraction, else 0
+    (unconstrained)."""
+    raw = os.environ.get(HBM_BUDGET_ENV, "").strip()
+    if raw:
+        return parse_bytes(raw)
+    phys = detect_hbm_bytes()
+    if phys <= 0:
+        return 0
+    headroom = float(os.environ.get(HBM_HEADROOM_ENV, "0.1"))
+    headroom = min(max(headroom, 0.0), 0.9)
+    return int(phys * (1.0 - headroom))
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // max(int(b), 1))
+
+
+def ledger(*, population: int, param_dim: int, stat_dim: int,
+           engine: str = "fused", batch: int = 4096, K: int = 1,
+           max_T: int = 32, carry_precision: str = "f32",
+           devices: int = 1, donate: bool = True,
+           telemetry_lanes: bool = False, wire_stats: bool = False,
+           models: int = 1, support_cap: Optional[int] = None,
+           record_rows: int = 0, cal_rows: int = 0,
+           sim_mult: int = 4) -> "OrderedDict[str, int]":
+    """Per-device peak-byte ledger for one engine step.
+
+    Columns (all bytes, population terms divided across ``devices``):
+
+    - ``carry_at_rest``  — the resident population carry: ``m`` i32 +
+      ``log_weight`` f32 (never narrowed) + theta/distance/stats at the
+      at-rest width.  Doubled when donation is off (XLA keeps input and
+      output buffers live across the dispatch); the sequential engine
+      re-uploads per generation, so it always pays the double and never
+      compresses.
+    - ``accept_window``  — the f32 working set of the accept/compact
+      window: ``n + B`` rows (population plus one rejection batch) at
+      full f32 lane width.  Compressed-carry decode promotion aliases
+      into this window, so it is not double-counted.
+    - ``round_batch``    — per-round proposal/simulation workspace,
+      batch-proportional with a ``sim_mult``-state-copy allowance.
+    - ``wire_egress``    — stacked per-generation wire slots (f16
+      lanes): ``K`` slots for a fused block, ``max_T`` for a
+      one-dispatch run, none for sequential.
+    - ``refit_support``  — proposal-refit support rows (capped by
+      ``support_cap``), replicated per device for the KDE
+      cross-product, one set per model.
+    - ``record_ring``    — stochastic-acceptance record ring rows.
+    - ``fidelity_rings`` — low/full calibration rings.
+    - ``telemetry``      — flat lane overhead when telemetry lanes are
+      on (deliberately tiny; present so the toggle is visible).
+    """
+    if engine not in ("sequential", "fused", "onedispatch"):
+        raise ValueError(f"capacity: unknown engine {engine!r}")
+    n, d, s = int(population), int(param_dim), int(stat_dim)
+    devices = max(int(devices), 1)
+    B = max(int(batch), 1)
+    mode = resolve_carry_precision(carry_precision)
+    if mode == "auto":
+        raise ValueError("ledger() needs a concrete carry_precision; "
+                         "plan() resolves 'auto'")
+    if engine == "sequential":
+        mode = "f32"  # the host loop never stores a compressed carry
+    w = CARRY_ITEMSIZE[mode]
+
+    n_dev = _ceil_div(n, devices)
+    b_dev = _ceil_div(B, devices)
+    cap_dev = n_dev + b_dev
+
+    mult = 2 if (engine == "sequential" or not donate) else 1
+    carry_row = 4 + 4 + w * (d + 1 + s)        # m, log_weight, bulk
+    window_row = 4 + 4 + 4 * (d + 1 + s)       # the f32 promotion width
+
+    slots = {"sequential": 0, "fused": int(K),
+             "onedispatch": int(max_T)}[engine]
+    wire_row = 2 * d + 3 + (2 * s if wire_stats else 0)
+
+    sup = n if support_cap is None else min(int(support_cap), n)
+
+    out: "OrderedDict[str, int]" = OrderedDict()
+    out["carry_at_rest"] = n_dev * carry_row * mult
+    out["accept_window"] = cap_dev * window_row
+    out["round_batch"] = b_dev * 4 * (d + s + 3) * int(sim_mult)
+    out["wire_egress"] = slots * n_dev * wire_row
+    out["refit_support"] = int(models) * sup * (4 * d + 8)
+    out["record_ring"] = int(record_rows) * (4 * d + 16)
+    out["fidelity_rings"] = 2 * int(cal_rows) * 8
+    out["telemetry"] = 4096 if telemetry_lanes else 0
+    return out
+
+
+def predict_peak_bytes(**kwargs) -> int:
+    """Sum of the :func:`ledger` columns — the model's predicted
+    per-device peak for one engine step."""
+    return sum(ledger(**kwargs).values())
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityPlan:
+    """A (precision, geometry) point the budget admits."""
+    carry_precision: str
+    batch: int
+    K: int
+    max_T: int
+    devices: int
+    predicted_bytes: int
+    budget_bytes: int        # 0 = unconstrained
+    ledger: "OrderedDict[str, int]"
+    note: str = ""
+
+    @property
+    def predicted_mb(self) -> float:
+        return self.predicted_bytes / (1024.0 * 1024.0)
+
+
+class CapacityError(RuntimeError):
+    """No (batch, K, max_T, precision) point fits the HBM budget.
+
+    Carries the full ledger of the smallest candidate tried at the
+    pinned precision (``.ledger``), the resolved budget (``.budget``),
+    the losing prediction (``.predicted``), the original request
+    (``.request``) and — when a narrower at-rest mode WOULD fit — a
+    ``.hint`` naming it, so the error is an instruction, not a wall.
+    """
+
+    def __init__(self, message: str, *, request: dict, ledger: dict,
+                 budget: int, predicted: int, hint: Optional[str] = None):
+        super().__init__(message)
+        self.request = request
+        self.ledger = ledger
+        self.budget = budget
+        self.predicted = predicted
+        self.hint = hint
+
+
+def _fmt_mb(b: int) -> str:
+    return f"{b / (1024.0 * 1024.0):.1f} MB"
+
+
+def _render_ledger(led: dict) -> str:
+    width = max(len(k) for k in led)
+    return "\n".join(f"    {k.ljust(width)}  {_fmt_mb(v):>10}"
+                     for k, v in led.items())
+
+
+def _batch_rungs(batch: int,
+                 round_to_batch: Optional[Callable[[int], int]]):
+    """Descending halvings of the requested rung, floored at 256 (or
+    the requested batch when smaller), snapped to the sampler's valid
+    rungs when a rounder is supplied."""
+    floor = min(int(batch), 256)
+    out, b = [], int(batch)
+    for _ in range(12):
+        snapped = int(round_to_batch(b)) if round_to_batch else b
+        snapped = max(snapped, 1)
+        if snapped not in out:
+            out.append(snapped)
+        if b <= floor:
+            break
+        b = max(b // 2, floor)
+    return out
+
+
+def plan(*, population: int, param_dim: int, stat_dim: int,
+         engine: str = "fused", batch: Optional[int] = None, K: int = 1,
+         max_T: int = 32, carry_precision: Optional[str] = None,
+         devices: int = 1, budget: Optional[int] = None,
+         round_to_batch: Optional[Callable[[int], int]] = None,
+         round_headroom: Optional[float] = None,
+         **lanes) -> CapacityPlan:
+    """Choose the widest (precision, geometry) point fitting the budget.
+
+    Search order: the precision ladder outermost (requested mode only,
+    or f32 -> bf16 -> int8 for ``auto``), then batch rungs descending,
+    then block ``K`` descending, then ``max_T`` descending — i.e. the
+    plan keeps exactness first and the requested geometry second, and
+    only narrows the at-rest carry when no f32 geometry fits.
+
+    Round-bounded engines (fused, onedispatch) additionally face the
+    COMPLETABILITY constraint: a candidate (batch, max_T) must satisfy
+    ``ceil(round_headroom * population / batch) <= max_T`` (default
+    :data:`ROUND_HEADROOM`) — shrinking the rung below it would trade
+    an OOM for a guaranteed undershoot, which is the same failed run.
+    The smallest-candidate bytes a :class:`CapacityError` reports (and
+    hence ``.predicted``) honour the constraint too.
+
+    ``budget=None`` resolves via :func:`resolved_budget_bytes`; a
+    non-positive budget is unconstrained and returns the request
+    verbatim (``auto`` resolving to f32).  Raises :class:`CapacityError`
+    when nothing fits.
+    """
+    if batch is None:
+        batch = min(int(population), 4096)
+    mode = resolve_carry_precision(carry_precision)
+    if budget is None:
+        budget = resolved_budget_bytes()
+    budget = int(budget or 0)
+    headroom = max(float(ROUND_HEADROOM if round_headroom is None
+                         else round_headroom), 1.0)
+
+    def _completable(b: int, t: int) -> bool:
+        if engine == "sequential":
+            return True  # the host loop rounds until done
+        return math.ceil(headroom * int(population) / max(int(b), 1)) \
+            <= int(t)
+
+    def _ledger_at(prec, b, k, t):
+        return ledger(population=population, param_dim=param_dim,
+                      stat_dim=stat_dim, engine=engine, batch=b, K=k,
+                      max_T=t, carry_precision=prec, devices=devices,
+                      **lanes)
+
+    if budget <= 0:
+        prec = "f32" if mode == "auto" else mode
+        led = _ledger_at(prec, batch, K, max_T)
+        return CapacityPlan(prec, int(batch), int(K), int(max_T),
+                            int(devices), sum(led.values()), 0, led,
+                            note="unconstrained")
+
+    ladder = AUTO_LADDER if mode == "auto" else (mode,)
+    rungs = _batch_rungs(batch, round_to_batch)
+    ks = list(range(int(K), 0, -1))
+    ts = [int(max_T)]
+    while ts[-1] > 8:
+        ts.append(max(ts[-1] // 2, 8))
+
+    smallest = None  # ledger of the tiniest candidate at ladder[0]
+    for prec in ladder:
+        for b in rungs:
+            for k in ks:
+                for t in ts:
+                    if not _completable(b, t):
+                        continue
+                    led = _ledger_at(prec, b, k, t)
+                    total = sum(led.values())
+                    if prec == ladder[0]:
+                        if smallest is None or total < smallest[1]:
+                            smallest = (led, total, b, k, t)
+                    if total <= budget:
+                        clamped = (prec != ladder[0] or b != batch
+                                   or k != K or t != max_T)
+                        note = ("clamped to fit budget" if clamped
+                                else "fits as requested")
+                        return CapacityPlan(prec, b, k, t, int(devices),
+                                            total, budget, led, note)
+
+    request = dict(population=population, param_dim=param_dim,
+                   stat_dim=stat_dim, engine=engine, batch=batch, K=K,
+                   max_T=max_T, carry_precision=mode, devices=devices,
+                   **lanes)
+    if smallest is None:
+        # no (batch, max_T) point can even FILL the population within
+        # the compiled round budget — a bytes budget never fixes that
+        led = _ledger_at(ladder[0], batch, K, max_T)
+        raise CapacityError(
+            f"capacity: no (batch, max_T) point can fill population="
+            f"{population} within {max_T} rounds at {headroom:.1f}x "
+            f"headroom (engine={engine}); raise max_T or the batch "
+            f"ceiling", request=request, ledger=led, budget=budget,
+            predicted=sum(led.values()), hint=None)
+
+    # nothing fits — find the narrowest mode that WOULD, for the hint
+    hint = None
+    for prec in AUTO_LADDER[1:]:
+        if prec in ladder:
+            continue
+        for b in rungs:
+            for t in ts:
+                if not _completable(b, t):
+                    continue
+                total = sum(_ledger_at(prec, b, 1, t).values())
+                if total <= budget:
+                    hint = (f"PYABC_TPU_CARRY_PRECISION={prec} would "
+                            f"fit (predicted {_fmt_mb(total)} <= budget "
+                            f"{_fmt_mb(budget)})")
+                    break
+            if hint:
+                break
+        if hint:
+            break
+
+    led, total, b, k, t = smallest
+    msg = (
+        f"capacity: no (batch, K, max_T, precision) point fits the HBM "
+        f"budget\n  population={population} devices={devices} "
+        f"engine={engine} carry_precision={mode}\n"
+        f"  budget: {_fmt_mb(budget)}\n"
+        f"  smallest candidate tried: batch={b} K={k} max_T={t} -> "
+        f"predicted {_fmt_mb(total)}\n{_render_ledger(led)}")
+    if hint:
+        msg += f"\n  hint: {hint}"
+    raise CapacityError(msg, request=request, ledger=led, budget=budget,
+                        predicted=total, hint=hint)

@@ -44,6 +44,143 @@ PRECISION_ENV = "PYABC_TPU_PRECISION_LANES"
 COMPONENTS = ("kde", "distance")
 _MODES = ("f32", "bf16")
 
+# ---------------------------------------------------------------------------
+# at-rest carry compression (the HBM ladder, capacity/ tentpole)
+# ---------------------------------------------------------------------------
+#
+# PYABC_TPU_PRECISION_LANES governs COMPUTE precision; this second policy
+# governs STORAGE: the dtype the population carry rests in between
+# generations of a fused scan / one-dispatch while-loop.  The carry is
+# the dominant at-rest HBM consumer at large populations (theta[n,d] +
+# distance[n] + stats[n,s]), and every use site promotes to f32 INSIDE
+# the accept/refit/resample window, so narrowing only the at-rest lanes
+# trades a bounded per-generation rounding (posterior-gated at 4 seeds,
+# tests/test_capacity.py) for a 2x (bf16) or ~4x (int8) carry footprint.
+#
+# Lanes that stay f32 regardless: ``log_weight`` (log-space accumulator
+# — bf16's 8-bit mantissa would visibly bias the normalization),
+# ``count``/``eps``/``rate``/``safety`` scalars, and every mode lane
+# (dist_w, rec_*, cal_*) — they are accumulator state, not bulk.
+#
+# Unlike the compute-lane policy this one is NOT process-frozen: it
+# enters every compile-cache key ("fused5"/"onedispatch6", smc.py) and
+# the serve digests (serve/spec.py), so a changed policy can never be
+# served a stale program — resolution happens per read.
+
+CARRY_PRECISION_ENV = "PYABC_TPU_CARRY_PRECISION"
+
+#: at-rest modes; "auto" defers to the capacity planner
+#: (capacity/model.py), which resolves it to the widest mode whose
+#: plan fits the HBM budget (f32 when unconstrained)
+CARRY_MODES = ("f32", "bf16", "int8", "auto")
+
+#: the carry lanes the codec narrows (population-sized bulk); m stays
+#: i32, log_weight/scalars/mode lanes stay f32 (accumulator statistics)
+CARRY_COMPRESSED_LANES = ("theta", "distance", "stats")
+
+#: f32 bytes saved per element at rest, by mode (capacity model input)
+CARRY_ITEMSIZE = {"f32": 4, "bf16": 2, "int8": 1}
+
+
+def resolve_carry_precision(value=None) -> str:
+    """The at-rest carry mode: ``value`` if given, else
+    ``$PYABC_TPU_CARRY_PRECISION`` (default ``f32``).  Validated, never
+    cached — the mode is part of every compile-cache key."""
+    raw = (value if value is not None
+           else os.environ.get(CARRY_PRECISION_ENV, "f32"))
+    raw = str(raw).strip().lower()
+    if raw not in CARRY_MODES:
+        raise ValueError(
+            f"{CARRY_PRECISION_ENV}={raw!r}: expected one of "
+            f"{CARRY_MODES}")
+    return raw
+
+
+def _quantize_i8(x):
+    """Per-column affine int8 quantization of an f32 array.
+
+    Deterministic (``jnp.round``, no RNG) and total: non-finite entries
+    clamp to the column floor — documented lossy, but the carry's
+    non-finite rows are always masked by ``count`` downstream, so the
+    clamp never reaches a statistic.  A degenerate (constant or dead)
+    column gets scale 1 so the decode stays finite.
+
+    Returns ``(q[int8], scale[f32 cols], lo[f32 cols])`` with
+    ``decode = (q + 127) * scale + lo``.
+    """
+    x = x.astype(jnp.float32)
+    finite = jnp.isfinite(x)
+    big = jnp.float32(3.4e38)
+    lo = jnp.min(jnp.where(finite, x, big), axis=0)
+    hi = jnp.max(jnp.where(finite, x, -big), axis=0)
+    dead = lo > hi  # no finite rows in the column
+    lo = jnp.where(dead, 0.0, lo)
+    hi = jnp.where(dead, 0.0, hi)
+    scale = jnp.maximum((hi - lo) / 254.0, 1e-30)
+    xs = jnp.where(finite, x, lo)
+    q = jnp.clip(jnp.round((xs - lo) / scale), 0.0, 254.0) - 127.0
+    return (q.astype(jnp.int8), scale.astype(jnp.float32),
+            lo.astype(jnp.float32))
+
+
+def encode_carry(carry: dict, mode: str) -> dict:
+    """Narrow the bulk lanes of a population carry to the at-rest mode.
+
+    ``f32`` returns the SAME dict object — zero new ops, so default
+    programs stay bit-identical to pre-codec builds.  Idempotent: lanes
+    already at the target dtype pass through (a previous block's
+    ``carry_out`` re-enters ``_seed_block_carry`` compressed).  int8
+    adds flat ``<lane>_qs``/``<lane>_qm`` scale/offset keys (f32, one
+    per column) — deliberately NOT population-sized, so the pod
+    sharding pin (``_POP_CARRY_LANES``) leaves them replicated.
+    """
+    if mode == "f32":
+        return carry
+    if mode not in ("bf16", "int8"):
+        raise ValueError(f"encode_carry: bad mode {mode!r}")
+    out = dict(carry)
+    for k in CARRY_COMPRESSED_LANES:
+        v = out.get(k)
+        if v is None:
+            continue
+        if mode == "bf16":
+            if v.dtype != jnp.bfloat16:
+                out[k] = v.astype(jnp.bfloat16)
+        else:
+            if v.dtype == jnp.int8:
+                continue  # aux keys already ride in ``carry``
+            q, scale, lo = _quantize_i8(v)
+            out[k] = q
+            out[k + "_qs"] = scale
+            out[k + "_qm"] = lo
+    return out
+
+
+def decode_carry(carry: dict, mode: str) -> dict:
+    """Promote a compressed carry back to f32 lanes (the accept/refit/
+    resample window's working precision).  ``f32`` is identity (same
+    object); int8 consumes and drops the ``_qs``/``_qm`` aux keys.
+    Safe on an already-decoded carry (pass-through)."""
+    if mode == "f32":
+        return carry
+    if mode not in ("bf16", "int8"):
+        raise ValueError(f"decode_carry: bad mode {mode!r}")
+    out = dict(carry)
+    for k in CARRY_COMPRESSED_LANES:
+        v = out.get(k)
+        if v is None:
+            continue
+        if mode == "bf16":
+            if v.dtype == jnp.bfloat16:
+                out[k] = v.astype(jnp.float32)
+        else:
+            if v.dtype != jnp.int8:
+                continue
+            scale = out.pop(k + "_qs")
+            lo = out.pop(k + "_qm")
+            out[k] = (v.astype(jnp.float32) + 127.0) * scale + lo
+    return out
+
 
 @lru_cache(maxsize=None)
 def _resolve() -> dict:

@@ -145,6 +145,15 @@ def host_shard_slice(mesh: Mesh, n: int) -> slice:
     return slice(mine[0] * per_dev, (mine[-1] + 1) * per_dev)
 
 
+def per_device_hbm_bytes() -> int:
+    """Physical HBM bytes of one device of the mesh, or 0 when the
+    backend does not report a limit (CPU rigs) — the capacity model's
+    auto-detect source (capacity/model.py).  Thin alias so mesh-level
+    planning code never reaches into ``jax.devices()`` directly."""
+    from ..capacity.model import detect_hbm_bytes
+    return detect_hbm_bytes()
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None):

@@ -4,6 +4,14 @@ Parity: pyabc/platform_factory.py:5-16 (MulticoreEvalParallel on
 Linux/macOS, SingleCore on Windows).  Here the choice is by device
 topology: one accelerator -> :class:`VectorizedSampler`; several devices ->
 :class:`ShardedSampler` over a particles mesh.
+
+When the caller can name the run's shape (``population`` + dims), the
+factory consults the HBM capacity model (capacity/model.py) before
+handing the sampler back: with a budget active, a shape no
+(precision, rung) point can fit raises :class:`~pyabc_tpu.capacity.
+CapacityError` HERE — at construction, with the full ledger — instead
+of as an XLA OOM minutes into the first compile.  Shape-less calls
+behave exactly as before.
 """
 
 from __future__ import annotations
@@ -14,7 +22,21 @@ from .sampler.sharded import ShardedSampler
 from .sampler.vectorized import VectorizedSampler
 
 
-def DefaultSampler(**kwargs):
-    if len(jax.devices()) > 1:
+def DefaultSampler(population=None, param_dim=None, stat_dim=None,
+                   **kwargs):
+    n_dev = len(jax.devices())
+    if population is not None:
+        from .capacity import model as _capacity
+        # plan-then-compile at the earliest possible moment; raises
+        # CapacityError (full ledger + precision hint) when no point
+        # fits, a no-op when no budget is active
+        _capacity.plan(
+            population=int(population),
+            param_dim=int(param_dim or 1),
+            stat_dim=int(stat_dim or 1),
+            engine="fused",
+            batch=min(int(population), 4096),
+            devices=max(n_dev, 1))
+    if n_dev > 1:
         return ShardedSampler(**kwargs)
     return VectorizedSampler(**kwargs)

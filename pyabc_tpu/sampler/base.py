@@ -323,9 +323,11 @@ class Sample:
         if idx.size:
             self._acc.append({
                 "m": np.asarray(rr.m)[idx],
-                "theta": np.asarray(rr.theta)[idx],
+                # pop-ok: round-batch rows (B, not pop), already
+                # through the wire chokepoint via fetch_to_host
+                "theta": np.asarray(rr.theta)[idx],  # pop-ok
                 "distance": np.asarray(rr.distance)[idx],
-                "log_weight": np.asarray(rr.log_weight)[idx],
+                "log_weight": np.asarray(rr.log_weight)[idx],  # pop-ok
                 "stats": np.asarray(rr.stats)[idx],
             })
         if self.record_rejected and self._n_recorded < self.max_records:
@@ -336,7 +338,7 @@ class Sample:
                 "distance": np.asarray(rr.distance)[take],
                 "accepted": acc_mask[take],
                 "m": np.asarray(rr.m)[take],
-                "theta": np.asarray(rr.theta)[take],
+                "theta": np.asarray(rr.theta)[take],  # pop-ok: B rows
                 "log_proposal": np.asarray(rr.log_proposal)[take],
                 "__count": int(take.size),
             })
@@ -387,7 +389,8 @@ class Sample:
                     "distance": np.asarray(out["rec_distance"][:rc]),
                     "accepted": np.asarray(out["rec_accepted"][:rc]),
                     "m": np.asarray(out["rec_m"][:rc]),
-                    "theta": np.asarray(out["rec_theta"][:rc]),
+                    # pop-ok: record-ring rows (max_records cap)
+                    "theta": np.asarray(out["rec_theta"][:rc]),  # pop-ok
                     "log_proposal": np.asarray(
                         out["rec_log_proposal"][:rc]),
                     "__count": rc,

@@ -273,7 +273,8 @@ def _build_one_gen(
         summary_lanes: bool = False,
         eps_sketch: bool = False,
         telemetry_lanes: bool = False,
-        fidelity_cfg: Optional[dict] = None):
+        fidelity_cfg: Optional[dict] = None,
+        carry_precision: str = "f32"):
     """Shared per-generation body behind :func:`build_fused_generations`
     (which scans it K times) and :func:`build_onedispatch_run` (which
     wraps those scans in a device-side stopping ``while_loop``).
@@ -300,6 +301,7 @@ def _build_one_gen(
     enforces non-adaptive distance + deterministic acceptor).
     """
     from ..autotune.tuner import EWMA_ALPHA
+    from ..ops.precision import decode_carry, encode_carry
     from ..wire.store import summary_wire_lanes as _summary_wire_lanes
     from .device_loop import narrow_wire
 
@@ -353,6 +355,11 @@ def _build_one_gen(
             adaptive=adaptive, fidelity=fidelity)
 
     def one_gen(carry, gen_key, final_flag=None, live=None):
+        # the at-rest carry promotes to the f32 window precision here
+        # and re-narrows on exit; the codec is identity under the
+        # default f32 policy, so default traces stay bit-identical
+        # (ops/precision.py, the HBM ladder)
+        carry = decode_carry(carry, carry_precision)
         m0, theta0, lw0, dist0, count0, eps0 = (
             carry["m"], carry["theta"], carry["log_weight"],
             carry["distance"], carry["count"], carry["eps"])
@@ -670,7 +677,7 @@ def _build_one_gen(
             # egress prefix) — only wired when the driver opts in, so a
             # lanes-off program stays bit-identical to pre-lanes
             wire["tl_screen_pass"] = extras["npass"]
-        return new_carry, wire
+        return encode_carry(new_carry, carry_precision), wire
 
     return one_gen
 
@@ -726,7 +733,8 @@ def build_fused_generations(
         summary_lanes: bool = False,
         eps_sketch: bool = False,
         telemetry_lanes: bool = False,
-        fidelity_cfg: Optional[dict] = None):
+        fidelity_cfg: Optional[dict] = None,
+        carry_precision: str = "f32"):
     """Compile-ready ``fused(carry, key[, final_mask]) -> (carry, wires)``
     for K generations.  ``carry`` = the previous generation's accepted
     population on device: dict(m[i32 n], theta[f32 n,d], log_weight
@@ -777,7 +785,7 @@ def build_fused_generations(
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
         stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
         eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes,
-        fidelity_cfg=fidelity_cfg)
+        fidelity_cfg=fidelity_cfg, carry_precision=carry_precision)
     stoch = stoch_cfg is not None
 
     def one_generation(carry, xs):
@@ -825,7 +833,8 @@ def build_onedispatch_run(
         eps_sketch: bool = False,
         telemetry_lanes: bool = False,
         fidelity_cfg: Optional[dict] = None,
-        progress: bool = False):
+        progress: bool = False,
+        carry_precision: str = "f32"):
     """Whole-run driver with DEVICE-side stopping: a ``lax.while_loop``
     over K-generation ``lax.scan`` blocks of the same per-generation
     body as :func:`build_fused_generations`, whose predicate evaluates
@@ -876,7 +885,7 @@ def build_onedispatch_run(
         rate_pred_factor=rate_pred_factor, adaptive_cfg=adaptive_cfg,
         stoch_cfg=stoch_cfg, summary_lanes=summary_lanes,
         eps_sketch=eps_sketch, telemetry_lanes=telemetry_lanes,
-        fidelity_cfg=fidelity_cfg)
+        fidelity_cfg=fidelity_cfg, carry_precision=carry_precision)
     if progress:
         from ..telemetry.lanes import device_progress_update
     M = kernel.M
@@ -1035,7 +1044,7 @@ def lane_extract(carry, row: int):
     leaf, materialized on the host (``np.asarray``) so the result is
     stable storage independent of any in-flight device buffer."""
     return jax.tree_util.tree_map(
-        lambda leaf: np.asarray(leaf)[row], carry)
+        lambda leaf: np.asarray(leaf)[row], carry)  # pop-ok: turnover d2h
 
 
 def lane_splice(carry, row: int, values):

@@ -48,6 +48,13 @@ class ShardedSampler(VectorizedSampler):
         # every round's batch must split evenly over devices
         self.min_batch_size = max(self.min_batch_size, self.n_devices)
 
+    def capacity_shard_devices(self) -> int:
+        """The device count the HBM capacity model divides population
+        terms by (capacity/model.py): the mesh width the population
+        carry and rejection buffers are sharded over.  Samplers without
+        this method plan single-device (the orchestrator's fallback)."""
+        return self.n_devices
+
     def _state_out_sharding(self):
         # pin the stateful-loop carry to the mesh-replicated layout XLA
         # converges to anyway, so the first generation on a rung

@@ -42,7 +42,7 @@ import numpy as np
 
 #: digest schema version — bump when the hashed canonical form changes
 #: (a stale persisted cache entry must miss, not alias)
-DIGEST_VERSION = 1
+DIGEST_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -118,6 +118,15 @@ def _observed_canonical(observed: Dict) -> list:
             for k in sorted(observed)]
 
 
+def _carry_policy() -> str:
+    """The at-rest carry-precision POLICY string (including "auto" —
+    the planner's resolution depends on the local HBM budget, but the
+    policy itself is what the submitter controls and what must key the
+    caches)."""
+    from ..ops.precision import resolve_carry_precision
+    return resolve_carry_precision()
+
+
 def _digest_of(parts: dict) -> str:
     blob = json.dumps(parts, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()
@@ -141,6 +150,10 @@ def study_digest(spec: StudySpec) -> str:
         "min_acceptance_rate": float(spec.min_acceptance_rate),
         "seed": int(spec.seed),
         "fidelity": str(spec.fidelity),
+        # the at-rest carry policy (ops/precision.py): bf16/int8 change
+        # the sampled chain (bounded per-generation rounding), so a
+        # compressed study must never alias an exact one
+        "carry_precision": _carry_policy(),
     })
 
 
@@ -159,4 +172,7 @@ def problem_key(spec: StudySpec) -> str:
         "population_size": int(spec.population_size),
         "min_acceptance_rate": float(spec.min_acceptance_rate),
         "fidelity": str(spec.fidelity),
+        # digest-bearing in the ENGINE key too: the codec is traced
+        # into the program (decode/encode at every generation boundary)
+        "carry_precision": _carry_policy(),
     })

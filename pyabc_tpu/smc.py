@@ -29,6 +29,8 @@ from .autotune import (compile_counters as _compile_counters,
                        configure_compile_cache, install_compile_listener,
                        jit_compile)
 from .autotune import occupancy as _occupancy
+from .capacity import model as _capacity
+from .ops import precision as _precision
 from .distance import Distance, PNormDistance, StochasticKernel, to_distance
 from .epsilon import Epsilon, MedianEpsilon, TemperatureBase
 from .fidelity import FidelityConfig as _FidelityConfig
@@ -301,6 +303,22 @@ class ABCSMC:
         #: $PYABC_TPU_DONATE_CARRY=0.
         self._donate_carry = os.environ.get(
             "PYABC_TPU_DONATE_CARRY", "1") not in ("0", "false", "no")
+        #: at-rest carry precision policy (ops/precision.py, the HBM
+        #: ladder): "f32" (default — bit-identical programs), "bf16",
+        #: "int8", or "auto" (the capacity planner resolves it to the
+        #: widest mode whose plan fits the HBM budget at the first
+        #: consult).  Enters every fused/onedispatch compile-cache key
+        #: and the serve digests.  Defers to $PYABC_TPU_CARRY_PRECISION.
+        cp = _precision.resolve_carry_precision()
+        self._carry_mode: Optional[str] = None if cp == "auto" else cp
+        self._carry_auto = cp == "auto"
+        #: the last capacity-model consult (capacity/model.py), surfaced
+        #: through GenerationTimeline.summary() as capacity_* keys
+        self.capacity_plan = None
+        #: XLA's own per-device footprint of the last one-dispatch
+        #: program (memory_analysis), captured when a budget is active —
+        #: the bench's "measured" side of the prediction pin
+        self.capacity_measured_bytes = 0
         #: joint (K, max_T, rung) occupancy tuning for fused blocks
         #: (autotune/occupancy.py).  Opt-in: changing K mid-run changes
         #: the device key-split stream, so the default stays the static
@@ -642,7 +660,9 @@ class ABCSMC:
                     m, self._pad_bucket(m, 1, n_pad)))
                 continue
             dim_m = self.parameter_priors[m].dim
-            theta_m = np.asarray(pop.theta)[idx, :dim_m]
+            # pop-ok: host-engine refit on the accepted population
+            # (the fused engines refit in-scan, support-capped)
+            theta_m = np.asarray(pop.theta)[idx, :dim_m]  # pop-ok
             w_m = np.asarray(pop.weight)[idx]
             self.transitions[m].fit(theta_m, w_m)
             bucket = self._pad_bucket(m, idx.size, n_pad)
@@ -1028,6 +1048,82 @@ class ABCSMC:
                 lambda s, o, p: self.distance_function.compute(s, o, p))
         return self._jit_dist_compute
 
+    def _carry_precision(self) -> str:
+        """The concrete at-rest carry mode for program builds and cache
+        keys.  An unresolved ``auto`` reads as f32 (exact) until the
+        first capacity consult pins it; once pinned it stays pinned so
+        every block of a run shares one carry layout."""
+        return self._carry_mode or "f32"
+
+    def _capacity_kwargs(self, engine: str, n: int, B: int) -> dict:
+        mode = self._block_mode()
+        fid = self._fidelity_eligible()
+        shard_fn = getattr(self.sampler, "capacity_shard_devices", None)
+        return dict(
+            population=n, param_dim=self.dim,
+            stat_dim=self.spec.total_size, engine=engine,
+            devices=max(int(shard_fn()) if shard_fn else 1, 1),
+            donate=bool(self._donate_carry) and engine != "sequential",
+            telemetry_lanes=bool(self.telemetry_lanes),
+            wire_stats=bool(getattr(self.sampler, "fetch_stats", False)),
+            models=self.M,
+            support_cap=self.fused_support_cap,
+            record_rows=(self._block_record_rows(B)
+                         if mode["stoch"] else 0),
+            cal_rows=self.fidelity.cal_rows if fid else 0)
+
+    def _capacity_feasible(self, engine: str, n: int):
+        """A ``feasible(K, max_T, B) -> bool`` predicate over the
+        capacity model for the occupancy tuner, or None when no HBM
+        budget is active (the tuner then searches unclamped, exactly
+        the pre-capacity behaviour)."""
+        budget = _capacity.resolved_budget_bytes()
+        if budget <= 0:
+            return None
+        prec = self._carry_precision()
+
+        def feasible(K: int, max_T: int, B: int) -> bool:
+            kw = self._capacity_kwargs(engine, n, B)
+            return _capacity.predict_peak_bytes(
+                batch=B, K=K, max_T=max_T, carry_precision=prec,
+                **kw) <= budget
+
+        return feasible
+
+    def _capacity_consult(self, engine: str, n: int, B: int, K: int,
+                          max_T: int, samp=None):
+        """Consult the HBM capacity model before building a device
+        program (capacity/model.py).  Resolves an ``auto`` carry
+        precision, may SHRINK (B, K, max_T) to the budget, records the
+        plan on the timeline, and raises :class:`CapacityError` with
+        the full ledger when nothing fits.  With no budget active the
+        plan comes back unconstrained and nothing changes — the
+        default path stays bit-identical."""
+        prec = ("auto" if (self._carry_auto and self._carry_mode is None)
+                else self._carry_precision())
+        rounder = getattr(samp, "_round_to_valid_batch", None)
+        kw = self._capacity_kwargs(engine, n, B)
+        plan = _capacity.plan(batch=B, K=K, max_T=max_T,
+                              carry_precision=prec,
+                              round_to_batch=rounder, **kw)
+        if self._carry_auto and self._carry_mode is None:
+            self._carry_mode = plan.carry_precision
+        self.capacity_plan = plan
+        self.timeline.capacity = {
+            "engine": engine, "precision": plan.carry_precision,
+            "batch": plan.batch, "K": plan.K, "max_T": plan.max_T,
+            "devices": plan.devices,
+            "predicted_bytes": plan.predicted_bytes,
+            "budget_bytes": plan.budget_bytes, "note": plan.note}
+        if plan.note == "clamped to fit budget":
+            logger.info(
+                "Capacity: clamped to fit HBM budget %.1f MB -> "
+                "batch=%d K=%d max_T=%d carry_precision=%s "
+                "(predicted %.1f MB)", plan.budget_bytes / 2**20,
+                plan.batch, plan.K, plan.max_T, plan.carry_precision,
+                plan.predicted_mb)
+        return plan
+
     def _seed_block_carry(self, t: int, carry: dict, B: int,
                           rate_est: float, safety: float):
         """Build a fused block's full device carry from either the
@@ -1038,6 +1134,11 @@ class ABCSMC:
         chain's state for ``t`` (caller takes the sequential path)."""
         mode = self._block_mode()
         eps_mode = self._eps_device_config()[0]
+        # a previous block's carry_out arrives at-rest (possibly
+        # compressed, ops/precision.py); seed construction happens in
+        # the f32 window and re-narrows on exit — identity under the
+        # default f32 policy
+        carry = _precision.decode_carry(carry, self._carry_precision())
         n = carry["theta"].shape[0]
         carry_in = {
             "m": carry["m"], "theta": carry["theta"],
@@ -1125,7 +1226,7 @@ class ABCSMC:
             else:
                 carry_in["cal_lo"], carry_in["cal_full"] = \
                     self._fidelity_nan_seed(rows)
-        return carry_in
+        return _precision.encode_carry(carry_in, self._carry_precision())
 
     @staticmethod
     def _fidelity_nan_seed(rows: int):
@@ -1259,12 +1360,13 @@ class ABCSMC:
         # samp._uid: the compiled fn closes over the sampler's round
         # builder (for ShardedSampler that bakes in mesh + axis), so a
         # swapped sampler must never be served a stale program
-        cache_key = ("fused4", self._kernel._uid, samp._uid, B,
+        carry_prec = self._carry_precision()
+        cache_key = ("fused5", self._kernel._uid, samp._uid, B,
                      n, K, d, s_width, eps_mode, alpha, mult, weighted,
                      eps_sketch, wire_stats, wire_m_bits, max_rounds,
                      sup_cap, mode["adaptive"], mode["stoch"],
                      record_rows, pdf_norm, bool(summary), eff_donate,
-                     fid_key)
+                     fid_key, carry_prec)
 
         def build():
             from .distance.kernel import SCALE_LIN
@@ -1330,7 +1432,8 @@ class ABCSMC:
                                   else 1.0),
                 adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
                 summary_lanes=bool(summary), eps_sketch=eps_sketch,
-                fidelity_cfg=fidelity_cfg),
+                fidelity_cfg=fidelity_cfg,
+                carry_precision=carry_prec),
                 **({"donate_argnums": (0,)} if eff_donate else {}))
 
         # block programs live in the sampler's CompiledLadder (one
@@ -1375,13 +1478,29 @@ class ABCSMC:
         occ_max_rounds = None
         if self._occupancy is not None:
             # joint shape: K, round budget and rung chosen TOGETHER
-            # from the decay/timing telemetry instead of independently
+            # from the decay/timing telemetry instead of independently;
+            # the HBM capacity model clamps the search to its feasible
+            # set when a budget is active (capacity/model.py)
             K_j, max_T_j, B_j = self._occupancy.propose(
                 n, max(float(samp._rate_est or 0.0), 1e-6), B,
-                samp._round_to_valid_batch)
+                samp._round_to_valid_batch,
+                feasible=self._capacity_feasible("fused", n))
             K = max(1, min(int(K_j), self.fuse_generations))
             B = int(B_j)
             occ_max_rounds = int(max_T_j)
+        # plan-then-compile: resolve the at-rest precision and shrink
+        # the rung/K to the HBM budget BEFORE anything traces; raises
+        # CapacityError (with the full ledger) when nothing fits
+        cap_plan = self._capacity_consult(
+            "fused", n, B, K,
+            occ_max_rounds or self._block_max_rounds(
+                n, B, rate_est=getattr(samp, "_rate_est", None)),
+            samp=samp)
+        if cap_plan.note == "clamped to fit budget":
+            B = int(cap_plan.batch)
+            K = max(1, min(int(cap_plan.K), K))
+            if occ_max_rounds is not None:
+                occ_max_rounds = int(cap_plan.max_T)
         mode = self._block_mode()
         eps_mode = self._eps_device_config()[0]
         carry_in = self._seed_block_carry(
@@ -1604,19 +1723,23 @@ class ABCSMC:
                 # host-side component state for a sequential continuation
                 prep = Sample()
                 if written == K:
+                    # at-rest (possibly compressed) between dispatches —
+                    # _seed_block_carry decodes on re-entry
                     self._fused_carry = carry_out
                     # the exact f32 accepted buffers of the last written
                     # generation: lets _fit_transitions gather supports
                     # ON device (f32, no re-upload) exactly like the
                     # sequential loop's Sample.device_population
-                    prep.device_population = dict(carry_out)
+                    prep.device_population = dict(_precision.decode_carry(
+                        carry_out, self._carry_precision()))
                     if mode["adaptive"]:
                         # pre-seed the host schedule with the in-scan
                         # refit's weights for t+K — update() then
                         # short-circuits to "changed" and the eps update
                         # sees distances under them (sequential parity)
                         self.distance_function.weights[t + written] = \
-                            np.asarray(carry_out["dist_w"], np.float32)
+                            np.asarray(  # pop-ok: dist_w is s-sized
+                                carry_out["dist_w"], np.float32)
                 else:
                     prep.device_population = None
                 self._prepare_next_iteration(
@@ -1661,13 +1784,14 @@ class ABCSMC:
         lanes_on = bool(self.telemetry_lanes)
         fid_on = self._fidelity_eligible()
         fid_key = self.fidelity.digest_key() if fid_on else None
-        cache_key = ("onedispatch5", self._kernel._uid, samp._uid, B,
+        carry_prec = self._carry_precision()
+        cache_key = ("onedispatch6", self._kernel._uid, samp._uid, B,
                      n, K, max_T, d, s_width, eps_mode, alpha, mult,
                      weighted, eps_sketch, wire_stats, wire_m_bits,
                      max_rounds, sup_cap, mode["adaptive"],
                      mode["stoch"], record_rows, pdf_norm,
                      single_model_stop, bool(summary),
-                     self._donate_carry, lanes_on, fid_key)
+                     self._donate_carry, lanes_on, fid_key, carry_prec)
 
         def build():
             from .autotune.ladder import aot_compile, avals_like
@@ -1727,7 +1851,8 @@ class ABCSMC:
                 adaptive_cfg=adaptive_cfg, stoch_cfg=stoch_cfg,
                 summary_lanes=bool(summary), eps_sketch=eps_sketch,
                 telemetry_lanes=lanes_on, progress=lanes_on,
-                fidelity_cfg=fidelity_cfg),
+                fidelity_cfg=fidelity_cfg,
+                carry_precision=carry_prec),
                 **self._donate_jit_kwargs())
             if aot_args is not None:
                 try:
@@ -1808,6 +1933,36 @@ class ABCSMC:
 
         return fetch
 
+    def _capture_measured_peak(self, fn, args):
+        """XLA's own per-device footprint of the compiled one-dispatch
+        program (``memory_analysis()``: arguments + outputs + temps −
+        donated aliases) — the MEASURED side of the capacity model's
+        prediction pin (``podstar_pop1e8_peak_err_pct``).  Best-effort:
+        older runtimes without the API leave the counter at 0."""
+        try:
+            # unwrap the ladder's AotGuard down to the XLA executable
+            fn = getattr(fn, "_compiled", fn)
+            if hasattr(fn, "memory_analysis"):     # AOT-compiled
+                mem = fn.memory_analysis()
+            elif hasattr(fn, "lower"):
+                # re-lower from avals; with the persistent compilation
+                # cache on this is a disk hit, not a recompile
+                mem = fn.lower(*args).compile().memory_analysis()
+            else:
+                return
+            measured = int(
+                getattr(mem, "argument_size_in_bytes", 0)
+                + getattr(mem, "output_size_in_bytes", 0)
+                + getattr(mem, "temp_size_in_bytes", 0)
+                - getattr(mem, "alias_size_in_bytes", 0))
+            if measured > 0:
+                self.capacity_measured_bytes = measured
+                if self.timeline.capacity is not None:
+                    self.timeline.capacity["measured_bytes"] = measured
+        except Exception as err:  # noqa: BLE001
+            logger.debug("capacity: memory_analysis unavailable (%s)",
+                         err)
+
     def _run_onedispatch(self, t: int, t_max, total_sims: int,
                          max_total_nr_simulations):
         """Execute (up to) the rest of the run in ONE device dispatch —
@@ -1838,6 +1993,17 @@ class ABCSMC:
         if carry["theta"].shape[0] != n:
             return 0, 0, None  # population size changed: classic path
         B = samp.choose_batch(n)
+        max_T = self.onedispatch_max_t
+        # plan-then-compile (capacity/model.py): resolve the at-rest
+        # precision and clamp (B, K, max_T) to the HBM budget before
+        # tracing; CapacityError propagates with the full ledger when
+        # no point fits
+        cap_plan = self._capacity_consult("onedispatch", n, B, K, max_T,
+                                          samp=samp)
+        if cap_plan.note == "clamped to fit budget":
+            B = int(cap_plan.batch)
+            K = max(1, min(int(cap_plan.K), K))
+            max_T = int(cap_plan.max_T)
         mode = self._block_mode()
         eps_mode = self._eps_device_config()[0]
         carry_in = self._seed_block_carry(
@@ -1846,7 +2012,6 @@ class ABCSMC:
         if carry_in is None:
             return 0, 0, None  # seed can't reproduce the chain state
         lazy = self._lazy_active
-        max_T = self.onedispatch_max_t
         i32max = int(np.iinfo(np.int32).max)
         t_limit = (int(np.clip(t_max - t, 1, max_T))
                    if np.isfinite(t_max) else max_T)
@@ -1924,6 +2089,10 @@ class ABCSMC:
             "pyabc_tpu_run_dispatches_total",
             "whole-run device dispatches issued by the orchestrator",
         ).inc()
+        if (cap_plan.budget_bytes > 0
+                or os.environ.get("PYABC_TPU_CAPACITY_MEASURE", "0")
+                in ("1", "true", "yes")):
+            self._capture_measured_peak(fn, args)
         # adopt the advanced key WITHOUT a d2h round-trip — the host
         # never needs its value, only to keep threading it
         self.key = ctl_out["key"]
@@ -2142,10 +2311,12 @@ class ABCSMC:
                     # t_limit hit mid-run: keep the device chain hot so
                     # the next dispatch continues from this frontier
                     self._fused_carry = carry_out
-                    prep.device_population = dict(carry_out)
+                    prep.device_population = dict(_precision.decode_carry(
+                        carry_out, self._carry_precision()))
                     if mode["adaptive"]:
                         self.distance_function.weights[t + written] = \
-                            np.asarray(carry_out["dist_w"], np.float32)
+                            np.asarray(  # pop-ok: dist_w is s-sized
+                                carry_out["dist_w"], np.float32)
                 else:
                     prep.device_population = None
                 self._prepare_next_iteration(
@@ -2593,7 +2764,8 @@ class ABCSMC:
                 if self._fleet is not None:
                     self._fleet.publish(self.timeline)
                 if blk["kind"] == "block":
-                    st["last_dp"] = (dict(blk["carry_out"])
+                    st["last_dp"] = (dict(_precision.decode_carry(
+                        blk["carry_out"], self._carry_precision()))
                                      if written == K else None)
                     if written == K and mode["adaptive"]:
                         # pre-seed the host-side weight schedule with the
@@ -2602,8 +2774,8 @@ class ABCSMC:
                         # sequential generation runs with the fused
                         # chain's weights
                         self.distance_function.weights[blk["t0"] + K] = \
-                            np.asarray(blk["carry_out"]["dist_w"],
-                                       np.float32)
+                            np.asarray(  # pop-ok: dist_w is s-sized
+                                blk["carry_out"]["dist_w"], np.float32)
                 else:
                     st["last_dp"] = blk.get("dp")
             if fallback or st["stop"]:
@@ -2650,7 +2822,7 @@ class ABCSMC:
         temperature-scheme records."""
         from scipy.special import logsumexp
         m = np.asarray(m)
-        theta = np.asarray(theta)
+        theta = np.asarray(theta)  # pop-ok: R temperature records
         all_m = np.arange(self.M)
         # log_pmf(target, source), broadcast to [M_source, R]
         log_jump = np.asarray(self.model_perturbation_kernel.log_pmf(

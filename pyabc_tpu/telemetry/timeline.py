@@ -44,6 +44,12 @@ class GenerationTimeline:
         #: any engine; None while running or when the run exhausted
         #: max_nr_populations without tripping a criterion
         self.stop_reason: Optional[str] = None
+        #: the last HBM capacity-model consult (capacity/model.py):
+        #: dict with engine / precision / batch / K / max_T / devices /
+        #: predicted_bytes / budget_bytes / note (+ measured_bytes when
+        #: XLA's memory_analysis was captured); None when the run never
+        #: consulted — surfaced as flat capacity_* keys in summary()
+        self.capacity: Optional[dict] = None
 
     def record(self, t: int, *, path: str, wall_s: float,
                stages: Optional[dict] = None, eps: Optional[float] = None,
@@ -143,6 +149,17 @@ class GenerationTimeline:
             "history_mode": self.history_mode,
             "stop_reason": self.stop_reason,
         }
+        if self.capacity is not None:
+            # the capacity consult, flattened to bench-line scalars
+            cap = self.capacity
+            out["capacity_precision"] = cap.get("precision")
+            out["capacity_predicted_mb"] = round(
+                cap.get("predicted_bytes", 0) / 2**20, 3)
+            out["capacity_budget_mb"] = round(
+                cap.get("budget_bytes", 0) / 2**20, 3)
+            if cap.get("measured_bytes"):
+                out["capacity_measured_mb"] = round(
+                    cap["measured_bytes"] / 2**20, 3)
         # per-phase medians over the rows that carry lane attribution
         # (onedispatch runs with telemetry lanes on); absent otherwise
         ph_keys = sorted({k for r in rows for k in r

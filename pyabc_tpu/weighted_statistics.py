@@ -24,6 +24,53 @@ def _xp(*arrays):
     return jnp
 
 
+#: population size above which even an "exact" quantile request routes
+#: through a sort-free path (device: the histogram sketch; host: the
+#: iterated-histogram refinement below) — the HBM ladder's
+#: no-materialization rule: at pop 1e8 a sorted copy is 400 MB and the
+#: O(N log N) sort dominates the eps update, while the sketch's bracket
+#: error is below the schedule's own quantization.  At or below the cap
+#: (a STATIC shape check) nothing changes: sub-cap programs and every
+#: tier-1 population stay byte-identical to the pre-cap path.
+POP_MATERIALIZE_CAP = 1 << 20
+
+
+def _np_sketch_quantile(points, weights, alpha, bins: int = 4096,
+                        passes: int = 3):
+    """Host mirror of :func:`ops.quantile_sketch.sketch_weighted_quantile`:
+    iterated fixed-bin histogram refinement via ``np.bincount`` — O(N)
+    per pass, no sorted copy of the population.  Bracket width after p
+    passes is ``range / bins**p`` (~1e-11 relative at the defaults)."""
+    points = np.asarray(points, np.float64).ravel()
+    if weights is None:
+        weights = np.full(points.shape, 1.0 / points.shape[0])
+    weights = np.asarray(weights, np.float64).ravel()
+    finite = np.isfinite(points)
+    if not finite.all():
+        points, weights = points[finite], weights[finite]
+    total = float(np.sum(weights))
+    if points.size == 0 or total <= 0:
+        return np.float64(np.nan)
+    lo, hi = float(np.min(points)), float(np.max(points))
+    below = 0.0
+    target = float(alpha) * total
+    for _ in range(passes):
+        width = max((hi - lo) / bins, 1e-300)
+        sel = (points >= lo) & (points <= hi)
+        idx = np.clip(((points[sel] - lo) / width).astype(np.int64),
+                      0, bins - 1)
+        hist = np.bincount(idx, weights=weights[sel], minlength=bins)
+        cdf = below + np.cumsum(hist)
+        b = int(np.searchsorted(cdf, target, side="left"))
+        b = min(b, bins - 1)
+        if b > 0:
+            below = float(cdf[b - 1])
+        new_lo = lo + b * width
+        hi = lo + (b + 1) * width
+        lo = new_lo
+    return np.float64(0.5 * (lo + hi))
+
+
 def weighted_quantile(points: Array, weights: Array = None, alpha: float = 0.5,
                       method: str = "exact") -> Array:
     """Weighted ``alpha``-quantile (reference: weighted_statistics.py:27-43).
@@ -39,14 +86,22 @@ def weighted_quantile(points: Array, weights: Array = None, alpha: float = 0.5,
     ``sketch_error_bound`` of the inverse CDF.  Host (numpy) inputs
     always take the exact path: the control plane calls this once per
     generation, where a sort is free and exactness is the point.
+
+    Above :data:`POP_MATERIALIZE_CAP` points, BOTH methods route
+    sort-free (device sketch / host iterated histogram): the ladder
+    never builds a sorted pop-1e8 vector, whatever the caller asked
+    for.  The check is static shape, so sub-cap calls are untouched.
     """
     xp = _xp(points, weights)
-    if method == "sketch" and xp is jnp:
-        from .ops.quantile_sketch import sketch_weighted_quantile
-        return sketch_weighted_quantile(points, weights, alpha)
     if method not in ("exact", "sketch"):
         raise ValueError(f"unknown quantile method {method!r}")
     points = xp.asarray(points)
+    over_cap = int(points.shape[0]) > POP_MATERIALIZE_CAP
+    if xp is jnp and (method == "sketch" or over_cap):
+        from .ops.quantile_sketch import sketch_weighted_quantile
+        return sketch_weighted_quantile(points, weights, alpha)
+    if over_cap:
+        return _np_sketch_quantile(points, weights, alpha)
     if weights is None:
         weights = xp.full(points.shape, 1.0 / points.shape[0])
     weights = weights / xp.sum(weights)

@@ -330,6 +330,12 @@ class DeviceRunStore:
         self.hydrations = 0
         #: optional write-ahead SpillJournal (resilience/journal.py)
         self.journal = None
+        #: the run's at-rest carry policy (ops/precision.py) — recorded
+        #: so a resumed run's durability ledger names the precision the
+        #: device-resident state was produced under; the wire itself is
+        #: always the f16 narrow coding regardless
+        from ..ops.precision import resolve_carry_precision
+        self.carry_precision = resolve_carry_precision()
 
     def attach_journal(self, journal):
         """Arm the durability contract: deposits write-ahead manifest
@@ -576,6 +582,7 @@ class DeviceRunStore:
                 "max_gens": self.max_gens,
                 "deposits": self.deposits,
                 "evictions": self.evictions,
+                "carry_precision": self.carry_precision,
                 "resident": [
                     {k: e[k] for k in ("t", "n", "count", "eps", "norm",
                                        "nbytes")}

@@ -1,0 +1,590 @@
+"""The HBM capacity model, the at-rest carry codec, and budget-clamped
+planning (capacity/ tentpole; docs/performance.md "The HBM ladder").
+
+Pins, in order: the byte arithmetic of every ledger column against
+hand-computed values (three engines, every lane toggle), budget
+resolution, the plan search order (exactness first: geometry clamps
+before the precision ladder narrows), the completability constraint
+(a clamped geometry must still be able to FILL the population within
+its round budget), the full CapacityError payload incl. the precision
+hint, the bf16/int8 carry codec (round-trip, idempotence, aux-key
+layout, determinism), the occupancy tuner's capacity clamp (a tight
+budget shrinks the rung instead of OOMing), end-to-end runs where an
+f32 plan provably cannot fit but the auto ladder completes compressed,
+f32 bit-identity with the env unset, and — in the slow battery — the
+4-seed posterior gate of the bf16 carry on SIR and Lotka-Volterra.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.autotune.occupancy import OccupancyTuner
+from pyabc_tpu.capacity import (
+    ROUND_HEADROOM,
+    CapacityError,
+    ledger,
+    parse_bytes,
+    plan,
+    predict_peak_bytes,
+    resolved_budget_bytes,
+)
+from pyabc_tpu.models import (
+    make_lotka_volterra_problem,
+    make_sir_problem,
+    make_two_gaussians_problem,
+)
+from pyabc_tpu.ops.precision import (
+    CARRY_COMPRESSED_LANES,
+    decode_carry,
+    encode_carry,
+    resolve_carry_precision,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_capacity_env(monkeypatch):
+    """No capacity/codec knob may leak between tests — the carry mode
+    enters compile-cache keys and the budget changes plan results."""
+    for var in ("PYABC_TPU_HBM_BUDGET", "PYABC_TPU_HBM_HEADROOM",
+                "PYABC_TPU_CARRY_PRECISION",
+                "PYABC_TPU_CAPACITY_MEASURE"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# parse_bytes / budget resolution
+# ---------------------------------------------------------------------------
+
+def test_parse_bytes():
+    assert parse_bytes("12G") == 12 * 1024 ** 3
+    assert parse_bytes("900M") == 900 * 1024 ** 2
+    assert parse_bytes("64k") == 64 * 1024
+    assert parse_bytes("2T") == 2 * 1024 ** 4
+    assert parse_bytes("1.5G") == int(1.5 * 1024 ** 3)
+    assert parse_bytes("2GiB") == 2 * 1024 ** 3
+    assert parse_bytes("512mb") == 512 * 1024 ** 2
+    assert parse_bytes("123") == 123
+    assert parse_bytes(4096) == 4096
+    assert parse_bytes(2.5) == 2
+    assert parse_bytes("") == 0
+    with pytest.raises(ValueError, match="PYABC_TPU_HBM_BUDGET"):
+        parse_bytes("12 gigs")
+
+
+def test_resolved_budget_env_verbatim(monkeypatch):
+    monkeypatch.setenv("PYABC_TPU_HBM_BUDGET", "2M")
+    assert resolved_budget_bytes() == 2 * 1024 ** 2
+
+
+def test_resolved_budget_cpu_is_unconstrained():
+    # CPU backends report no bytes_limit: budget 0, every plan fits
+    assert resolved_budget_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# ledger arithmetic (hand-computed bytes)
+# ---------------------------------------------------------------------------
+
+_SHAPE = dict(population=1000, param_dim=2, stat_dim=4, batch=256,
+              K=3, max_T=32)
+
+
+def test_ledger_fused_f32_hand_computed():
+    led = ledger(engine="fused", carry_precision="f32", **_SHAPE)
+    # carry row: m(4) + log_weight(4) + 4*(d + 1 + s) = 36 bytes
+    assert led["carry_at_rest"] == 1000 * 36
+    # accept window: (n + B) rows at the full f32 promotion width
+    assert led["accept_window"] == (1000 + 256) * 36
+    # round workspace: B * 4 * (d + s + 3) * sim_mult(4)
+    assert led["round_batch"] == 256 * 4 * 9 * 4
+    # K wire slots of f16 lanes: 2d + 3 per row
+    assert led["wire_egress"] == 3 * 1000 * 7
+    # refit support: models * n * (4d + 8), NOT device-divided
+    assert led["refit_support"] == 1000 * 16
+    assert led["record_ring"] == 0
+    assert led["fidelity_rings"] == 0
+    assert led["telemetry"] == 0
+    assert predict_peak_bytes(
+        engine="fused", carry_precision="f32", **_SHAPE) == \
+        sum(led.values())
+
+
+def test_ledger_onedispatch_slots_are_max_t():
+    led = ledger(engine="onedispatch", carry_precision="f32", **_SHAPE)
+    assert led["wire_egress"] == 32 * 1000 * 7
+    # every other column matches the fused layout
+    fused = ledger(engine="fused", carry_precision="f32", **_SHAPE)
+    for col in led:
+        if col != "wire_egress":
+            assert led[col] == fused[col]
+
+
+def test_ledger_sequential_double_buffers_and_forces_f32():
+    led = ledger(engine="sequential", carry_precision="bf16", **_SHAPE)
+    # the host loop re-uploads per generation (x2) and never stores a
+    # compressed carry: the bf16 request reads as f32
+    assert led["carry_at_rest"] == 2 * 1000 * 36
+    assert led["wire_egress"] == 0
+
+
+def test_ledger_precision_narrows_only_the_bulk():
+    bf16 = ledger(engine="fused", carry_precision="bf16", **_SHAPE)
+    int8 = ledger(engine="fused", carry_precision="int8", **_SHAPE)
+    # bulk row at width w: 4 + 4 + w * (d + 1 + s)
+    assert bf16["carry_at_rest"] == 1000 * (8 + 2 * 7)
+    assert int8["carry_at_rest"] == 1000 * (8 + 1 * 7)
+    # the accept window is the f32 promotion width — incompressible
+    f32 = ledger(engine="fused", carry_precision="f32", **_SHAPE)
+    assert bf16["accept_window"] == f32["accept_window"]
+    assert int8["accept_window"] == f32["accept_window"]
+
+
+def test_ledger_lane_toggles():
+    base = ledger(engine="fused", carry_precision="f32", **_SHAPE)
+    no_donate = ledger(engine="fused", carry_precision="f32",
+                       donate=False, **_SHAPE)
+    assert no_donate["carry_at_rest"] == 2 * base["carry_at_rest"]
+    tel = ledger(engine="fused", carry_precision="f32",
+                 telemetry_lanes=True, **_SHAPE)
+    assert tel["telemetry"] == 4096
+    ws = ledger(engine="fused", carry_precision="f32", wire_stats=True,
+                **_SHAPE)
+    assert ws["wire_egress"] == 3 * 1000 * (7 + 2 * 4)
+    m3 = ledger(engine="fused", carry_precision="f32", models=3,
+                **_SHAPE)
+    assert m3["refit_support"] == 3 * base["refit_support"]
+    capped = ledger(engine="fused", carry_precision="f32",
+                    support_cap=100, **_SHAPE)
+    assert capped["refit_support"] == 100 * 16
+    rr = ledger(engine="fused", carry_precision="f32", record_rows=10,
+                **_SHAPE)
+    assert rr["record_ring"] == 10 * (4 * 2 + 16)
+    cal = ledger(engine="fused", carry_precision="f32", cal_rows=5,
+                 **_SHAPE)
+    assert cal["fidelity_rings"] == 2 * 5 * 8
+
+
+def test_ledger_devices_divide_population_not_support():
+    led = ledger(engine="fused", carry_precision="f32", devices=4,
+                 **_SHAPE)
+    assert led["carry_at_rest"] == 250 * 36
+    assert led["accept_window"] == (250 + 64) * 36
+    assert led["round_batch"] == 64 * 4 * 9 * 4
+    assert led["wire_egress"] == 3 * 250 * 7
+    # refit support rows are replicated per device for the KDE
+    # cross-product — never divided
+    assert led["refit_support"] == 1000 * 16
+
+
+def test_ledger_rejects_auto_and_unknown_engine():
+    with pytest.raises(ValueError, match="concrete carry_precision"):
+        ledger(engine="fused", carry_precision="auto", **_SHAPE)
+    with pytest.raises(ValueError, match="unknown engine"):
+        ledger(engine="warp", carry_precision="f32", **_SHAPE)
+
+
+# ---------------------------------------------------------------------------
+# plan(): search order, clamping, completability, CapacityError
+# ---------------------------------------------------------------------------
+
+_PLAN_KW = dict(population=4096, param_dim=2, stat_dim=4,
+                engine="onedispatch")
+
+
+def _mins(**overrides):
+    """Per-precision completable minima via the 1-byte-budget probe —
+    the same protocol the podstar_pop1e8 bench workers use."""
+    out = {}
+    for prec in ("f32", "bf16"):
+        kw = dict(_PLAN_KW, batch=8192, K=4, max_T=32, budget=1,
+                  carry_precision=prec)
+        kw.update(overrides)
+        with pytest.raises(CapacityError) as ei:
+            plan(**kw)
+        out[prec] = int(ei.value.predicted)
+    return out
+
+
+def test_plan_unconstrained_returns_request_verbatim():
+    p = plan(batch=8192, K=4, max_T=32, carry_precision="auto",
+             budget=0, **_PLAN_KW)
+    assert (p.carry_precision, p.batch, p.K, p.max_T) == \
+        ("f32", 8192, 4, 32)
+    assert p.note == "unconstrained"
+    assert p.budget_bytes == 0
+
+
+def test_plan_fits_as_requested_under_generous_budget():
+    p = plan(batch=8192, K=4, max_T=32, carry_precision="f32",
+             budget=10 ** 12, **_PLAN_KW)
+    assert (p.batch, p.K, p.max_T) == (8192, 4, 32)
+    assert p.note == "fits as requested"
+    assert p.predicted_bytes == predict_peak_bytes(
+        batch=8192, K=4, max_T=32, carry_precision="f32", **_PLAN_KW)
+
+
+def test_plan_clamps_geometry_before_narrowing_precision():
+    full = predict_peak_bytes(batch=8192, K=4, max_T=32,
+                              carry_precision="f32", **_PLAN_KW)
+    p = plan(batch=8192, K=4, max_T=32, carry_precision="auto",
+             budget=full - 1, **_PLAN_KW)
+    # exactness first: the budget only just excludes the requested
+    # geometry, so a smaller f32 point must win before bf16 is tried
+    assert p.carry_precision == "f32"
+    assert p.note == "clamped to fit budget"
+    assert (p.batch, p.K, p.max_T) != (8192, 4, 32)
+    assert p.predicted_bytes <= full - 1
+
+
+def test_plan_auto_descends_to_bf16_at_discriminating_budget():
+    mins = _mins()
+    assert 0 < mins["bf16"] < mins["f32"]
+    budget = (mins["f32"] + mins["bf16"]) // 2
+    p = plan(batch=8192, K=4, max_T=32, carry_precision="auto",
+             budget=budget, **_PLAN_KW)
+    assert p.carry_precision == "bf16"
+    assert p.note == "clamped to fit budget"
+    assert p.predicted_bytes <= budget
+
+
+def test_plan_never_emits_an_uncompletable_geometry():
+    mins = _mins()
+    budget = (mins["f32"] + mins["bf16"]) // 2
+    p = plan(batch=8192, K=4, max_T=32, carry_precision="auto",
+             budget=budget, **_PLAN_KW)
+    need = math.ceil(ROUND_HEADROOM * _PLAN_KW["population"] / p.batch)
+    assert need <= p.max_T
+
+
+def test_plan_raises_when_no_geometry_can_fill_the_population():
+    # batch rungs floor at min(batch, 256): no (256, <=8) point can
+    # propose 4x the population, whatever the byte budget
+    with pytest.raises(CapacityError, match="can fill population"):
+        plan(population=100_000, param_dim=2, stat_dim=4,
+             engine="onedispatch", batch=256, K=1, max_T=8,
+             budget=10 ** 12, carry_precision="f32")
+
+
+def test_capacity_error_payload_and_hint():
+    mins = _mins()
+    budget = (mins["f32"] + mins["bf16"]) // 2
+    with pytest.raises(CapacityError) as ei:
+        plan(batch=8192, K=4, max_T=32, carry_precision="f32",
+             budget=budget, **_PLAN_KW)
+    err = ei.value
+    assert err.budget == budget
+    assert err.predicted == mins["f32"]
+    assert err.request["carry_precision"] == "f32"
+    assert err.request["engine"] == "onedispatch"
+    assert set(err.ledger) == {
+        "carry_at_rest", "accept_window", "round_batch", "wire_egress",
+        "refit_support", "record_ring", "fidelity_rings", "telemetry"}
+    assert "PYABC_TPU_CARRY_PRECISION=bf16 would fit" in err.hint
+    # the rendered message carries the ledger and the hint
+    assert "carry_at_rest" in str(err)
+    assert "hint:" in str(err)
+
+
+def test_plan_snaps_rungs_through_the_sampler_rounder():
+    mins = _mins()
+    budget = (mins["f32"] + mins["bf16"]) // 2
+
+    def rounder(b):
+        return max((int(b) // 512) * 512, 512)
+
+    p = plan(batch=8192, K=4, max_T=32, carry_precision="auto",
+             budget=budget * 2, round_to_batch=rounder, **_PLAN_KW)
+    assert p.batch % 512 == 0
+
+
+# ---------------------------------------------------------------------------
+# the at-rest carry codec
+# ---------------------------------------------------------------------------
+
+def _carry(n=64, d=3, s=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "m": jnp.asarray(rng.integers(0, 2, n), jnp.int32),
+        "log_weight": jnp.asarray(rng.normal(size=n), jnp.float32),
+        "theta": jnp.asarray(rng.normal(size=(n, d)) * 10.0,
+                             jnp.float32),
+        "distance": jnp.asarray(rng.uniform(0.0, 5.0, n), jnp.float32),
+        "stats": jnp.asarray(rng.normal(size=(n, s)), jnp.float32),
+        "count": jnp.int32(n),
+    }
+
+
+def test_codec_f32_is_identity_same_object():
+    c = _carry()
+    assert encode_carry(c, "f32") is c
+    assert decode_carry(c, "f32") is c
+
+
+def test_codec_bf16_round_trip_and_untouched_lanes():
+    c = _carry()
+    enc = encode_carry(c, "bf16")
+    for k in CARRY_COMPRESSED_LANES:
+        assert enc[k].dtype == jnp.bfloat16
+    # accumulator lanes never narrow — same objects, no new ops
+    assert enc["m"] is c["m"]
+    assert enc["log_weight"] is c["log_weight"]
+    assert enc["count"] is c["count"]
+    dec = decode_carry(enc, "bf16")
+    for k in CARRY_COMPRESSED_LANES:
+        assert dec[k].dtype == jnp.float32
+        expect = np.asarray(c[k]).astype(jnp.bfloat16).astype(np.float32)
+        assert np.array_equal(np.asarray(dec[k]), expect)
+    # idempotent: an already-encoded lane passes through untouched
+    assert encode_carry(enc, "bf16")["theta"] is enc["theta"]
+    assert decode_carry(dec, "bf16")["theta"] is dec["theta"]
+
+
+def test_codec_int8_aux_keys_and_error_bound():
+    c = _carry()
+    enc = encode_carry(c, "int8")
+    for k in CARRY_COMPRESSED_LANES:
+        assert enc[k].dtype == jnp.int8
+        # flat per-column aux (NOT population-sized, so the pod
+        # sharding pin leaves them replicated)
+        assert enc[k + "_qs"].dtype == jnp.float32
+        assert enc[k + "_qs"].shape == np.asarray(c[k]).shape[1:]
+        assert enc[k + "_qm"].shape == np.asarray(c[k]).shape[1:]
+    dec = decode_carry(enc, "int8")
+    for k in CARRY_COMPRESSED_LANES:
+        assert k + "_qs" not in dec and k + "_qm" not in dec
+        x = np.asarray(c[k], np.float64)
+        span = x.max(axis=0) - x.min(axis=0)
+        err = np.abs(np.asarray(dec[k], np.float64) - x)
+        # affine 255-level grid: error bounded by one step
+        assert np.all(err <= span / 254.0 + 1e-6)
+    # idempotent re-encode keeps the quantized lanes and aux as-is
+    enc2 = encode_carry(enc, "int8")
+    assert enc2["theta"] is enc["theta"]
+    assert enc2["theta_qs"] is enc["theta_qs"]
+
+
+def test_codec_int8_clamps_non_finite_to_column_floor():
+    c = _carry()
+    theta = np.asarray(c["theta"]).copy()
+    theta[3, 1] = np.inf
+    c["theta"] = jnp.asarray(theta)
+    dec = decode_carry(encode_carry(c, "int8"), "int8")
+    out = np.asarray(dec["theta"])
+    assert np.all(np.isfinite(out))
+    finite_lo = theta[np.isfinite(theta[:, 1]), 1].min()
+    assert out[3, 1] == pytest.approx(finite_lo, abs=1e-5)
+
+
+def test_codec_is_deterministic():
+    for mode in ("bf16", "int8"):
+        a = encode_carry(_carry(seed=7), mode)
+        b = encode_carry(_carry(seed=7), mode)
+        for k in a:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), \
+                (mode, k)
+
+
+def test_codec_rejects_unknown_modes():
+    with pytest.raises(ValueError, match="bad mode"):
+        encode_carry(_carry(), "f16")
+    with pytest.raises(ValueError, match="bad mode"):
+        decode_carry(_carry(), "f64")
+
+
+def test_resolve_carry_precision(monkeypatch):
+    assert resolve_carry_precision() == "f32"
+    monkeypatch.setenv("PYABC_TPU_CARRY_PRECISION", "bf16")
+    assert resolve_carry_precision() == "bf16"  # re-read, never cached
+    assert resolve_carry_precision("int8") == "int8"  # arg wins
+    with pytest.raises(ValueError, match="PYABC_TPU_CARRY_PRECISION"):
+        resolve_carry_precision("fp8")
+
+
+# ---------------------------------------------------------------------------
+# occupancy tuner: capacity clamp (a tight budget shrinks the rung)
+# ---------------------------------------------------------------------------
+
+def _pow2_rung(b):
+    return max(256, 1 << int(round(math.log2(max(float(b), 1.0)))))
+
+
+def test_occupancy_fallback_shrinks_rung_to_feasible_set():
+    tuner = OccupancyTuner(k_max=4)
+    K, max_T, B = tuner.propose(
+        n=8192, rate=0.5, B0=4096, round_to_rung=_pow2_rung,
+        feasible=lambda K, T, B: B <= 1024)
+    # no scored candidate fits (rungs explored: 2048/4096/8192), so the
+    # fallback clamps through shrinking rungs instead of returning a
+    # shape the device would OOM on
+    assert (K, max_T, B) == (1, tuner.t_choices[-1], 1024)
+
+
+def test_occupancy_scores_only_inside_the_feasible_set():
+    tuner = OccupancyTuner(k_max=4)
+    # telemetry so scoring has real rho/timing estimates
+    tuner.observe_block(K=2, B=4096, rounds_per_gen=[4, 6],
+                        wall_s=1.0, written=2)
+    K, max_T, B = tuner.propose(
+        n=8192, rate=0.5, B0=4096, round_to_rung=_pow2_rung,
+        feasible=lambda K, T, B: B <= 2048)
+    assert B == 2048
+    K2, _, B2 = tuner.propose(
+        n=8192, rate=0.5, B0=4096, round_to_rung=_pow2_rung)
+    assert B2 in (2048, 4096, 8192)  # unclamped search unchanged
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: budget-clamped runs on the fused engine
+# ---------------------------------------------------------------------------
+
+def _abc(pop=256, fuse=2, seed=0, **kwargs):
+    models, priors, distance, observed, posterior_fn = \
+        make_two_gaussians_problem()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    eps=pt.ConstantEpsilon(0.3),
+                    sampler=pt.VectorizedSampler(),
+                    fuse_generations=fuse, seed=seed, **kwargs)
+    abc.new("sqlite://", observed)
+    return abc
+
+
+#: one unconstrained default-config reference run, shared lazily by
+#: the tests below (the autouse fixture guarantees a clean env at
+#: every entry, so whichever test builds it first sees the default)
+_REF = {}
+
+
+def _ref_run():
+    if not _REF:
+        abc = _abc()
+        h = abc.run(max_nr_populations=3)
+        df, w = h.get_distribution(m=0)
+        _REF["cap"] = dict(abc.timeline.capacity)
+        _REF["dist"] = (df.to_numpy(), np.asarray(w))
+    return _REF
+
+
+def _fused_mins(abc, n):
+    samp = abc.sampler
+    B = samp.choose_batch(n)
+    kw = abc._capacity_kwargs("fused", n, B)
+    out = {}
+    for prec in ("f32", "bf16"):
+        with pytest.raises(CapacityError) as ei:
+            plan(batch=B, K=abc.fuse_generations, max_T=32, budget=1,
+                 carry_precision=prec, **kw)
+        out[prec] = int(ei.value.predicted)
+    return out
+
+
+def test_tight_budget_clamps_rung_and_run_completes(monkeypatch):
+    # unconstrained reference: what the consult would request
+    cap_ref = _ref_run()["cap"]
+    assert cap_ref["note"] == "unconstrained"
+    # regression (occupancy satellite): one byte under the requested
+    # geometry's footprint must shrink the shape, not OOM or bounce
+    monkeypatch.setenv("PYABC_TPU_HBM_BUDGET",
+                       str(cap_ref["predicted_bytes"] - 1))
+    abc = _abc()
+    h = abc.run(max_nr_populations=3)
+    cap = abc.timeline.capacity
+    assert cap["note"] == "clamped to fit budget"
+    assert (cap["batch"], cap["K"], cap["max_T"]) != \
+        (cap_ref["batch"], cap_ref["K"], cap_ref["max_T"])
+    assert cap["predicted_bytes"] < cap_ref["predicted_bytes"]
+    assert len(h.get_all_populations()) == 4  # prior + 3 generations
+
+
+def test_f32_raises_where_auto_completes_compressed(monkeypatch):
+    probe = _abc()
+    mins = _fused_mins(probe, 256)
+    assert 0 < mins["bf16"] < mins["f32"]
+    budget = (mins["f32"] + mins["bf16"]) // 2
+    monkeypatch.setenv("PYABC_TPU_HBM_BUDGET", str(budget))
+
+    # pinned f32: no geometry fits — the error names the mode that would
+    monkeypatch.setenv("PYABC_TPU_CARRY_PRECISION", "f32")
+    with pytest.raises(CapacityError) as ei:
+        _abc().run(max_nr_populations=3)
+    assert "PYABC_TPU_CARRY_PRECISION=bf16" in (ei.value.hint or "")
+
+    # auto: the planner narrows the carry and the run completes
+    monkeypatch.setenv("PYABC_TPU_CARRY_PRECISION", "auto")
+    abc = _abc()
+    h = abc.run(max_nr_populations=3)
+    assert abc.timeline.capacity["precision"] == "bf16"
+    assert abc._carry_mode == "bf16"
+    assert len(h.get_all_populations()) == 4
+
+
+def test_f32_env_is_bit_identical_to_default(monkeypatch):
+    df0, w0 = _ref_run()["dist"]
+    monkeypatch.setenv("PYABC_TPU_CARRY_PRECISION", "f32")
+    h1 = _abc().run(max_nr_populations=3)
+    df1, w1 = h1.get_distribution(m=0)
+    # the f32 codec is the same-object identity: explicit f32 must be
+    # bit-for-bit the default program, not merely statistically close
+    assert np.array_equal(df0, df1.to_numpy())
+    assert np.array_equal(w0, np.asarray(w1))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_compressed_runs_complete_and_are_deterministic(
+        mode, monkeypatch):
+    monkeypatch.setenv("PYABC_TPU_CARRY_PRECISION", mode)
+    dists = []
+    for _ in range(2):
+        h = _abc(seed=5).run(max_nr_populations=3)
+        dists.append(h.get_distribution(m=0))
+    (df0, w0), (df1, w1) = dists
+    assert np.array_equal(df0.to_numpy(), df1.to_numpy())
+    assert np.array_equal(np.asarray(w0), np.asarray(w1))
+
+
+# ---------------------------------------------------------------------------
+# slow battery: the 4-seed posterior gate of the bf16 carry
+# ---------------------------------------------------------------------------
+
+def _posterior_moments(problem_factory, pop, gens, seed, fuse=4):
+    models, priors, distance, observed = problem_factory()
+    abc = pt.ABCSMC(models, priors, distance, population_size=pop,
+                    eps=pt.MedianEpsilon(), fuse_generations=fuse,
+                    seed=seed)
+    abc.new("sqlite://", observed)
+    h = abc.run(max_nr_populations=gens)
+    df, w = h.get_distribution(m=0)
+    w = np.asarray(w, np.float64)
+    cols = sorted(df.columns)
+    x = np.stack([df[c].to_numpy(np.float64) for c in cols], axis=1)
+    mean = w @ x
+    std = np.sqrt(np.maximum(w @ (x - mean) ** 2, 1e-30))
+    return mean, std
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("problem", [make_sir_problem,
+                                     make_lotka_volterra_problem],
+                         ids=["sir", "lotka_volterra"])
+def test_bf16_carry_posterior_gate(problem, seed, monkeypatch):
+    """The compressed at-rest carry must leave the posterior intact:
+    same problem, same seed, f32 vs bf16 carries — the per-parameter
+    posterior means may differ only at Monte-Carlo scale (a fraction
+    of the posterior spread), across 4 independent seeds on both the
+    SIR tau-leap and the Lotka-Volterra SDE problems."""
+    pop, gens = (2000, 6) if problem is make_sir_problem else (1000, 5)
+    monkeypatch.setenv("PYABC_TPU_CARRY_PRECISION", "f32")
+    mean_f32, std_f32 = _posterior_moments(problem, pop, gens, seed)
+    monkeypatch.setenv("PYABC_TPU_CARRY_PRECISION", "bf16")
+    mean_bf16, std_bf16 = _posterior_moments(problem, pop, gens, seed)
+    scale = np.maximum(std_f32, 1e-3)
+    assert np.all(np.abs(mean_bf16 - mean_f32) <= 0.5 * scale), (
+        mean_f32, mean_bf16, std_f32)
+    # the spread itself must not collapse or explode under compression
+    assert np.all(std_bf16 <= 2.0 * std_f32 + 1e-3)
+    assert np.all(std_bf16 >= 0.33 * std_f32 - 1e-3)

@@ -52,14 +52,14 @@ def test_repo_tree_is_clean():
 
 
 def test_ten_rules_registered():
-    assert len(ALL_RULES) == 17
+    assert len(ALL_RULES) == 18
     assert set(ALL_RULES) == {
         "wire-chokepoint", "no-inline-jit", "retry-sites",
         "fused-eligibility", "span-pairs", "fault-sites",
         "host-sync", "lock-discipline", "prng-keys", "env-drift",
         "sort-discipline", "precision-policy", "collective-discipline",
         "study-isolation", "claim-discipline", "event-discipline",
-        "fidelity-discipline"}
+        "fidelity-discipline", "pop-materialization"}
 
 
 # ---------------------------------------------------------------------------
@@ -491,6 +491,33 @@ def test_sort_discipline_scope_and_suppress(tmp_path):
     assert [(path, lineno) for path, lineno, _ in got] == [
         ("ops/hot.py", 2), ("ops/hot.py", 3), ("ops/hot.py", 6),
         ("weighted_statistics.py", 1)]
+
+
+def test_pop_materialization_scope_and_cooccurrence(tmp_path):
+    """A materializer flags only when the line names a population lane
+    AND sits in the engine surface; scalar asarray, host modules, and
+    both suppression spellings never flag."""
+    from tools.lint.rules import pop_materialization as mod
+    pkg = tmp_path / "pkg"
+    (pkg / "sampler").mkdir(parents=True)
+    (pkg / "epsilon").mkdir()
+    (pkg / "sampler" / "hot.py").write_text(
+        "import numpy as np\n"
+        "a = np.asarray(carry_out['theta'])\n"
+        "b = np.argsort(theta[:, 0])\n"
+        "c = jax.device_get(carry['log_weight'])\n"
+        "eps = np.asarray(eps_scalar)\n"
+        "ok = np.asarray(carry_out['theta'])  # pop-ok\n"
+        "# a comment naming np.asarray(carry) is not a violation\n")
+    # host-side modules may materialize freely — out of scope
+    (pkg / "epsilon" / "cold.py").write_text(
+        "import numpy as np\nq = np.sort(np.asarray(theta))\n")
+    (pkg / "smc.py").write_text(
+        "w = np.asarray(device_population['log_weight'])\n")
+    got = mod.check(root=str(pkg))
+    assert [(path, lineno) for path, lineno, _ in got] == [
+        ("sampler/hot.py", 2), ("sampler/hot.py", 3),
+        ("sampler/hot.py", 4), ("smc.py", 1)]
 
 
 def test_study_isolation_scope_and_semantics(tmp_path):

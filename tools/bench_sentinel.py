@@ -92,6 +92,21 @@ WATCHED = (
     # host sync crept back in.  50 % slack absorbs scheduler jitter on
     # the small setup/teardown constant it prices.
     ("podstar_pop1e7_collective_s_per_gen", "lower", 0.50),
+    # the HBM-ladder pod row (bench_podstar_pop1e8): the capacity
+    # contract is binary — every host must prove the unplanned f32 run
+    # infeasible under the discriminating budget AND complete under a
+    # compressed plan that sits inside it; any hole reads nonzero
+    ("podstar_pop1e8_capacity_violations", "zero", 0.0),
+    # the capacity model is only load-bearing while it tracks XLA's
+    # reality: the population-proportional slope of predicted vs
+    # memory_analysis()-measured peak must agree within an ABSOLUTE
+    # 15 % — no trajectory reference, the limit is a contract
+    ("podstar_pop1e8_peak_err_pct", "ceiling", 15.0),
+    # ... and the compressed-carry footprint itself fails high on
+    # trajectory (with the _MB_SLACK floor): a decode that stops
+    # re-encoding, or a lane dropped from the codec, shows up here
+    # before it shows up as an OOM at pop 1e8
+    ("podstar_pop1e8_measured_peak_mb", "lower", 0.10),
     # serving-tier throughput (bench_serve, serve/worker.py): the
     # multi-tenant study mix through one warm worker — fails low when
     # warm-engine reuse, the study axis or the content cache stops

@@ -1,0 +1,13 @@
+import jax
+import numpy as np
+
+
+def harvest(carry_out):
+    theta = np.asarray(carry_out["theta"])
+    order = np.argsort(theta[:, 0])
+    pulled = jax.device_get(carry_out["log_weight"])
+    return theta[order], pulled
+
+
+def snapshot(device_population):
+    return np.array(device_population["theta"])

@@ -1,0 +1,16 @@
+import jax
+import numpy as np
+
+
+def harvest(carry_out):
+    theta = np.asarray(carry_out["theta"])  # pop-ok: final-pop egress
+    order = np.argsort(theta[:, 0])  # graftlint: allow(pop-materialization)
+    pulled = jax.device_get(carry_out["log_weight"])  # pop-ok
+    # a comment naming np.asarray(carry) is not a violation
+    eps = np.asarray(carry_scalar_eps)
+    return theta[order], pulled, eps
+
+
+def snapshot(device_population):
+    return np.array(  # graftlint: allow(pop-materialization)
+        device_population["theta"])

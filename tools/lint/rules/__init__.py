@@ -1,5 +1,5 @@
 """graftlint rule modules — importing this package registers all
-seventeen rules with :data:`tools.lint.core.RULES` (registration order
+eighteen rules with :data:`tools.lint.core.RULES` (registration order
 is the default run order: the six ported gates first, then the new
 analyzers)."""
 
@@ -20,3 +20,4 @@ from . import study_isolation    # noqa: F401
 from . import claim_discipline   # noqa: F401
 from . import event_discipline   # noqa: F401
 from . import fidelity_discipline  # noqa: F401
+from . import pop_materialization  # noqa: F401

@@ -1,0 +1,114 @@
+"""Rule ``pop-materialization``: no O(population) host materialization
+of carry-sized arrays outside the sketch/capped-support chokepoints.
+
+The HBM ladder (capacity/model.py) plans runs whose population never
+fits on the host as a dense f32 copy — at pop 1e8 a single
+``np.asarray(carry["theta"])`` is 400 MB per parameter column and an
+``np.sort`` of it doubles that.  Every order statistic the control
+plane needs is available sort-free: the device histogram sketch
+(``ops/quantile_sketch.py``), the host iterated-histogram mirror
+(``weighted_statistics._np_sketch_quantile``), and the capped-support
+resampler.  This rule keeps pop-sized arrays out of host numpy: a
+``np.asarray`` / ``np.sort`` / ``device_get`` whose line names a
+population-lane identifier must either route through a chokepoint or
+justify itself with an explicit allow-comment — the surviving legit
+sites (model-count-sized slices, final-population egress through the
+wire chokepoint) are annotated where they stand.
+
+Scope: the engine surface that holds population carries —
+``sampler/``, ``ops/``, ``weighted_statistics.py`` and ``smc.py``.
+Cold modules (visualization, storage import/export) may materialize
+freely: they run once per study on host-sized data.
+
+Suppression: ``# pop-ok`` on the line;
+``# graftlint: allow(pop-materialization)`` also works.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from ..core import Finding, Rule, default_package_root, register
+
+#: population-carry surface (package-root-relative, forward slashes)
+SCAN_PREFIXES = ("sampler/", "ops/")
+SCAN_FILES = ("weighted_statistics.py", "smc.py")
+
+SUPPRESS = "# pop-ok"
+
+# host materialization of a device array: a full copy (np.asarray /
+# np.array), a host sort (np.sort / np.argsort), or an explicit
+# device->host pull.  ``np.asarray`` on host-sized scalars is fine —
+# the _POP_TOKENS co-occurrence filter below is what makes a line a
+# violation.
+_MAT = re.compile(
+    r"\bnp\.(?:asarray|array|sort|argsort)\b"
+    r"|\bjax\.device_get\b|(?<![.\w])device_get\b")
+
+# identifiers that name population-sized lanes of the carry pytree or
+# its host projections.  Deliberately the lane vocabulary of
+# sampler/fused.py's carry, not generic words: a ``np.asarray(eps)``
+# never flags.
+_POP_TOKENS = re.compile(
+    r"\b(?:carry|carry_out|carry_in|theta|log_weight|"
+    r"device_population|particles|population_lanes?)\b")
+
+
+def _package_root(root: str = None) -> str:
+    return root if root is not None else default_package_root()
+
+
+def check(root: str = None) -> list:
+    """Scan the population-carry surface; returns
+    ``[(relpath, lineno, line), ...]`` violations (empty = clean)."""
+    root = _package_root(root)
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if not (rel in SCAN_FILES or rel.startswith(SCAN_PREFIXES)):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if SUPPRESS in line:
+                        continue
+                    code = line.split("#", 1)[0]
+                    if _MAT.search(code) and _POP_TOKENS.search(code):
+                        violations.append((rel, lineno, line.rstrip()))
+    violations.sort(key=lambda v: (v[0], v[1]))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = check(root)
+    if not violations:
+        print("pop materialization: clean (population lanes stay "
+              "on-device or annotated)")
+        return 0
+    print("O(population) host materialization of a carry lane (route "
+          "order statistics through ops/quantile_sketch.py or the "
+          "capped-support resampler, or justify the copy with "
+          f"'{SUPPRESS}'):")
+    for rel, lineno, line in violations:
+        print(f"  pyabc_tpu/{rel}:{lineno}: {line.strip()}")
+    return 1
+
+
+@register
+class PopMaterializationRule(Rule):
+    id = "pop-materialization"
+    description = ("population carry lanes are never materialized on "
+                   "the host outside sketch/capped-support chokepoints; "
+                   "legit copies are annotated")
+
+    def run(self, tree):
+        prefix = tree.package_rel_prefix()
+        return [Finding(self.id, f"{prefix}/{rel}", lineno, line.strip())
+                for rel, lineno, line in check(tree.package_root)]
